@@ -1,0 +1,36 @@
+"""idunno_tpu — a TPU-native distributed ML inference framework.
+
+A from-scratch re-architecture of the capabilities of the IDunno distributed
+learning cluster (UIUC CS425 MP4, reference: kentchen831213/
+-Distributed-Machine-Learning-System): cluster membership + failure detection,
+a replicated versioned file store, fair-time scheduling of concurrent model
+jobs, straggler/failed-host task reassignment, standby-coordinator failover,
+live stats, and an interactive operations shell — built TPU-first:
+
+- compute path: jit-compiled Flax models resident in HBM, batched bfloat16
+  forwards on the MXU, sharded over a `jax.sharding.Mesh` (data parallel over
+  the batch axis, optional tensor parallelism), results collected with XLA
+  collectives over ICI rather than N-way TCP broadcasts
+  (reference: per-image torch forwards, `alexnet_resnet.py:12-92`);
+- control plane: typed messages over a pluggable transport (in-process for
+  tests, UDP/TCP over DCN between TPU hosts), replacing the reference's
+  `"<SEPARATOR>"` string frames (`mp4_machinelearning.py:54`).
+
+Package layout (SURVEY.md §7; layers land bottom-up — a module listed here
+but not yet present is simply not built yet):
+    config      — cluster/runtime configuration (no hardcoded IPs)
+    utils       — enums, hash ring, logging taxonomy
+    comm        — transports + typed control-plane messages + device mesh
+    membership  — join/heartbeat/failure detector
+    store       — replicated versioned file store (SDFS verbs)
+    models      — Flax AlexNet / ResNet-18
+    ops         — preprocessing + device-side classification ops
+    engine      — jit-compiled batched inference + training steps
+    parallel    — sharding policies, collectives, mesh helpers
+    scheduler   — fair-time multi-job scheduling, task bookkeeping
+    serve       — node assembly, coordinator/worker, metrics, failover
+    cli         — interactive operations shell
+    grep        — distributed log grep
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,125 @@
+"""Cluster and runtime configuration.
+
+The reference hardcodes its whole topology: a 10-VM hostname ring and IP map
+(`utils.py:57-61, 70-92`), coordinator IPs edited by hand (`README.md:10-16`,
+`mp4_machinelearning.py:47-48`), ports derived from a username (`:29-42`), and
+scheduling knobs as module constants (`:44-46, 56-57`). Here all of that is a
+dataclass, loadable from JSON or the environment, with zero hardcoded
+addresses.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class PortConfig:
+    """One UDP port for membership datagrams and TCP ports per control-plane
+    service (reference: five fixed ports, `mp4_machinelearning.py:29-42`)."""
+
+    membership: int = 18700
+    store: int = 18710
+    inference: int = 18720
+    result: int = 18730
+    metadata: int = 18740
+    grep: int = 18750
+
+    def offset(self, delta: int) -> "PortConfig":
+        """Shift every port by ``delta`` — lets many nodes share one machine
+        (the in-process/loopback test clusters)."""
+        return PortConfig(**{f.name: getattr(self, f.name) + delta
+                             for f in dataclasses.fields(self)})
+
+
+@dataclass(frozen=True)
+class ClusterConfig:
+    """Static cluster topology + protocol knobs.
+
+    ``hosts`` is the orderd host registry (the ring). The reference's
+    equivalents: `get_all_hosts` (`utils.py:57-61`), `COORDINATOR_IP` /
+    `STANDBY_COORDINATOR_IP` (`mp4_machinelearning.py:47-48`),
+    `INTRODUCER_HOST` (`utils.py:4`).
+    """
+
+    hosts: tuple[str, ...] = tuple(f"node{i}" for i in range(10))
+    coordinator: str = "node0"
+    standby_coordinator: str = "node1"
+    introducer: str = "node0"
+    ports: PortConfig = field(default_factory=PortConfig)
+
+    # Failure detection (reference: 0.3 s ping loop `mp4_machinelearning.py:199`,
+    # 2 s suspicion timeout `:847`).
+    ping_interval_s: float = 0.3
+    failure_timeout_s: float = 2.0
+
+    # File store (reference: 4-5 ring replicas, `utils.py:48-55`).
+    replication_factor: int = 4
+
+    # Scheduler (reference: RATE_FACTOR=10 `mp4_machinelearning.py:44`,
+    # straggler threshold 30 s `:812`).
+    rate_factor: int = 10
+    straggler_timeout_s: float = 30.0
+
+    # Query pump (reference: batch 400, 1 query / 20 s,
+    # `mp4_machinelearning.py:45-46, 1104-1109`).
+    query_batch_size: int = 400
+    query_interval_s: float = 20.0
+
+    # Failover metadata replication period (reference: 1 Hz, `:971-987`).
+    metadata_interval_s: float = 1.0
+
+    def __post_init__(self) -> None:
+        for name in ("coordinator", "standby_coordinator", "introducer"):
+            host = getattr(self, name)
+            if host not in self.hosts:
+                raise ValueError(f"{name}={host!r} is not in hosts")
+        if len(set(self.hosts)) != len(self.hosts):
+            raise ValueError("duplicate hosts in registry")
+
+    @property
+    def n_hosts(self) -> int:
+        return len(self.hosts)
+
+    def ring_index(self, host: str) -> int:
+        return self.hosts.index(host)
+
+    def ring_successors(self, host: str) -> list[str]:
+        """All other hosts in ring order starting after ``host`` (the
+        reference's `get_replica_neighbors`, `utils.py:30-39`)."""
+        i = self.ring_index(host)
+        n = self.n_hosts
+        return [self.hosts[(i + k) % n] for k in range(1, n)]
+
+    @classmethod
+    def from_json(cls, path: str) -> "ClusterConfig":
+        with open(path) as f:
+            raw = json.load(f)
+        if "ports" in raw:
+            raw["ports"] = PortConfig(**raw["ports"])
+        if "hosts" in raw:
+            raw["hosts"] = tuple(raw["hosts"])
+        return cls(**raw)
+
+    @classmethod
+    def from_env(cls) -> "ClusterConfig":
+        """Load from ``IDUNNO_CONFIG`` (a JSON path) or fall back to the
+        default local topology."""
+        path = os.environ.get("IDUNNO_CONFIG")
+        if path:
+            return cls.from_json(path)
+        return cls()
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Model-engine knobs: the TPU replacement for the reference's per-task
+    torch.hub reload + batch=1 loop (`alexnet_resnet.py:17-22, 67`)."""
+
+    batch_size: int = 256           # device batch per forward
+    image_size: int = 224           # crop fed to the model
+    resize_size: int = 256          # canonical host-decoded size
+    compute_dtype: str = "bfloat16"  # MXU-friendly
+    param_dtype: str = "float32"

@@ -1,0 +1,1 @@
+from idunno_tpu.engine.inference import InferenceEngine, QueryResult  # noqa: F401

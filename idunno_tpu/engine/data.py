@@ -1,0 +1,87 @@
+"""Host-side image loading.
+
+The reference's loader is a per-image loop: glob ``test_<N>.JPEG``, PIL open,
+RGB-convert (rewriting the file on disk!), torchvision transforms
+(`alexnet_resnet.py:46-66`). Here the host decodes and resizes to a canonical
+static 256x256 uint8 NHWC batch (shortest-side resize to 256 + center crop —
+equal to the center 256x256 region the reference's CenterCrop(224) would read
+from); everything after that is device-side (`idunno_tpu.ops.preprocess`).
+
+A synthetic generator stands in for the dataset when no image files exist
+(zero-egress test environments): deterministic per-index uint8 images.
+"""
+from __future__ import annotations
+
+import os
+from collections.abc import Iterator
+
+import numpy as np
+
+CANONICAL_SIZE = 256
+
+
+def _resize_shortest(img, target: int):
+    from PIL import Image
+    w, h = img.size
+    if w <= h:
+        new_w, new_h = target, max(target, round(h * target / w))
+    else:
+        new_w, new_h = max(target, round(w * target / h)), target
+    return img.resize((new_w, new_h), Image.BILINEAR)
+
+
+def load_image(path: str, size: int = CANONICAL_SIZE) -> np.ndarray:
+    """Decode one image file → uint8 [size, size, 3] (RGB-converted like the
+    reference `alexnet_resnet.py:51-54`, minus its rewrite-to-disk side
+    effect)."""
+    from PIL import Image
+    with Image.open(path) as img:
+        if img.mode != "RGB":
+            img = img.convert("RGB")
+        img = _resize_shortest(img, size)
+        w, h = img.size
+        left, top = (w - size) // 2, (h - size) // 2
+        img = img.crop((left, top, left + size, top + size))
+        return np.asarray(img, dtype=np.uint8)
+
+
+def image_name(index: int) -> str:
+    """Reference dataset naming: ``test_<N>.JPEG`` (`alexnet_resnet.py:49`)."""
+    return f"test_{index}.JPEG"
+
+
+def image_path(root: str, index: int) -> str:
+    return os.path.join(root, image_name(index))
+
+
+def synthetic_image(index: int, size: int = CANONICAL_SIZE) -> np.ndarray:
+    """Deterministic pseudo-image for a dataset index (no files needed)."""
+    rng = np.random.default_rng(index)
+    return rng.integers(0, 256, size=(size, size, 3), dtype=np.uint8)
+
+
+def load_range(root: str | None, start: int, end: int,
+               size: int = CANONICAL_SIZE) -> tuple[list[str], np.ndarray]:
+    """Load dataset indices [start, end] inclusive (the reference's range
+    convention, `alexnet_resnet.py:48`) → (names, uint8 [N, size, size, 3]).
+
+    Falls back to synthetic images for missing files so a query over a
+    partially-present dataset still completes (the reference silently skips
+    missing indices; we classify a deterministic placeholder instead, keeping
+    result counts exact)."""
+    names, imgs = [], []
+    for i in range(start, end + 1):
+        name = image_name(i)
+        path = image_path(root, i) if root else None
+        if path and os.path.exists(path):
+            imgs.append(load_image(path, size))
+        else:
+            imgs.append(synthetic_image(i, size))
+        names.append(name)
+    return names, np.stack(imgs) if imgs else np.zeros((0, size, size, 3), np.uint8)
+
+
+def iter_batches(names: list[str], images: np.ndarray,
+                 batch_size: int) -> Iterator[tuple[list[str], np.ndarray]]:
+    for i in range(0, len(names), batch_size):
+        yield names[i:i + batch_size], images[i:i + batch_size]

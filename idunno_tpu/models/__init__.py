@@ -1,0 +1,42 @@
+"""Model registry.
+
+The reference supports exactly two model names, dispatched by string
+(`alexnet_resnet.py:17-22`, `mp4_machinelearning.py:560-571`): ``alexnet``
+and ``resnet`` (ResNet-18). We keep those names as the registry keys and make
+the registry extensible.
+"""
+from __future__ import annotations
+
+from collections.abc import Callable
+
+import flax.linen as nn
+
+from idunno_tpu.models.alexnet import AlexNet
+from idunno_tpu.models.resnet import ResNet, resnet18, resnet34
+
+_REGISTRY: dict[str, Callable[..., nn.Module]] = {
+    "alexnet": AlexNet,
+    "resnet": resnet18,      # the reference's "resnet" means ResNet-18
+    "resnet18": resnet18,
+    "resnet34": resnet34,
+}
+
+
+def available_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def create_model(name: str, **kwargs) -> nn.Module:
+    try:
+        return _REGISTRY[name](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; available: {available_models()}") from None
+
+
+def register_model(name: str, factory: Callable[..., nn.Module]) -> None:
+    _REGISTRY[name] = factory
+
+
+__all__ = ["AlexNet", "ResNet", "resnet18", "resnet34", "create_model",
+           "available_models", "register_model"]

@@ -1,0 +1,45 @@
+"""AlexNet as a Flax module, TPU-first.
+
+Replaces the reference's per-task ``torch.hub.load('pytorch/vision', 'alexnet')``
+(`alexnet_resnet.py:17-19`). Architecture matches torchvision ``alexnet``
+(the single-tower variant): five convs, three maxpools, adaptive pool to 6x6,
+three FC layers with dropout. NHWC layout, bfloat16 compute, float32 params.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = lambda feat, kern, stride, pad, name: nn.Conv(
+            feat, kern, strides=stride, padding=pad,
+            dtype=self.dtype, param_dtype=self.param_dtype, name=name)
+        x = x.astype(self.dtype)
+        x = nn.relu(conv(64, (11, 11), (4, 4), ((2, 2), (2, 2)), "conv0")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(192, (5, 5), (1, 1), ((2, 2), (2, 2)), "conv1")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(384, (3, 3), (1, 1), ((1, 1), (1, 1)), "conv2")(x))
+        x = nn.relu(conv(256, (3, 3), (1, 1), ((1, 1), (1, 1)), "conv3")(x))
+        x = nn.relu(conv(256, (3, 3), (1, 1), ((1, 1), (1, 1)), "conv4")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        # torchvision AdaptiveAvgPool2d((6,6)); identity at 224x224 input.
+        from idunno_tpu.ops.pooling import adaptive_avg_pool
+        x = adaptive_avg_pool(x, (6, 6))
+        x = x.reshape((x.shape[0], -1))
+        dense = lambda feat, name: nn.Dense(
+            feat, dtype=self.dtype, param_dtype=self.param_dtype, name=name)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(dense(4096, "fc0")(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(dense(4096, "fc1")(x))
+        x = dense(self.num_classes, "fc2")(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,152 @@
+"""Torchvision → Flax weight conversion (pretrained-weight import).
+
+The reference gets pretrained weights by calling ``torch.hub.load(...,
+pretrained=True)`` on every task (`alexnet_resnet.py:17-22`), which needs
+network access. Here conversion is a one-time, *optional* step: if a
+torchvision checkpoint is available locally (cached hub dir or a state-dict
+file), convert it into our Flax variable tree and persist it via the engine's
+checkpoint path; otherwise models run with deterministic random init (accuracy
+parity then needs the converted weights, throughput does not).
+
+Layout notes:
+- torch convs are OIHW; Flax convs are HWIO  → transpose (2, 3, 1, 0).
+- torch Linear is (out, in); Flax Dense is (in, out) → transpose.
+- AlexNet's first FC consumes a flattened feature map: torch flattens CHW,
+  our NHWC model flattens HWC — rows of fc0's weight must be permuted from
+  C-major to HWC order.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def _t_conv(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def _t_dense(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w, (1, 0))
+
+
+def _chw_to_hwc_rows(w: np.ndarray, c: int, h: int, wdim: int) -> np.ndarray:
+    """Permute a torch Linear weight's input dim from CHW to HWC flattening."""
+    out_f, in_f = w.shape
+    assert in_f == c * h * wdim
+    w = w.reshape(out_f, c, h, wdim).transpose(0, 2, 3, 1).reshape(out_f, in_f)
+    return w
+
+
+def _np(t: Any) -> np.ndarray:
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t,
+                      dtype=np.float32)
+
+
+def convert_resnet18(state_dict: dict[str, Any]) -> dict:
+    """torchvision ``resnet18`` state_dict → our ResNet variables
+    ({'params': ..., 'batch_stats': ...})."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    params: dict[str, Any] = {}
+    stats: dict[str, Any] = {}
+
+    def put(tree, path, leaf):
+        node = tree
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = leaf
+
+    def bn(flax_name, torch_prefix):
+        put(params, (flax_name, "scale"), sd[f"{torch_prefix}.weight"])
+        put(params, (flax_name, "bias"), sd[f"{torch_prefix}.bias"])
+        put(stats, (flax_name, "mean"), sd[f"{torch_prefix}.running_mean"])
+        put(stats, (flax_name, "var"), sd[f"{torch_prefix}.running_var"])
+
+    put(params, ("stem_conv", "kernel"), _t_conv(sd["conv1.weight"]))
+    bn("stem_norm", "bn1")
+    for stage in range(4):
+        for block in range(2):
+            tp = f"layer{stage + 1}.{block}"
+            fb = f"stage{stage}_block{block}"
+            put(params, (fb, "Conv_0", "kernel"), _t_conv(sd[f"{tp}.conv1.weight"]))
+            bn_tree_name = (fb, "BatchNorm_0")
+            put(params, (*bn_tree_name, "scale"), sd[f"{tp}.bn1.weight"])
+            put(params, (*bn_tree_name, "bias"), sd[f"{tp}.bn1.bias"])
+            put(stats, (*bn_tree_name, "mean"), sd[f"{tp}.bn1.running_mean"])
+            put(stats, (*bn_tree_name, "var"), sd[f"{tp}.bn1.running_var"])
+            put(params, (fb, "Conv_1", "kernel"), _t_conv(sd[f"{tp}.conv2.weight"]))
+            bn2 = (fb, "BatchNorm_1")
+            put(params, (*bn2, "scale"), sd[f"{tp}.bn2.weight"])
+            put(params, (*bn2, "bias"), sd[f"{tp}.bn2.bias"])
+            put(stats, (*bn2, "mean"), sd[f"{tp}.bn2.running_mean"])
+            put(stats, (*bn2, "var"), sd[f"{tp}.bn2.running_var"])
+            if f"{tp}.downsample.0.weight" in sd:
+                put(params, (fb, "downsample_conv", "kernel"),
+                    _t_conv(sd[f"{tp}.downsample.0.weight"]))
+                ds = (fb, "downsample_norm")
+                put(params, (*ds, "scale"), sd[f"{tp}.downsample.1.weight"])
+                put(params, (*ds, "bias"), sd[f"{tp}.downsample.1.bias"])
+                put(stats, (*ds, "mean"), sd[f"{tp}.downsample.1.running_mean"])
+                put(stats, (*ds, "var"), sd[f"{tp}.downsample.1.running_var"])
+    put(params, ("fc", "kernel"), _t_dense(sd["fc.weight"]))
+    put(params, ("fc", "bias"), sd["fc.bias"])
+    return {"params": params, "batch_stats": stats}
+
+
+def convert_alexnet(state_dict: dict[str, Any]) -> dict:
+    """torchvision ``alexnet`` state_dict → our AlexNet variables."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    params: dict[str, Any] = {}
+    conv_map = ["features.0", "features.3", "features.6", "features.8",
+                "features.10"]
+    for i, tp in enumerate(conv_map):
+        params[f"conv{i}"] = {"kernel": _t_conv(sd[f"{tp}.weight"]),
+                              "bias": sd[f"{tp}.bias"]}
+    fc_map = ["classifier.1", "classifier.4", "classifier.6"]
+    for i, tp in enumerate(fc_map):
+        w = sd[f"{tp}.weight"]
+        if i == 0:
+            w = _chw_to_hwc_rows(w, c=256, h=6, wdim=6)
+        params[f"fc{i}"] = {"kernel": _t_dense(w), "bias": sd[f"{tp}.bias"]}
+    return {"params": params}
+
+
+def _cached_checkpoint(url: str) -> str | None:
+    """Path of an already-downloaded torch-hub checkpoint for ``url``, or
+    None. Never touches the network."""
+    import os
+
+    try:
+        import torch
+        hub_dir = torch.hub.get_dir()
+    except Exception:
+        return None
+    fname = url.rsplit("/", 1)[-1]
+    path = os.path.join(hub_dir, "checkpoints", fname)
+    return path if os.path.exists(path) else None
+
+
+def try_load_torchvision(model_name: str) -> dict | None:
+    """Best-effort *local* pretrained import: convert a torchvision
+    checkpoint only if it is already in the torch-hub cache. Returns the
+    converted Flax variables, or None when torch/torchvision is missing or
+    nothing is cached — zero-egress environments must never block on a
+    download attempt."""
+    try:
+        import torch
+        from torchvision import models as tvm
+    except Exception:
+        return None
+    if model_name == "alexnet":
+        weights, convert = tvm.AlexNet_Weights.IMAGENET1K_V1, convert_alexnet
+    elif model_name in ("resnet", "resnet18"):
+        weights, convert = tvm.ResNet18_Weights.IMAGENET1K_V1, convert_resnet18
+    else:
+        return None
+    path = _cached_checkpoint(weights.url)
+    if path is None:
+        return None
+    # conversion errors propagate: silently falling back to random weights
+    # while claiming "pretrained" would produce garbage predictions.
+    state_dict = torch.load(path, map_location="cpu", weights_only=True)
+    return convert(state_dict)

@@ -1,0 +1,90 @@
+"""ResNet-18 as a Flax module, TPU-first.
+
+Replaces the reference's per-task ``torch.hub.load('pytorch/vision', 'resnet18')``
+(`alexnet_resnet.py:21-22`) with a module whose parameters are initialised (or
+converted from torchvision, see `models/convert.py`) exactly once and stay
+resident in HBM. Layout is NHWC (XLA's preferred TPU conv layout), compute in
+bfloat16 so convolutions tile onto the MXU, params in float32.
+
+Architecture matches torchvision ``resnet18``: stem conv7x7/2 + maxpool, four
+stages of two BasicBlocks with (64, 128, 256, 512) filters, stride-2
+projection downsample at stage entry, global average pool, 1000-way FC.
+"""
+from __future__ import annotations
+
+from collections.abc import Callable, Sequence
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Callable[..., nn.Module]
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs with a residual connection (torchvision BasicBlock)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        pad1 = ((1, 1), (1, 1))   # torch-style explicit padding, not XLA SAME
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                      padding=pad1)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), padding=pad1)(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1),
+                                 strides=(self.strides, self.strides),
+                                 padding="VALID", name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_norm")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Generic BasicBlock ResNet (18 = [2,2,2,2], 34 = [3,4,6,3])."""
+
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False,
+                       dtype=self.dtype, param_dtype=self.param_dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5,
+                       dtype=self.dtype, param_dtype=self.param_dtype)
+
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), strides=(2, 2),
+                 padding=((3, 3), (3, 3)), name="stem_conv")(x)
+        x = norm(name="stem_norm")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BasicBlock(self.num_filters * 2 ** stage, strides,
+                               conv=conv, norm=norm,
+                               name=f"stage{stage}_block{block}")(x)
+        x = jnp.mean(x, axis=(1, 2))            # global average pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="fc")(x)
+        return x.astype(jnp.float32)            # logits in f32 for stable softmax
+
+
+def resnet18(**kwargs) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), **kwargs)
+
+
+def resnet34(**kwargs) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), **kwargs)

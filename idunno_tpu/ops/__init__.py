@@ -1,0 +1,3 @@
+from idunno_tpu.ops.preprocess import (  # noqa: F401
+    IMAGENET_MEAN, IMAGENET_STD, center_crop, preprocess_batch)
+from idunno_tpu.ops.classify import top1_from_logits, topk_from_logits  # noqa: F401

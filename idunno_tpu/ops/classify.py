@@ -1,0 +1,26 @@
+"""Device-side classification heads.
+
+The reference runs host-side ``softmax`` then ``topk(..., 1)`` per image
+(`alexnet_resnet.py:80-88`). Here softmax + top-k happen on device over the
+whole batch, so only (index, probability) pairs — not 1000-way probability
+vectors — cross the HBM→host boundary.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def top1_from_logits(logits: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, C] logits → ([B] int32 class ids, [B] f32 probabilities)."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+    top_prob = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+    return idx, top_prob
+
+
+def topk_from_logits(logits: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, C] logits → ([B, k] class ids, [B, k] probabilities)."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_prob, idx = jax.lax.top_k(probs, k)
+    return idx.astype(jnp.int32), top_prob

@@ -1,0 +1,27 @@
+"""Adaptive average pooling with torch semantics (static shapes).
+
+torchvision AlexNet uses ``AdaptiveAvgPool2d((6, 6))`` before the classifier.
+Window boundaries follow torch: start = floor(i*H/out), end = ceil((i+1)*H/out).
+The double loop is over the *output* grid (static, e.g. 36 cells), so XLA sees
+a fixed fusion-friendly graph — no dynamic shapes.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def adaptive_avg_pool(x: jnp.ndarray, out_hw: tuple[int, int]) -> jnp.ndarray:
+    """NHWC [B,H,W,C] → [B,out_h,out_w,C]."""
+    _, h, w, _ = x.shape
+    out_h, out_w = out_hw
+    if (h, w) == (out_h, out_w):
+        return x
+    rows = []
+    for i in range(out_h):
+        h0, h1 = (i * h) // out_h, -((-(i + 1) * h) // out_h)
+        cols = []
+        for j in range(out_w):
+            w0, w1 = (j * w) // out_w, -((-(j + 1) * w) // out_w)
+            cols.append(jnp.mean(x[:, h0:h1, w0:w1, :], axis=(1, 2)))
+        rows.append(jnp.stack(cols, axis=1))
+    return jnp.stack(rows, axis=1)

@@ -1,0 +1,39 @@
+"""Device-side image preprocessing.
+
+The reference preprocesses one image at a time on the host with torchvision
+transforms — Resize(256) / CenterCrop(224) / ToTensor / Normalize
+(`alexnet_resnet.py:57-62`). Here the host loader only decodes and resizes to
+a canonical static 256x256 (see `idunno_tpu.engine.data`); the crop, dtype
+conversion, and normalization run on the TPU, batched and fused by XLA into
+the first convolution's input pipeline. Static shapes throughout — one
+compiled executable per (model, batch) pair, reused forever.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# torchvision ImageNet normalization constants (`alexnet_resnet.py:61`).
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def center_crop(x: jnp.ndarray, size: int) -> jnp.ndarray:
+    """Center-crop NHWC batch to ``size`` (static slice — jit friendly)."""
+    h, w = x.shape[1], x.shape[2]
+    top = (h - size) // 2
+    left = (w - size) // 2
+    return x[:, top:top + size, left:left + size, :]
+
+
+def preprocess_batch(images_u8: jnp.ndarray, *, crop: int = 224) -> jnp.ndarray:
+    """uint8 NHWC batch (canonical 256x256) → normalized f32 NHWC ``crop``².
+
+    Matches CenterCrop(224) + ToTensor + Normalize from the reference
+    pipeline; the Resize(256-shortest-side) half happens at decode time on
+    the host.
+    """
+    x = center_crop(images_u8, crop)
+    x = x.astype(jnp.float32) / 255.0
+    mean = jnp.asarray(IMAGENET_MEAN, dtype=jnp.float32)
+    std = jnp.asarray(IMAGENET_STD, dtype=jnp.float32)
+    return (x - mean) / std

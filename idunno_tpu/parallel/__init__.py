@@ -1,0 +1,3 @@
+from idunno_tpu.parallel.mesh import make_mesh, local_mesh  # noqa: F401
+from idunno_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding, replicated_sharding, shard_batch)

@@ -1,0 +1,41 @@
+"""Device-mesh construction.
+
+The reference's "mesh" is the 10-VM host ring (`utils.py:57-61`) with raw
+sockets between nodes. The TPU-native worker set is the chips of a pod slice
+arranged in a `jax.sharding.Mesh`; data movement between them is XLA
+collectives over ICI, inserted by the compiler from sharding annotations —
+not hand-written sends (SURVEY.md §5 "distributed communication backend").
+
+Axis conventions:
+    data   — batch-dimension data parallelism (the reference's only strategy:
+             query-range sharding, `mp4_machinelearning.py:516-536`)
+    model  — optional tensor parallelism for wide layers
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(n_data: int, n_model: int = 1,
+              devices: list | None = None) -> Mesh:
+    """Build a (data, model) mesh over ``devices`` (default: all local)."""
+    devices = devices if devices is not None else jax.devices()
+    need = n_data * n_model
+    if need > len(devices):
+        raise ValueError(f"mesh {n_data}x{n_model} needs {need} devices, "
+                         f"have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(n_data, n_model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def local_mesh(n_model: int = 1) -> Mesh:
+    """Mesh over every visible device, data-parallel by default."""
+    n = len(jax.devices())
+    if n % n_model:
+        raise ValueError(f"{n} devices not divisible by model axis {n_model}")
+    return make_mesh(n // n_model, n_model)

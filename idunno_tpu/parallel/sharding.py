@@ -1,0 +1,45 @@
+"""Sharding policies.
+
+Params are replicated across the data axis (each chip holds the full model in
+HBM — the reference's whole-model-per-worker layout, `alexnet_resnet.py:18-22`,
+done right); batches are sharded over the data axis so each chip computes its
+contiguous slice of the query range. Optional tensor parallelism shards wide
+kernels over the model axis.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from idunno_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading (batch) dim split over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch: Any) -> Any:
+    """Place a host batch on the mesh, leading dim over the data axis."""
+    return jax.device_put(batch, batch_sharding(mesh))
+
+
+def replicate(mesh: Mesh, tree: Any) -> Any:
+    """Replicate a pytree (model variables) across the whole mesh."""
+    return jax.device_put(tree, replicated_sharding(mesh))
+
+
+def tp_param_spec(path: tuple, leaf: Any) -> P:
+    """Tensor-parallel PartitionSpec for a param leaf: shard the last
+    (output-features) dim of large Dense kernels over the model axis,
+    replicate everything else. Used by the optional TP engine mode."""
+    name = "/".join(str(p) for p in path)
+    if leaf.ndim >= 2 and leaf.shape[-1] % 2 == 0 and "fc" in name and leaf.size > 1 << 20:
+        return P(*([None] * (leaf.ndim - 1) + [MODEL_AXIS]))
+    return P()

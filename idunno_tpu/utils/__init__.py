@@ -1,0 +1,2 @@
+from idunno_tpu.utils.types import MemberStatus, MessageType  # noqa: F401
+from idunno_tpu.utils.ring import file_replica_hosts, hash_ring_index  # noqa: F401

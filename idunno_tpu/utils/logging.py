@@ -1,0 +1,48 @@
+"""Structured logging, mirroring the reference's logger taxonomy.
+
+Reference (`mp4_machinelearning.py:62-80`): a rotating ``host.log`` (100 MB,
+one backup) plus ERROR-level console, with six named loggers — receiver,
+monitor, join, send, master, sdfs. We keep the taxonomy (plus scheduler /
+engine / failover loggers) but tag records with the node name so in-process
+multi-node test clusters produce readable interleaved logs.
+"""
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import os
+
+LOGGER_NAMES = (
+    "receiver", "monitor", "join", "send", "master", "sdfs",
+    "scheduler", "engine", "failover", "metrics", "grep",
+)
+
+_FMT = "%(asctime)s %(levelname)s [%(name)s] %(message)s"
+
+
+def setup_node_logging(node_name: str, log_dir: str = ".",
+                       console_level: int = logging.ERROR,
+                       file_level: int = logging.INFO) -> logging.Logger:
+    """Configure the per-node rotating file log + console errors; returns the
+    node's root logger. Loggers are namespaced ``idunno.<node>.<component>``."""
+    root = logging.getLogger(f"idunno.{node_name}")
+    root.setLevel(min(console_level, file_level))
+    if root.handlers:   # idempotent for repeated Server construction in tests
+        return root
+    os.makedirs(log_dir, exist_ok=True)
+    fh = logging.handlers.RotatingFileHandler(
+        os.path.join(log_dir, f"{node_name}.log"),
+        maxBytes=100 * 1024 * 1024, backupCount=1)
+    fh.setLevel(file_level)
+    fh.setFormatter(logging.Formatter(_FMT))
+    ch = logging.StreamHandler()
+    ch.setLevel(console_level)
+    ch.setFormatter(logging.Formatter(_FMT))
+    root.addHandler(fh)
+    root.addHandler(ch)
+    root.propagate = False
+    return root
+
+
+def component_logger(node_name: str, component: str) -> logging.Logger:
+    return logging.getLogger(f"idunno.{node_name}.{component}")

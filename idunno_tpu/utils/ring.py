@@ -1,0 +1,30 @@
+"""Hash-ring placement for replicated files.
+
+Reference semantics: a file is placed at ``hash(name) % 10`` and replicated to
+the next ring slots, skipping slot 0 (the master keeps its own copy anyway) —
+`get_file_neighbors` (`utils.py:48-55`), call site `mp4_machinelearning.py:361`.
+
+Here the ring is the configured host registry; placement is a deterministic
+stable hash (not Python's randomized ``hash``) so every node computes the same
+replica set, and the primary-host copy is part of the replica set explicitly
+instead of implicitly.
+"""
+from __future__ import annotations
+
+import zlib
+
+
+def hash_ring_index(name: str, n_hosts: int) -> int:
+    """Deterministic ring slot for a file name (stable across processes,
+    unlike the reference's ``hash(sdfsfilename)%10``)."""
+    return zlib.crc32(name.encode()) % n_hosts
+
+
+def file_replica_hosts(name: str, hosts: tuple[str, ...] | list[str],
+                       replication_factor: int) -> list[str]:
+    """The ordered replica set for ``name``: the hashed primary slot plus the
+    next ``replication_factor - 1`` ring successors."""
+    n = len(hosts)
+    k = min(replication_factor, n)
+    start = hash_ring_index(name, n)
+    return [hosts[(start + i) % n] for i in range(k)]

@@ -1,0 +1,33 @@
+"""Test harness: emulate an 8-chip slice on CPU.
+
+Must run before jax is imported anywhere (SURVEY.md §4: multi-device tests via
+``--xla_force_host_platform_device_count``).
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's sitecustomize imports jax at interpreter startup (before
+# this conftest), so the env vars above are too late for platform selection —
+# force it through the live config as well (must happen before any backend
+# initialisation).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

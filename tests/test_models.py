@@ -1,0 +1,129 @@
+"""Model + engine tests (SURVEY.md C5)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idunno_tpu.config import EngineConfig
+from idunno_tpu.engine import InferenceEngine
+from idunno_tpu.engine import data as data_lib
+from idunno_tpu.models import available_models, create_model
+from idunno_tpu.ops.classify import top1_from_logits, topk_from_logits
+from idunno_tpu.ops.preprocess import center_crop, preprocess_batch
+from idunno_tpu.parallel.mesh import make_mesh
+
+
+def test_registry_has_reference_models():
+    # the two names the reference dispatches on (`mp4_machinelearning.py:560-571`)
+    assert "alexnet" in available_models()
+    assert "resnet" in available_models()
+
+
+@pytest.mark.parametrize("name,expected_params", [
+    ("resnet", 11_689_512),   # torchvision resnet18 param count
+    ("alexnet", 61_100_840),  # torchvision alexnet param count
+])
+def test_model_shapes_and_param_counts(name, expected_params):
+    model = create_model(name)
+    x = jnp.zeros((2, 224, 224, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 1000)
+    assert logits.dtype == jnp.float32
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(variables["params"]))
+    assert n_params == expected_params
+
+
+def test_preprocess_matches_reference_semantics():
+    imgs = np.random.default_rng(0).integers(
+        0, 256, size=(3, 256, 256, 3), dtype=np.uint8)
+    out = preprocess_batch(jnp.asarray(imgs))
+    assert out.shape == (3, 224, 224, 3)
+    # white pixel normalizes to (1 - mean) / std
+    white = preprocess_batch(jnp.full((1, 256, 256, 3), 255, jnp.uint8))
+    np.testing.assert_allclose(
+        np.asarray(white)[0, 0, 0],
+        (1.0 - np.array([0.485, 0.456, 0.406])) / np.array([0.229, 0.224, 0.225]),
+        rtol=1e-5)
+
+
+def test_center_crop_is_centered():
+    x = jnp.zeros((1, 256, 256, 3)).at[:, 16:240, 16:240, :].set(1.0)
+    out = center_crop(x, 224)
+    assert out.shape == (1, 224, 224, 3)
+    assert float(out.sum()) == 224 * 224 * 3
+
+
+def test_top1_and_topk():
+    logits = jnp.asarray([[0.0, 5.0, 1.0], [3.0, 0.0, 0.0]])
+    idx, prob = top1_from_logits(logits)
+    assert idx.tolist() == [1, 0]
+    assert np.all(np.asarray(prob) > 0.5)
+    kidx, kprob = topk_from_logits(logits, 2)
+    assert kidx.shape == (2, 2)
+    assert kidx[0].tolist() == [1, 2]
+    # probabilities sorted descending
+    assert np.all(np.diff(np.asarray(kprob), axis=1) <= 0)
+
+
+def test_engine_end_to_end_synthetic():
+    eng = InferenceEngine(EngineConfig(batch_size=16), pretrained=False)
+    res = eng.infer("resnet", 0, 24)   # inclusive range, like the reference
+    assert res.model == "resnet"
+    assert len(res.records) == 25
+    name0, cat0, prob0 = res.records[0]
+    assert name0 == "test_0.JPEG"     # reference naming `alexnet_resnet.py:86`
+    assert isinstance(cat0, str) and 0.0 <= prob0 <= 1.0
+    assert res.elapsed_s > 0
+    # determinism: same input -> same prediction
+    res2 = eng.infer("resnet", 0, 24)
+    assert [r[1] for r in res.records] == [r[1] for r in res2.records]
+
+
+def test_engine_pads_partial_batches():
+    eng = InferenceEngine(EngineConfig(batch_size=8), pretrained=False)
+    idx, prob = eng.infer_batch(
+        "alexnet", np.zeros((3, 256, 256, 3), np.uint8))
+    assert idx.shape == (3,) and prob.shape == (3,)
+
+
+def test_engine_on_multichip_mesh(eight_devices):
+    mesh = make_mesh(8, 1, devices=eight_devices)
+    eng = InferenceEngine(EngineConfig(batch_size=16), mesh=mesh,
+                          pretrained=False)
+    res = eng.infer("resnet", 0, 31)
+    assert len(res.records) == 32
+
+
+def test_load_range_synthetic_deterministic(tmp_path):
+    names, imgs = data_lib.load_range(str(tmp_path), 5, 9)
+    assert names == [f"test_{i}.JPEG" for i in range(5, 10)]
+    assert imgs.shape == (5, 256, 256, 3)
+    names2, imgs2 = data_lib.load_range(None, 5, 9)
+    np.testing.assert_array_equal(imgs, imgs2)
+
+
+def test_infer_empty_range_returns_empty():
+    eng = InferenceEngine(EngineConfig(batch_size=8), pretrained=False)
+    idx, prob = eng.infer_batch("resnet", np.zeros((0, 256, 256, 3), np.uint8))
+    assert idx.shape == (0,) and prob.shape == (0,)
+
+
+def test_train_step_learns_and_varies_dropout():
+    import optax
+    from idunno_tpu.engine.train import (
+        create_train_state, make_train_step)
+    model = create_model("alexnet")
+    tx = optax.sgd(1e-2)
+    state = create_train_state(model, jax.random.PRNGKey(0), 64, tx)
+    step = jax.jit(make_train_step(model, tx))
+    images = jnp.ones((4, 64, 64, 3), jnp.float32)
+    labels = jnp.zeros((4,), jnp.int32)
+    state1, m1 = step(state, images, labels)
+    state2, m2 = step(state1, images, labels)
+    assert int(state2.step) == 2
+    # params actually move
+    delta = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(jnp.abs(a - b).sum()), state.params, state2.params))
+    assert sum(delta) > 0
