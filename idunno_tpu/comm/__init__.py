@@ -1,0 +1,3 @@
+from idunno_tpu.comm.message import Message  # noqa: F401
+from idunno_tpu.comm.transport import Transport  # noqa: F401
+from idunno_tpu.comm.inproc import InProcNetwork, InProcTransport  # noqa: F401

@@ -1,0 +1,97 @@
+"""In-process fake network — the test cluster substrate.
+
+The reference was only ever tested by hand on 10 real VMs (SURVEY.md §4).
+This transport lets N node objects form a cluster inside one process with
+controllable failures: ``kill(host)`` makes a node unreachable (process
+crash), ``partition(a, b)`` drops traffic between two hosts (network cut),
+both reversible. Delivery is synchronous on the caller's thread — tests stay
+deterministic; the node runtime supplies its own threads for periodic loops.
+"""
+from __future__ import annotations
+
+import threading
+
+from idunno_tpu.comm.message import Message
+from idunno_tpu.comm.transport import Handler, Transport, TransportError
+
+
+class InProcNetwork:
+    """Shared registry of node transports + fault state."""
+
+    def __init__(self) -> None:
+        self._nodes: dict[str, "InProcTransport"] = {}
+        self._dead: set[str] = set()
+        self._cuts: set[frozenset[str]] = set()
+        self._lock = threading.RLock()
+
+    def transport(self, host: str) -> "InProcTransport":
+        with self._lock:
+            t = InProcTransport(host, self)
+            self._nodes[host] = t
+            return t
+
+    # -- fault injection --------------------------------------------------
+
+    def kill(self, host: str) -> None:
+        with self._lock:
+            self._dead.add(host)
+
+    def revive(self, host: str) -> None:
+        with self._lock:
+            self._dead.discard(host)
+
+    def partition(self, a: str, b: str) -> None:
+        with self._lock:
+            self._cuts.add(frozenset((a, b)))
+
+    def heal(self, a: str, b: str) -> None:
+        with self._lock:
+            self._cuts.discard(frozenset((a, b)))
+
+    # -- delivery ---------------------------------------------------------
+
+    def _reachable(self, src: str, dst: str) -> bool:
+        with self._lock:
+            return (dst in self._nodes and dst not in self._dead
+                    and src not in self._dead
+                    and frozenset((src, dst)) not in self._cuts)
+
+    def deliver(self, src: str, dst: str, service: str,
+                msg: Message, reliable: bool) -> Message | None:
+        if not self._reachable(src, dst):
+            if reliable:
+                raise TransportError(f"{dst} unreachable from {src}")
+            return None
+        with self._lock:
+            node = self._nodes[dst]
+            handler = node._handlers.get(service)
+        if handler is None:
+            if reliable:
+                raise TransportError(f"{dst} has no service {service!r}")
+            return None
+        # round-trip through bytes so serialization bugs surface in tests
+        wire = Message.from_bytes(msg.to_bytes())
+        return handler(service, wire)
+
+
+class InProcTransport(Transport):
+    def __init__(self, host: str, net: InProcNetwork) -> None:
+        self.host = host
+        self._net = net
+        self._handlers: dict[str, Handler] = {}
+
+    def serve(self, service: str, handler: Handler) -> None:
+        self._handlers[service] = handler
+
+    def call(self, host: str, service: str, msg: Message,
+             timeout: float | None = None) -> Message | None:
+        return self._net.deliver(self.host, host, service, msg, reliable=True)
+
+    def datagram(self, host: str, service: str, msg: Message) -> None:
+        try:
+            self._net.deliver(self.host, host, service, msg, reliable=False)
+        except TransportError:
+            pass
+
+    def close(self) -> None:
+        self._handlers.clear()

@@ -1,0 +1,52 @@
+"""Transport abstraction for the DCN control plane.
+
+The reference binds five raw sockets per node (ports `mp4_machinelearning.py
+:29-42`) and hand-codes connect/send/recv at every call site. Here a node
+talks to a named (host, service) endpoint through one interface with two
+delivery modes, and the wire substrate is pluggable:
+
+- ``InProcTransport`` (comm/inproc.py) — loopback delivery inside one
+  process, for the fake-cluster test fixture (SURVEY.md §4).
+- ``NetTransport`` (comm/net.py) — JSON-over-TCP with length framing plus
+  UDP datagrams, for real multi-host deployments over DCN.
+
+Services (the reference's ports): membership, store, inference, result,
+metadata, grep.
+"""
+from __future__ import annotations
+
+import abc
+from collections.abc import Callable
+
+from idunno_tpu.comm.message import Message
+
+# handler: (service, msg) -> reply Message or None
+Handler = Callable[[str, Message], Message | None]
+
+
+class TransportError(Exception):
+    """Peer unreachable / connection failed — the caller decides whether to
+    fail over (the reference's primary→standby retry, `:956-963`)."""
+
+
+class Transport(abc.ABC):
+    """One node's endpoint: serve handlers, call peers."""
+
+    @abc.abstractmethod
+    def serve(self, service: str, handler: Handler) -> None:
+        """Register the handler for a named service on this node."""
+
+    @abc.abstractmethod
+    def call(self, host: str, service: str, msg: Message,
+             timeout: float | None = None) -> Message | None:
+        """Reliable request/response (the TCP paths). Raises TransportError
+        if the peer is unreachable."""
+
+    @abc.abstractmethod
+    def datagram(self, host: str, service: str, msg: Message) -> None:
+        """Unreliable fire-and-forget (the UDP membership path). Silently
+        drops if the peer is unreachable."""
+
+    @abc.abstractmethod
+    def close(self) -> None:
+        ...

@@ -1,0 +1,2 @@
+from idunno_tpu.membership.service import MembershipService  # noqa: F401
+from idunno_tpu.membership.list import MemberEntry, MembershipList  # noqa: F401

@@ -1,0 +1,175 @@
+"""Membership + failure detection service.
+
+Reference semantics preserved (SURVEY.md C2):
+- JOIN via introducer/master: newcomer asks the introducer, gets the full
+  list back (`mp4_machinelearning.py:163-189`).
+- Master-driven heartbeats: the acting master pings every other host on a
+  0.3 s period, piggybacking its full membership list (`:191-220`);
+  receivers merge by timestamp and PONG their own list back (`:272-287`).
+- Suspicion: the acting master marks hosts LEAVE after 2 s of silence
+  (`:832-884`) and the change propagates on the next ping wave.
+- Voluntary leave (`:1038-1052`) is a LEAVE broadcast, distinct from a crash.
+
+Beyond the reference (which hardcodes one master): mastership is *acting* —
+if the configured coordinator is dead in the local view, the standby
+coordinator assumes the heartbeat/monitor role, and it detects the
+coordinator's death itself by ping silence. Status-change callbacks drive
+store re-replication and scheduler reassignment (the reference couples these
+inline in `monitor_program`, `:852-884`).
+
+Periodic methods (``ping_once`` / ``monitor_once``) are pure steps driven by
+the node runtime's threads — or directly by tests, no sleeping in here.
+"""
+from __future__ import annotations
+
+import time
+from collections.abc import Callable
+
+from idunno_tpu.comm.message import Message
+from idunno_tpu.comm.transport import Transport, TransportError
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.list import MembershipList
+from idunno_tpu.utils.types import MemberStatus, MessageType
+
+SERVICE = "membership"
+
+# callback(host, old_status_or_None, new_status)
+ChangeCallback = Callable[[str, MemberStatus | None, MemberStatus], None]
+
+
+class MembershipService:
+    def __init__(self, host: str, config: ClusterConfig, transport: Transport,
+                 clock: Callable[[], float] = time.time) -> None:
+        self.host = host
+        self.config = config
+        self.transport = transport
+        self.clock = clock
+        self.members = MembershipList()
+        self._callbacks: list[ChangeCallback] = []
+        transport.serve(SERVICE, self._handle)
+
+    # -- wiring -----------------------------------------------------------
+
+    def on_change(self, cb: ChangeCallback) -> None:
+        self._callbacks.append(cb)
+
+    def _fire(self, changes) -> None:
+        for host, old, new in changes:
+            for cb in self._callbacks:
+                cb(host, old, new)
+
+    # -- mastership -------------------------------------------------------
+
+    def acting_master(self) -> str:
+        """The configured coordinator while it is alive in the local view,
+        else the standby (the reference's primary→standby order,
+        `mp4_machinelearning.py:47-48, 956-963`)."""
+        c = self.config.coordinator
+        if self.members.get(c) is None or self.members.is_alive(c):
+            return c
+        return self.config.standby_coordinator
+
+    @property
+    def is_acting_master(self) -> bool:
+        return self.acting_master() == self.host
+
+    # -- lifecycle --------------------------------------------------------
+
+    def join(self) -> None:
+        """Introduce self. The introducer (or any alive seed) replies with
+        the merged full list."""
+        now = self.clock()
+        self.members.set(self.host, MemberStatus.RUNNING, now)
+        self.members.touch(self.host, now)
+        if self.host == self.config.introducer:
+            return
+        msg = Message(MessageType.JOIN, self.host,
+                      {"members": self.members.to_wire()})
+        for seed in (self.config.introducer, self.config.coordinator,
+                     self.config.standby_coordinator):
+            if seed == self.host:
+                continue
+            try:
+                out = self.transport.call(seed, SERVICE, msg, timeout=5.0)
+            except TransportError:
+                continue
+            if out is not None:
+                self._fire(self.members.merge(out.payload["members"]))
+                return
+        # nobody reachable — we are first up; keep our solo list.
+
+    def leave(self) -> None:
+        """Voluntary leave: broadcast a LEAVE-stamped list (distinct from a
+        crash, which is only ever *detected*)."""
+        now = self.clock()
+        self.members.set(self.host, MemberStatus.LEAVE, now)
+        msg = Message(MessageType.LEAVE, self.host,
+                      {"members": self.members.to_wire()})
+        for h in self.config.hosts:
+            if h != self.host:
+                self.transport.datagram(h, SERVICE, msg)
+
+    # -- periodic steps (driven by runtime threads or tests) --------------
+
+    def ping_once(self) -> None:
+        """Acting master only: heartbeat every other configured host with
+        the full list piggybacked."""
+        if not self.is_acting_master:
+            return
+        msg = Message(MessageType.PING, self.host,
+                      {"members": self.members.to_wire()})
+        for h in self.config.hosts:
+            if h != self.host:
+                self.transport.datagram(h, SERVICE, msg)
+
+    def monitor_once(self) -> None:
+        """Failure detection step.
+
+        Acting master: mark alive members LEAVE after ``failure_timeout_s``
+        of silence. Standby (not acting master): watch only the coordinator's
+        ping stream — silence there promotes the standby on the next step.
+        """
+        now = self.clock()
+        timeout = self.config.failure_timeout_s
+        if self.is_acting_master:
+            for e in self.members.entries():
+                if e.host == self.host or not e.status.alive:
+                    continue
+                if not e.last_heard:
+                    # never heard from (e.g. we just became master): start
+                    # this host's silence clock NOW so a host that died
+                    # during the failover window is still detected.
+                    self.members.touch(e.host, now)
+                    continue
+                if now - e.last_heard > timeout:
+                    self.members.set(e.host, MemberStatus.LEAVE, now)
+                    self._fire([(e.host, MemberStatus.RUNNING,
+                                 MemberStatus.LEAVE)])
+        elif self.host == self.config.standby_coordinator:
+            c = self.members.get(self.config.coordinator)
+            if (c is not None and c.status.alive and c.last_heard
+                    and now - c.last_heard > timeout):
+                self.members.set(c.host, MemberStatus.LEAVE, now)
+                self._fire([(c.host, MemberStatus.RUNNING,
+                             MemberStatus.LEAVE)])
+
+    # -- message handling -------------------------------------------------
+
+    def _handle(self, service: str, msg: Message) -> Message | None:
+        now = self.clock()
+        if msg.type is MessageType.JOIN:
+            self._fire(self.members.merge(msg.payload["members"]))
+            self.members.touch(msg.sender, now)
+            return Message(MessageType.ACK, self.host,
+                           {"members": self.members.to_wire()})
+        if msg.type in (MessageType.PING, MessageType.PONG,
+                        MessageType.LEAVE):
+            self._fire(self.members.merge(msg.payload["members"]))
+            self.members.touch(msg.sender, now)
+            if msg.type is MessageType.PING:
+                self.transport.datagram(
+                    msg.sender, SERVICE,
+                    Message(MessageType.PONG, self.host,
+                            {"members": self.members.to_wire()}))
+            return None
+        return None

@@ -1,0 +1,1 @@
+from idunno_tpu.store.sdfs import FileStoreService  # noqa: F401

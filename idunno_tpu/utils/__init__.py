@@ -1,2 +1,2 @@
 from idunno_tpu.utils.types import MemberStatus, MessageType  # noqa: F401
-from idunno_tpu.utils.ring import file_replica_hosts, hash_ring_index  # noqa: F401
+from idunno_tpu.utils.ring import hash_ring_index, ring_order  # noqa: F401

@@ -6,8 +6,7 @@ the next ring slots, skipping slot 0 (the master keeps its own copy anyway) —
 
 Here the ring is the configured host registry; placement is a deterministic
 stable hash (not Python's randomized ``hash``) so every node computes the same
-replica set, and the primary-host copy is part of the replica set explicitly
-instead of implicitly.
+replica set.
 """
 from __future__ import annotations
 
@@ -20,11 +19,9 @@ def hash_ring_index(name: str, n_hosts: int) -> int:
     return zlib.crc32(name.encode()) % n_hosts
 
 
-def file_replica_hosts(name: str, hosts: tuple[str, ...] | list[str],
-                       replication_factor: int) -> list[str]:
-    """The ordered replica set for ``name``: the hashed primary slot plus the
-    next ``replication_factor - 1`` ring successors."""
+def ring_order(name: str, hosts: tuple[str, ...] | list[str]) -> list[str]:
+    """All hosts in ring order starting from ``name``'s hash slot — callers
+    filter by liveness and truncate to their replication factor."""
     n = len(hosts)
-    k = min(replication_factor, n)
     start = hash_ring_index(name, n)
-    return [hosts[(start + i) % n] for i in range(k)]
+    return [hosts[(start + i) % n] for i in range(n)]

@@ -1,0 +1,139 @@
+"""Membership + failure detector tests (SURVEY.md C2) on the in-process
+fake cluster — the test capability the reference never had (§4)."""
+import pytest
+
+from idunno_tpu.comm.inproc import InProcNetwork
+from idunno_tpu.comm.message import Message
+from idunno_tpu.comm.transport import TransportError
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.service import MembershipService
+from idunno_tpu.utils.types import MemberStatus, MessageType
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture
+def cluster():
+    cfg = ClusterConfig(hosts=tuple(f"n{i}" for i in range(5)),
+                        coordinator="n0", standby_coordinator="n1",
+                        introducer="n0")
+    net = InProcNetwork()
+    clock = FakeClock()
+    services = {}
+    for h in cfg.hosts:
+        services[h] = MembershipService(h, cfg, net.transport(h), clock=clock)
+    for h in cfg.hosts:
+        services[h].join()
+        clock.advance(0.01)
+    return cfg, net, clock, services
+
+
+def pump(services, clock, waves=3, dt=0.3):
+    for _ in range(waves):
+        for s in services.values():
+            s.ping_once()
+        clock.advance(dt)
+
+
+def test_message_roundtrip_with_blob():
+    m = Message(MessageType.PUT, "n3", {"k": [1, 2]}, blob=b"\x00raw\xff")
+    out = Message.from_bytes(m.to_bytes())
+    assert out.type is MessageType.PUT
+    assert out.sender == "n3"
+    assert out.payload == {"k": [1, 2]}
+    assert out.blob == b"\x00raw\xff"
+
+
+def test_inproc_kill_and_partition():
+    net = InProcNetwork()
+    ta = net.transport("a")
+    tb = net.transport("b")
+    tb.serve("echo", lambda svc, m: Message(MessageType.ACK, "b"))
+    assert ta.call("b", "echo", Message(MessageType.PING, "a")).type is MessageType.ACK
+    net.partition("a", "b")
+    with pytest.raises(TransportError):
+        ta.call("b", "echo", Message(MessageType.PING, "a"))
+    net.heal("a", "b")
+    net.kill("b")
+    with pytest.raises(TransportError):
+        ta.call("b", "echo", Message(MessageType.PING, "a"))
+    net.revive("b")
+    assert ta.call("b", "echo", Message(MessageType.PING, "a")) is not None
+
+
+def test_join_converges_everywhere(cluster):
+    cfg, net, clock, services = cluster
+    pump(services, clock)
+    for h in cfg.hosts:
+        assert services[h].members.alive_hosts() == list(cfg.hosts), h
+
+
+def test_failure_detection_and_propagation(cluster):
+    cfg, net, clock, services = cluster
+    pump(services, clock)
+    events = []
+    services["n0"].on_change(lambda h, o, n: events.append((h, n)))
+    net.kill("n3")
+    # silence > 2 s: pings go unanswered
+    pump(services, clock, waves=8, dt=0.3)
+    services["n0"].monitor_once()
+    assert ("n3", MemberStatus.LEAVE) in events
+    assert "n3" not in services["n0"].members.alive_hosts()
+    # propagation to everyone else on the next wave
+    pump(services, clock, waves=1)
+    for h in ("n1", "n2", "n4"):
+        assert "n3" not in services[h].members.alive_hosts(), h
+
+
+def test_voluntary_leave_and_rejoin(cluster):
+    cfg, net, clock, services = cluster
+    pump(services, clock)
+    services["n4"].leave()
+    for h in ("n0", "n1", "n2", "n3"):
+        assert "n4" not in services[h].members.alive_hosts(), h
+    clock.advance(1.0)
+    services["n4"].join()        # rejoin with a newer timestamp
+    pump(services, clock)
+    for h in cfg.hosts:
+        assert "n4" in services[h].members.alive_hosts(), h
+
+
+def test_standby_takes_over_on_coordinator_death(cluster):
+    cfg, net, clock, services = cluster
+    pump(services, clock)
+    assert services["n1"].is_acting_master is False
+    net.kill("n0")
+    pump(services, clock, waves=8, dt=0.3)
+    services["n1"].monitor_once()          # standby notices ping silence
+    assert "n0" not in services["n1"].members.alive_hosts()
+    assert services["n1"].is_acting_master
+    # standby's heartbeats now drive the cluster; others learn n0 is gone
+    pump(services, clock, waves=2)
+    for h in ("n2", "n3", "n4"):
+        assert "n0" not in services[h].members.alive_hosts(), h
+        assert services[h].acting_master() == "n1", h
+    # and the new master keeps detecting failures
+    net.kill("n4")
+    pump(services, clock, waves=8, dt=0.3)
+    services["n1"].monitor_once()
+    assert "n4" not in services["n1"].members.alive_hosts()
+
+
+def test_non_master_does_not_ping(cluster):
+    cfg, net, clock, services = cluster
+    pump(services, clock)
+    sent = []
+    t = services["n2"].transport
+    orig = t.datagram
+    t.datagram = lambda *a, **k: sent.append(a) or orig(*a, **k)
+    services["n2"].ping_once()
+    assert sent == []

@@ -1,0 +1,64 @@
+"""Real-socket transport tests (SURVEY.md C3): framed TCP call/response,
+UDP datagrams, unreachable-peer errors."""
+import threading
+
+import pytest
+
+from idunno_tpu.comm.message import Message
+from idunno_tpu.comm.net import NetTransport
+from idunno_tpu.comm.transport import TransportError
+from idunno_tpu.utils.types import MessageType
+
+_base = [23800]
+
+
+@pytest.fixture
+def pair():
+    base = _base[0]
+    _base[0] += 100          # fresh ports per test — no TIME_WAIT races
+
+    def addr_of(host):
+        i = int(host[1:])
+        return ("127.0.0.1", base + 10 * i, base + 10 * i + 1)
+
+    ta = NetTransport("h0", addr_of)
+    tb = NetTransport("h1", addr_of)
+    yield ta, tb
+    ta.close()
+    tb.close()
+
+
+def test_tcp_call_roundtrip_with_blob(pair):
+    ta, tb = pair
+    got = {}
+
+    def handler(svc, msg):
+        got["msg"] = msg
+        return Message(MessageType.ACK, "h1", {"ok": True}, blob=b"Y" * 10000)
+
+    tb.serve("store", handler)
+    out = ta.call("h1", "store",
+                  Message(MessageType.PUT, "h0", {"name": "f"},
+                          blob=b"X" * 100000))
+    assert got["msg"].payload == {"name": "f"}
+    assert got["msg"].blob == b"X" * 100000
+    assert out.type is MessageType.ACK and out.blob == b"Y" * 10000
+
+
+def test_udp_datagram_delivery(pair):
+    ta, tb = pair
+    seen = threading.Event()
+    tb.serve("membership", lambda svc, m: seen.set())
+    ta.datagram("h1", "membership", Message(MessageType.PING, "h0"))
+    assert seen.wait(timeout=2.0)
+
+
+def test_unreachable_raises(pair):
+    ta, _ = pair
+    with pytest.raises(TransportError):
+        ta.call("h7", "store", Message(MessageType.GET, "h0"), timeout=0.5)
+
+
+def test_call_without_handler_returns_none(pair):
+    ta, tb = pair
+    assert ta.call("h1", "nosuch", Message(MessageType.GET, "h0")) is None

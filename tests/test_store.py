@@ -1,0 +1,170 @@
+"""Replicated versioned file store tests (SURVEY.md C4)."""
+import pytest
+
+from idunno_tpu.comm.inproc import InProcNetwork
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.service import MembershipService
+from idunno_tpu.store.sdfs import VERSION_DELIM, FileStoreService, StoreError
+
+from tests.test_membership import FakeClock, pump
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    cfg = ClusterConfig(hosts=tuple(f"n{i}" for i in range(5)),
+                        coordinator="n0", standby_coordinator="n1",
+                        introducer="n0", replication_factor=3)
+    net = InProcNetwork()
+    clock = FakeClock()
+    members, stores = {}, {}
+    for h in cfg.hosts:
+        t = net.transport(h)
+        members[h] = MembershipService(h, cfg, t, clock=clock)
+        stores[h] = FileStoreService(h, cfg, t, members[h],
+                                     str(tmp_path / h))
+    for h in cfg.hosts:
+        members[h].join()
+        clock.advance(0.01)
+    pump(members, clock)
+    return cfg, net, clock, members, stores
+
+
+def test_put_get_roundtrip_and_versioning(cluster, tmp_path):
+    cfg, net, clock, members, stores = cluster
+    src = tmp_path / "local.bin"
+    src.write_bytes(b"hello v1")
+    v1 = stores["n3"].put(str(src), "data.bin")
+    assert v1 == 1
+    src.write_bytes(b"hello v2")
+    v2 = stores["n2"].put(str(src), "data.bin")
+    assert v2 == 2
+    dst = tmp_path / "out.bin"
+    got_v = stores["n4"].get("data.bin", str(dst))
+    assert got_v == 2
+    assert dst.read_bytes() == b"hello v2"
+
+
+def test_replication_and_ls(cluster):
+    cfg, net, clock, members, stores = cluster
+    stores["n2"].put_bytes("f.txt", b"payload")
+    hosts = stores["n3"].ls("f.txt")
+    assert len(hosts) >= cfg.replication_factor
+    # every listed holder really has it on disk
+    for h in hosts:
+        assert "f.txt" in stores[h].local_files(), h
+    # the acting master always keeps a copy (`:355-357`)
+    assert "n0" in hosts
+
+
+def test_get_versions_merged_with_delimiters(cluster, tmp_path):
+    cfg, net, clock, members, stores = cluster
+    for i in (1, 2, 3):
+        stores["n2"].put_bytes("v.txt", b"content%d" % i)
+    out = tmp_path / "versions.txt"
+    included = stores["n4"].get_versions("v.txt", 2, str(out))
+    assert included == [3, 2]
+    data = out.read_bytes()
+    assert (VERSION_DELIM % 3) in data and (VERSION_DELIM % 2) in data
+    assert (VERSION_DELIM % 1) not in data
+    assert b"content3" in data and b"content2" in data
+
+
+def test_delete_removes_everywhere(cluster):
+    cfg, net, clock, members, stores = cluster
+    stores["n2"].put_bytes("gone.txt", b"x")
+    holders = stores["n2"].ls("gone.txt")
+    stores["n3"].delete("gone.txt")
+    for h in holders:
+        assert "gone.txt" not in stores[h].local_files(), h
+    with pytest.raises(StoreError):
+        stores["n2"].get_bytes("gone.txt")
+
+
+def test_get_missing_file_errors(cluster):
+    cfg, net, clock, members, stores = cluster
+    with pytest.raises(StoreError):
+        stores["n2"].get_bytes("never-put")
+
+
+def test_rereplication_after_holder_death(cluster):
+    cfg, net, clock, members, stores = cluster
+    stores["n2"].put_bytes("precious.txt", b"keep me")
+    holders = set(stores["n2"].ls("precious.txt"))
+    victim = next(h for h in holders if h not in ("n0", "n1"))
+    observer = next(h for h in cfg.hosts if h != victim)
+    net.kill(victim)
+    pump(members, clock, waves=8, dt=0.3)
+    members["n0"].monitor_once()        # detects death, triggers re-replication
+    new_holders = set(stores[observer].ls("precious.txt"))
+    assert victim not in new_holders
+    alive_holders = {h for h in new_holders
+                     if members["n0"].members.is_alive(h)}
+    assert len(alive_holders) >= cfg.replication_factor
+    blob, v = stores[observer].get_bytes("precious.txt")
+    assert blob == b"keep me" and v == 1
+
+
+def test_master_failover_preserves_files(cluster):
+    cfg, net, clock, members, stores = cluster
+    stores["n2"].put_bytes("survivor.txt", b"before failover")
+    net.kill("n0")
+    pump(members, clock, waves=8, dt=0.3)
+    members["n1"].monitor_once()        # standby notices, takes over
+    assert members["n1"].is_acting_master
+    pump(members, clock, waves=2)
+    # new master rebuilt metadata from inventories; reads still work
+    blob, v = stores["n3"].get_bytes("survivor.txt")
+    assert blob == b"before failover" and v == 1
+    # and writes go to the new master
+    v2 = stores["n4"].put_bytes("survivor.txt", b"after failover")
+    assert v2 == 2
+
+
+def test_sanitized_name_survives_failover(cluster):
+    # names needing sanitisation must still resolve after metadata rebuild
+    cfg, net, clock, members, stores = cluster
+    stores["n2"].put_bytes("models/resnet.ckpt", b"ckpt-bytes")
+    net.kill("n0")
+    pump(members, clock, waves=8, dt=0.3)
+    members["n1"].monitor_once()
+    pump(members, clock, waves=2)
+    blob, v = stores["n3"].get_bytes("models/resnet.ckpt")
+    assert blob == b"ckpt-bytes" and v == 1
+
+
+def test_delete_not_resurrected_by_partitioned_holder(cluster):
+    cfg, net, clock, members, stores = cluster
+    stores["n2"].put_bytes("zombie.txt", b"braaains")
+    holders = stores["n2"].ls("zombie.txt")
+    victim = next(h for h in holders if h not in ("n0", "n1"))
+    client = next(h for h in cfg.hosts if h not in (victim, "n0"))
+    # partition the holder from the master during the delete
+    net.partition("n0", victim)
+    stores[client].delete("zombie.txt")
+    net.heal("n0", victim)
+    # coordinator dies; standby rebuilds metadata from inventories —
+    # the stale copy on `victim` must NOT resurrect the file
+    net.kill("n0")
+    pump(members, clock, waves=8, dt=0.3)
+    members["n1"].monitor_once()
+    pump(members, clock, waves=2)
+    with pytest.raises(StoreError):
+        stores["n3"].get_bytes("zombie.txt")
+    # and re-put after delete gets a version beyond the tombstone
+    v = stores["n3"].put_bytes("zombie.txt", b"fresh")
+    assert v >= 2
+
+
+def test_simultaneous_master_and_member_death_detected(cluster):
+    # a host that dies in the same window as the coordinator must still be
+    # detected by the standby (never-heard silence clock)
+    cfg, net, clock, members, stores = cluster
+    net.kill("n0")
+    net.kill("n3")
+    pump(members, clock, waves=8, dt=0.3)
+    members["n1"].monitor_once()        # standby takes over
+    assert members["n1"].is_acting_master
+    members["n1"].monitor_once()        # starts silence clocks
+    pump(members, clock, waves=8, dt=0.3)
+    members["n1"].monitor_once()
+    assert "n3" not in members["n1"].members.alive_hosts()
