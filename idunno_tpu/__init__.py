@@ -16,8 +16,7 @@ live stats, and an interactive operations shell — built TPU-first:
   tests, UDP/TCP over DCN between TPU hosts), replacing the reference's
   `"<SEPARATOR>"` string frames (`mp4_machinelearning.py:54`).
 
-Package layout (SURVEY.md §7; layers land bottom-up — a module listed here
-but not yet present is simply not built yet):
+Package layout (SURVEY.md §7):
     config      — cluster/runtime configuration (no hardcoded IPs)
     utils       — enums, hash ring, logging taxonomy
     comm        — transports + typed control-plane messages + device mesh

@@ -1,0 +1,1 @@
+from idunno_tpu.cli.shell import Shell  # noqa: F401

@@ -1,0 +1,281 @@
+"""Interactive operations shell (SURVEY.md C12).
+
+The full reference command surface (`README.md:31-50`,
+`shell` `mp4_machinelearning.py:1111-1229`):
+
+  1  list_mem                      membership list
+  2  list_self                     this node's id
+  3  join                          join the cluster
+  4  leave                         voluntary leave
+  5  list_master                   acting master + standby
+  6  grep <pattern>                distributed log grep (C14)
+  7  put <local> <sdfs>            upload to the file store
+  8  get <sdfs> <local>            fetch latest version
+  9  delete <sdfs>                 delete from the store
+  10 ls <sdfs>                     hosts storing a file
+  11 store                         files stored on this host
+  12 get-versions <sdfs> <k> <local>  last k versions, delimited
+  13 inference <start> <end> <model>  submit a query range
+  c1 query rates + finished counts per model
+  c2 processing-time stats of a query per model
+  c4 dump all results to result.txt
+  cvm  per-host running tasks
+  cq   per-query task assignment map
+
+c1/c2 report *measured* numbers — the reference fabricates AlexNet stats as
+0.95 × ResNet's and invents quartiles (`preprocess_c1`/`c2`, `:1232-1267`).
+"""
+from __future__ import annotations
+
+import json
+import shlex
+import threading
+from collections.abc import Callable, Iterable
+
+from idunno_tpu.serve.node import Node
+
+HELP = """\
+  1  list_mem                      membership list
+  2  list_self                     this node's id
+  3  join                          join the cluster
+  4  leave                         voluntary leave
+  5  list_master                   acting master + standby
+  6  grep <pattern>                distributed log grep
+  7  put <local> <sdfs>            upload to the file store
+  8  get <sdfs> <local>            fetch latest version
+  9  delete <sdfs>                 delete from the store
+  10 ls <sdfs>                     hosts storing a file
+  11 store                         files stored on this host
+  12 get-versions <sdfs> <k> <local>  last k versions, delimited
+  13 inference <start> <end> <model>  submit a query range
+  c1 query rates + finished counts per model
+  c2 processing-time stats of a query per model
+  c4 [path] dump all results to result.txt
+  cvm  per-host running tasks
+  cq   per-query task assignment map"""
+
+
+class Shell:
+    def __init__(self, node: Node, out: Callable[[str], None] = print,
+                 async_inference: bool = True) -> None:
+        self.node = node
+        self.out = out
+        self.async_inference = async_inference
+        self._commands = {
+            "help": self.cmd_help, "1": self.cmd_list_mem,
+            "list_mem": self.cmd_list_mem,
+            "2": self.cmd_list_self, "list_self": self.cmd_list_self,
+            "3": self.cmd_join, "join": self.cmd_join,
+            "4": self.cmd_leave, "leave": self.cmd_leave,
+            "5": self.cmd_list_master, "list_master": self.cmd_list_master,
+            "6": self.cmd_grep, "grep": self.cmd_grep,
+            "7": self.cmd_put, "put": self.cmd_put,
+            "8": self.cmd_get, "get": self.cmd_get,
+            "9": self.cmd_delete, "delete": self.cmd_delete,
+            "10": self.cmd_ls, "ls": self.cmd_ls,
+            "11": self.cmd_store, "store": self.cmd_store,
+            "12": self.cmd_get_versions, "get-versions": self.cmd_get_versions,
+            "13": self.cmd_inference, "inference": self.cmd_inference,
+            "c1": self.cmd_c1, "c2": self.cmd_c2, "c4": self.cmd_c4,
+            "cvm": self.cmd_cvm, "cq": self.cmd_cq,
+        }
+
+    # -- driver -----------------------------------------------------------
+
+    def dispatch(self, line: str) -> str | None:
+        """Run one command line; returns the output text (also emitted)."""
+        parts = shlex.split(line.strip())
+        if not parts:
+            return None
+        cmd, args = parts[0], parts[1:]
+        fn = self._commands.get(cmd)
+        if fn is None:
+            text = f"unknown command: {cmd!r} (try `help`)"
+        else:
+            try:
+                text = fn(args)
+            except Exception as e:          # shell must survive bad input
+                text = f"error: {e}"
+        if text:
+            self.out(text)
+        return text
+
+    def run(self, lines: Iterable[str] | None = None) -> None:
+        if lines is None:
+            self.out("idunno_tpu shell — `help` for commands")
+            while True:
+                try:
+                    line = input(f"{self.node.host}> ")
+                except (EOFError, KeyboardInterrupt):
+                    return
+                if line.strip() in ("exit", "quit"):
+                    return
+                self.dispatch(line)
+        else:
+            for line in lines:
+                self.dispatch(line)
+
+    # -- membership -------------------------------------------------------
+
+    def cmd_help(self, args: list[str]) -> str:
+        return HELP
+
+    def cmd_list_mem(self, args: list[str]) -> str:
+        rows = [f"{e.host:20s} {e.status.value:8s} ts={e.ts:.3f}"
+                for e in self.node.membership.members.entries()]
+        return "\n".join(rows) or "(empty membership list)"
+
+    def cmd_list_self(self, args: list[str]) -> str:
+        me = self.node.membership.members.get(self.node.host)
+        status = me.status.value if me else "NOT JOINED"
+        return f"{self.node.host} [{status}]"
+
+    def cmd_join(self, args: list[str]) -> str:
+        self.node.membership.join()
+        return f"{self.node.host} joined"
+
+    def cmd_leave(self, args: list[str]) -> str:
+        self.node.leave()
+        return f"{self.node.host} left (voluntary)"
+
+    def cmd_list_master(self, args: list[str]) -> str:
+        return (f"acting master: {self.node.membership.acting_master()}\n"
+                f"standby:       {self.node.config.standby_coordinator}")
+
+    # -- grep -------------------------------------------------------------
+
+    def cmd_grep(self, args: list[str]) -> str:
+        if not args:
+            return "usage: grep <pattern>"
+        results = self.node.grep.query(" ".join(args))
+        out = []
+        for h in sorted(results):
+            r = results[h]
+            if "error" in r:
+                out.append(f"--- {h}: ERROR {r['error']}")
+                continue
+            out.append(f"--- {h}: {r['count']} matching lines"
+                       + (" (truncated)" if r.get("truncated") else ""))
+            out.extend(r["lines"])
+        total = self.node.grep.total_count(results)
+        out.append(f"TOTAL: {total} matching lines")
+        return "\n".join(out)
+
+    # -- file store -------------------------------------------------------
+
+    def cmd_put(self, args: list[str]) -> str:
+        if len(args) != 2:
+            return "usage: put <localfilename> <sdfsfilename>"
+        v = self.node.store.put(args[0], args[1])
+        return f"put {args[1]} -> version {v}"
+
+    def cmd_get(self, args: list[str]) -> str:
+        if len(args) != 2:
+            return "usage: get <sdfsfilename> <localfilename>"
+        v = self.node.store.get(args[0], args[1])
+        return f"got {args[0]} (version {v}) -> {args[1]}"
+
+    def cmd_delete(self, args: list[str]) -> str:
+        if len(args) != 1:
+            return "usage: delete <sdfsfilename>"
+        self.node.store.delete(args[0])
+        return f"deleted {args[0]}"
+
+    def cmd_ls(self, args: list[str]) -> str:
+        if len(args) != 1:
+            return "usage: ls <sdfsfilename>"
+        hosts = self.node.store.ls(args[0])
+        return "\n".join(hosts) or f"{args[0]} not stored anywhere"
+
+    def cmd_store(self, args: list[str]) -> str:
+        files = self.node.store.local_files()
+        rows = [f"{n}  versions={vs}" for n, vs in sorted(files.items())]
+        return "\n".join(rows) or "(nothing stored on this host)"
+
+    def cmd_get_versions(self, args: list[str]) -> str:
+        if len(args) != 3:
+            return "usage: get-versions <sdfsfilename> <num-versions> <localfilename>"
+        versions = self.node.store.get_versions(args[0], int(args[1]), args[2])
+        return f"wrote versions {versions} of {args[0]} -> {args[2]}"
+
+    # -- inference --------------------------------------------------------
+
+    def cmd_inference(self, args: list[str]) -> str:
+        if len(args) != 3:
+            return "usage: inference <start> <end> <model>"
+        start, end, model = int(args[0]), int(args[1]), args[2]
+        if self.async_inference:
+            # the reference runs the paced query pump in a thread (`:1200-1205`)
+            def pump():
+                try:
+                    self.node.inference.inference(model, start, end)
+                except Exception as e:
+                    self.out(f"inference pump {model} [{start}, {end}] "
+                             f"aborted: {e}")
+            threading.Thread(target=pump, daemon=True,
+                             name=f"{self.node.host}-inference-pump").start()
+            return (f"submitted inference {model} [{start}, {end}] "
+                    f"(paced, 1 query / {self.node.config.query_interval_s:g} s)")
+        qnums = self.node.inference.inference(model, start, end, pace_s=0.0)
+        return f"submitted inference {model} [{start}, {end}] queries={qnums}"
+
+    # -- stats ------------------------------------------------------------
+
+    def _models_seen(self) -> list[str]:
+        svc = self.node.inference
+        models = {m for m, _ in svc.scheduler.book.queries()}
+        models.update(svc._qnum)
+        return sorted(models)
+
+    def cmd_c1(self, args: list[str]) -> str:
+        svc = self.node.inference
+        bs = self.node.config.query_batch_size
+        rows = []
+        for m in self._models_seen():
+            rows.append(
+                f"{m}: query_rate={svc.metrics.query_rate(m, bs):.3f}/s "
+                f"image_rate={svc.metrics.image_rate(m):.1f}/s "
+                f"finished_images={svc.metrics.finished_images(m)} "
+                f"finished_queries={svc.metrics.finished_queries(m)}")
+        return "\n".join(rows) or "(no queries yet)"
+
+    def cmd_c2(self, args: list[str]) -> str:
+        svc = self.node.inference
+        rows = []
+        for m in self._models_seen():
+            s = svc.metrics.processing_stats(m)
+            if s is None:
+                rows.append(f"{m}: (no data in window)")
+            else:
+                rows.append(f"{m}: avg={s.avg:.3f}s q1={s.q1:.3f}s "
+                            f"median={s.q2:.3f}s q3={s.q3:.3f}s "
+                            f"stddev={s.stddev:.3f}s n={s.n}")
+        return "\n".join(rows) or "(no queries yet)"
+
+    def cmd_c4(self, args: list[str]) -> str:
+        results = self.node.inference.all_results()
+        path = args[0] if args else "result.txt"
+        with open(path, "w") as f:
+            json.dump(results, f, indent=1)
+        n = sum(len(v) for v in results.values())
+        return f"wrote {n} records across {len(results)} queries -> {path}"
+
+    def cmd_cvm(self, args: list[str]) -> str:
+        book = self.node.inference.scheduler.book
+        rows = []
+        for h in self.node.membership.members.alive_hosts():
+            tasks = [t for t in book.tasks_on_worker(h) if t.state == "w"]
+            desc = ", ".join(f"{t.model}#{t.qnum}[{t.start},{t.end}]"
+                             for t in tasks) or "(idle)"
+            rows.append(f"{h}: {desc}")
+        return "\n".join(rows) or "(no members)"
+
+    def cmd_cq(self, args: list[str]) -> str:
+        book = self.node.inference.scheduler.book
+        rows = []
+        for model, qnum in book.queries():
+            parts = ", ".join(
+                f"({t.worker},{t.start},{t.end},{t.state})"
+                for t in book.tasks_for_query(model, qnum))
+            rows.append(f"{model}#{qnum}: {parts}")
+        return "\n".join(rows) or "(no queries yet)"

@@ -1,0 +1,1 @@
+from idunno_tpu.grep.loggrep import LogGrepService  # noqa: F401

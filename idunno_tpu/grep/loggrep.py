@@ -1,0 +1,98 @@
+"""Distributed log grep (SURVEY.md C14).
+
+The reference's shell command 6 invokes the MP1 grep subsystem —
+``mp1_client.Client(cmd).query()`` fanning out to per-VM
+``mp1_server.server_program()`` log servers — but those modules are missing
+from the repo (`mp4_machinelearning.py:15-16, 1163-1167, 1285`); only the
+interface shape is known. This module provides that capability natively:
+each node serves regex queries over its local log files; a client fans out
+to every alive host and merges per-host matches + counts.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+from idunno_tpu.comm.message import Message
+from idunno_tpu.comm.transport import Transport, TransportError
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.service import MembershipService
+from idunno_tpu.utils.types import MessageType
+
+SERVICE = "grep"
+MAX_LINES = 10_000       # per-host reply cap; counts stay exact
+
+
+class LogGrepService:
+    def __init__(self, host: str, config: ClusterConfig,
+                 transport: Transport, membership: MembershipService,
+                 log_dir: str = ".") -> None:
+        self.host = host
+        self.config = config
+        self.transport = transport
+        self.membership = membership
+        self.log_dir = log_dir
+        transport.serve(SERVICE, self._handle)
+
+    # -- server side ------------------------------------------------------
+
+    def _handle(self, service: str, msg: Message) -> Message | None:
+        if msg.type is not MessageType.GREP:
+            return Message(MessageType.ERROR, self.host,
+                           {"error": "bad grep verb"})
+        try:
+            pattern = re.compile(msg.payload["pattern"])
+        except re.error as e:
+            return Message(MessageType.ERROR, self.host,
+                           {"error": f"bad pattern: {e}"})
+        count, lines = self.grep_local(pattern)
+        return Message(MessageType.ACK, self.host,
+                       {"count": count, "lines": lines[:MAX_LINES],
+                        "truncated": count > MAX_LINES})
+
+    def grep_local(self, pattern: re.Pattern) -> tuple[int, list[str]]:
+        count, lines = 0, []
+        try:
+            log_files = sorted(f for f in os.listdir(self.log_dir)
+                               if f.endswith(".log"))
+        except FileNotFoundError:
+            return 0, []
+        for fn in log_files:
+            try:
+                with open(os.path.join(self.log_dir, fn),
+                          errors="replace") as f:
+                    for line in f:
+                        if pattern.search(line):
+                            count += 1
+                            if len(lines) < MAX_LINES:
+                                lines.append(f"{fn}:{line.rstrip()}")
+            except OSError:
+                continue
+        return count, lines
+
+    # -- client side ------------------------------------------------------
+
+    def query(self, pattern: str) -> dict[str, dict]:
+        """Fan out to every alive host (self included); returns
+        host → {count, lines, truncated} (unreachable hosts → error)."""
+        msg = Message(MessageType.GREP, self.host, {"pattern": pattern})
+        out: dict[str, dict] = {}
+        for h in self.membership.members.alive_hosts():
+            if h == self.host:
+                reply = self._handle(SERVICE, msg)
+            else:
+                try:
+                    reply = self.transport.call(h, SERVICE, msg, timeout=15.0)
+                except TransportError as e:
+                    out[h] = {"error": str(e)}
+                    continue
+            if reply is None or reply.type is MessageType.ERROR:
+                out[h] = {"error": (reply.payload.get("error", "no reply")
+                                    if reply else "no reply")}
+            else:
+                out[h] = dict(reply.payload)
+        return out
+
+    @staticmethod
+    def total_count(results: dict[str, dict]) -> int:
+        return sum(r.get("count", 0) for r in results.values())

@@ -1,0 +1,2 @@
+from idunno_tpu.scheduler.tasks import Task, TaskBook  # noqa: F401
+from idunno_tpu.scheduler.fair import FairScheduler, fair_shares  # noqa: F401

@@ -1,0 +1,128 @@
+"""Task bookkeeping for the coordinator.
+
+The reference keeps two parallel dicts: ``worker_set[(model, qnum)]`` holding
+``(vm, start, end, 'w'/'f', t_start, t_end)`` tuples and the reverse map
+``working_vm_set[vm]`` (`mp4_machinelearning.py:137-144, 529-533`). Here both
+views live behind one thread-safe book with typed tasks, and the whole book
+serializes to/from wire form for standby-coordinator state replication
+(replacing the stringified-dict broadcast, `:971-1011`).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+WORKING = "w"        # reference's 'w' / 'f' task states (`:529-533, 645-652`)
+FINISHED = "f"
+
+
+@dataclass
+class Task:
+    model: str
+    qnum: int
+    worker: str
+    start: int                  # inclusive, reference range convention
+    end: int
+    state: str = WORKING
+    t_assigned: float = 0.0
+    t_finished: float = 0.0
+
+    @property
+    def n_items(self) -> int:
+        return self.end - self.start + 1
+
+    def to_wire(self) -> dict[str, Any]:
+        return {"model": self.model, "qnum": self.qnum, "worker": self.worker,
+                "start": self.start, "end": self.end, "state": self.state,
+                "t_assigned": self.t_assigned, "t_finished": self.t_finished}
+
+    @classmethod
+    def from_wire(cls, d: dict[str, Any]) -> "Task":
+        return cls(model=d["model"], qnum=int(d["qnum"]), worker=d["worker"],
+                   start=int(d["start"]), end=int(d["end"]), state=d["state"],
+                   t_assigned=float(d["t_assigned"]),
+                   t_finished=float(d["t_finished"]))
+
+
+class TaskBook:
+    """All in-flight and finished tasks, indexed by query and by worker."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._by_query: dict[tuple[str, int], list[Task]] = {}
+
+    # -- mutation ---------------------------------------------------------
+
+    def record(self, tasks: list[Task]) -> None:
+        with self._lock:
+            for t in tasks:
+                self._by_query.setdefault((t.model, t.qnum), []).append(t)
+
+    def reassign(self, task: Task, new_worker: str, now: float) -> Task:
+        """Move an in-flight task to another worker (failure/straggler
+        re-dispatch, `:706-760`)."""
+        with self._lock:
+            task.worker = new_worker
+            task.t_assigned = now
+            return task
+
+    def mark_finished(self, model: str, qnum: int, start: int, end: int,
+                      now: float) -> Task | None:
+        """Flip the matching task to finished (`:645-652`); returns it, or
+        None if no matching in-flight task (duplicate/stale result)."""
+        with self._lock:
+            for t in self._by_query.get((model, qnum), []):
+                if t.start == start and t.end == end and t.state == WORKING:
+                    t.state = FINISHED
+                    t.t_finished = now
+                    return t
+        return None
+
+    # -- queries ----------------------------------------------------------
+
+    def tasks_for_query(self, model: str, qnum: int) -> list[Task]:
+        with self._lock:
+            return list(self._by_query.get((model, qnum), []))
+
+    def query_done(self, model: str, qnum: int) -> bool:
+        with self._lock:
+            tasks = self._by_query.get((model, qnum), [])
+            return bool(tasks) and all(t.state == FINISHED for t in tasks)
+
+    def tasks_on_worker(self, worker: str) -> list[Task]:
+        """The reference's ``working_vm_set`` view (`:140-144`)."""
+        with self._lock:
+            return [t for ts in self._by_query.values() for t in ts
+                    if t.worker == worker]
+
+    def in_flight(self, worker: str | None = None) -> list[Task]:
+        with self._lock:
+            return [t for ts in self._by_query.values() for t in ts
+                    if t.state == WORKING
+                    and (worker is None or t.worker == worker)]
+
+    def stragglers(self, now: float, timeout: float) -> list[Task]:
+        """In-flight tasks assigned more than ``timeout`` ago — with the
+        comparison the right way around (the reference computes
+        ``start_time - time_now`` which is never positive, `:822`)."""
+        with self._lock:
+            return [t for ts in self._by_query.values() for t in ts
+                    if t.state == WORKING and now - t.t_assigned > timeout]
+
+    def queries(self) -> list[tuple[str, int]]:
+        with self._lock:
+            return sorted(self._by_query)
+
+    # -- failover serialization ------------------------------------------
+
+    def to_wire(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return [t.to_wire() for ts in self._by_query.values() for t in ts]
+
+    def load_wire(self, tasks: list[dict[str, Any]]) -> None:
+        with self._lock:
+            self._by_query.clear()
+            for d in tasks:
+                t = Task.from_wire(d)
+                self._by_query.setdefault((t.model, t.qnum), []).append(t)

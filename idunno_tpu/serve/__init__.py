@@ -1,0 +1,2 @@
+from idunno_tpu.serve.metrics import MetricsTracker  # noqa: F401
+from idunno_tpu.serve.inference_service import InferenceService  # noqa: F401

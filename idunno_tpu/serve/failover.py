@@ -1,0 +1,135 @@
+"""Standby-coordinator failover (SURVEY.md C10).
+
+Reference: the master streams its scheduler state, stringified, to the other
+nine VMs once a second (`send_metadata`, `mp4_machinelearning.py:971-987`);
+every host runs `receive_metadata` (`:989-1011`) — which assigns raw strings
+over dict-typed fields, corrupting the very state it exists to preserve
+(SURVEY.md §7 bugs-not-to-replicate). Clients fail over primary→standby
+(`:956-963`).
+
+Here the acting master replicates a *versioned, typed* snapshot (task book,
+per-model query counters, metrics windows, accumulated results) to the
+standby each period. When the standby observes the coordinator's death (via
+its own ping-silence monitor) it adopts the newest snapshot, reassigns every
+in-flight task stranded on dead hosts, and re-dispatches — resuming
+unfinished query ranges instead of losing them. Workers already deliver
+results master-then-standby, so results in flight during the switch land on
+the new master.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from idunno_tpu.comm.message import Message
+from idunno_tpu.comm.transport import Transport, TransportError
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.service import MembershipService
+from idunno_tpu.serve.inference_service import InferenceService
+from idunno_tpu.utils.types import MemberStatus, MessageType
+
+SERVICE = "metadata"
+
+
+class FailoverManager:
+    def __init__(self, host: str, config: ClusterConfig,
+                 transport: Transport, membership: MembershipService,
+                 service: InferenceService) -> None:
+        self.host = host
+        self.config = config
+        self.transport = transport
+        self.membership = membership
+        self.service = service
+        self._lock = threading.RLock()
+        self._seq = 0
+        self._received: dict[str, Any] | None = None
+        self._received_seq = -1
+        self._adopted = False
+        transport.serve(SERVICE, self._handle)
+        membership.on_change(self._on_member_change)
+
+    # -- master side: periodic replication --------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        svc = self.service
+        with svc._results_lock:
+            results = {f"{m}\x00{q}": [list(r) for r in v]
+                       for (m, q), v in svc._results.items()}
+        self._seq += 1
+        return {"seq": self._seq,
+                "tasks": svc.scheduler.book.to_wire(),
+                "qnum": dict(svc._qnum),
+                "metrics": svc.metrics.to_wire(),
+                "results": results}
+
+    def replicate_once(self) -> bool:
+        """Acting master → standby; returns True if delivered."""
+        if not self.membership.is_acting_master:
+            return False
+        standby = self.config.standby_coordinator
+        if standby == self.host:
+            return False
+        msg = Message(MessageType.METADATA, self.host, self.snapshot())
+        try:
+            return self.transport.call(standby, SERVICE, msg,
+                                       timeout=10.0) is not None
+        except TransportError:
+            return False
+
+    # -- standby side ------------------------------------------------------
+
+    def _handle(self, service: str, msg: Message) -> Message | None:
+        if msg.type is not MessageType.METADATA:
+            return None
+        with self._lock:
+            seq = int(msg.payload.get("seq", 0))
+            if seq > self._received_seq:
+                self._received = msg.payload
+                self._received_seq = seq
+                self._adopted = False
+        return Message(MessageType.ACK, self.host)
+
+    def _on_member_change(self, host: str, old: MemberStatus | None,
+                          new: MemberStatus) -> None:
+        if (new is MemberStatus.LEAVE
+                and host == self.config.coordinator
+                and self.membership.is_acting_master):
+            self.adopt()
+
+    def adopt(self) -> None:
+        """Become the coordinator: load the newest replicated snapshot and
+        resume every unfinished range."""
+        with self._lock:
+            if self._adopted or self._received is None:
+                return
+            snap = self._received
+            self._adopted = True
+        svc = self.service
+        svc.scheduler.book.load_wire(snap["tasks"])
+        svc._qnum.update({m: max(int(q), svc._qnum.get(m, 0))
+                          for m, q in snap["qnum"].items()})
+        svc.metrics.load_wire(snap["metrics"])
+        with svc._results_lock:
+            for key, recs in snap["results"].items():
+                m, q = key.split("\x00")
+                existing = svc._results.setdefault((m, int(q)), [])
+                seen = {tuple(r) for r in existing}
+                existing.extend(tuple(r) for r in recs
+                                if tuple(r) not in seen)
+        self.resume_in_flight()
+
+    def resume_in_flight(self) -> None:
+        """Reassign in-flight tasks stranded on dead hosts (including the
+        dead coordinator) and re-dispatch everything still marked working —
+        duplicates are rejected by the task book."""
+        svc = self.service
+        alive = set(self.membership.members.alive_hosts())
+        for task in svc.scheduler.book.in_flight():
+            if task.worker not in alive:
+                candidates = sorted(alive - {task.worker})
+                if not candidates:
+                    continue
+                svc.scheduler.book.reassign(
+                    task, svc.scheduler.rng.choice(candidates),
+                    svc.clock())
+            svc._dispatch(task, svc.dataset_root)

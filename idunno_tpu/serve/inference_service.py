@@ -1,0 +1,341 @@
+"""The distributed inference serving path (SURVEY.md C6, C7, C9, C11).
+
+Call path, re-architected from the reference's §3.2 stack:
+
+  client ``submit_query`` ──INFERENCE──► acting master
+      master: FairScheduler.assign → per-task ──JOB──► workers
+      worker: queue → engine (jit batched forward on its chips)
+              ──RESULT──► acting master (NOT a 10-way TCP broadcast,
+                          `mp4_machinelearning.py:603-613`)
+      master: TaskBook.mark_finished, metrics, result accumulation
+
+Failure handling on the master: membership LEAVE → in-flight tasks of the
+dead worker reassigned to ring successors and re-dispatched
+(`transfer_failed_inference_work`, `:706-760`); straggler monitor re-sends
+tasks stuck past the timeout with the comparison fixed (`:809-830`, bug
+`:822`) and actually enabled (the reference ships it switched off, `:1277`).
+
+Workers execute jobs from a queue: the transport handler only enqueues, so
+dispatch never blocks on inference. The runtime drives ``process_jobs_once``
+from a thread; tests call it directly for determinism.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections.abc import Callable
+from dataclasses import dataclass, field
+from typing import Any, Protocol
+
+from idunno_tpu.comm.message import Message
+from idunno_tpu.comm.transport import Transport, TransportError
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.service import MembershipService
+from idunno_tpu.scheduler.fair import FairScheduler
+from idunno_tpu.scheduler.tasks import Task
+from idunno_tpu.serve.metrics import MetricsTracker
+from idunno_tpu.utils.types import MemberStatus, MessageType
+
+SERVICE = "inference"
+RESULT_SERVICE = "result"
+
+
+class Engine(Protocol):
+    """What a worker needs from its model engine (the real
+    ``idunno_tpu.engine.InferenceEngine`` or a test fake)."""
+
+    def infer(self, name: str, start: int, end: int,
+              dataset_root: str | None = None) -> Any: ...
+
+
+@dataclass
+class Job:
+    model: str
+    qnum: int
+    start: int
+    end: int
+    dataset: str | None
+
+
+class InferenceServiceError(Exception):
+    pass
+
+
+class InferenceService:
+    def __init__(self, host: str, config: ClusterConfig,
+                 transport: Transport, membership: MembershipService,
+                 engine: Engine, metrics: MetricsTracker | None = None,
+                 scheduler: FairScheduler | None = None,
+                 dataset_root: str | None = None,
+                 clock: Callable[[], float] = time.time) -> None:
+        self.host = host
+        self.config = config
+        self.transport = transport
+        self.membership = membership
+        self.engine = engine
+        self.clock = clock
+        self.metrics = metrics or MetricsTracker(clock=clock)
+        self.scheduler = scheduler or FairScheduler(config, clock=clock)
+        self.dataset_root = dataset_root
+
+        # coordinator state
+        self._qnum: dict[str, int] = {}          # per-model counter (`:965-966`)
+        self._results: dict[tuple[str, int], list[tuple[str, str, float]]] = {}
+        self._results_lock = threading.RLock()
+
+        # worker state
+        self._jobs: list[Job] = []
+        self._pending_results: list[Message] = []   # computed, undelivered
+        self._jobs_lock = threading.RLock()
+        self._jobs_available = threading.Event()
+
+        transport.serve(SERVICE, self._handle_inference)
+        transport.serve(RESULT_SERVICE, self._handle_result)
+        membership.on_change(self._on_member_change)
+
+    # ------------------------------------------------------------------ #
+    # client API
+    # ------------------------------------------------------------------ #
+
+    def _master_call(self, msg: Message) -> Message:
+        """Primary→standby failover (`send_inference_command`, `:956-963`)."""
+        targets = [self.membership.acting_master()]
+        if self.config.standby_coordinator not in targets:
+            targets.append(self.config.standby_coordinator)
+        last: Exception | None = None
+        for t in targets:
+            if t == self.host:
+                out = self._handle_inference(SERVICE, msg)
+            else:
+                try:
+                    out = self.transport.call(t, SERVICE, msg, timeout=30.0)
+                except TransportError as e:
+                    last = e
+                    continue
+            if out is not None:
+                if out.type is MessageType.ERROR:
+                    raise InferenceServiceError(
+                        out.payload.get("error", "inference error"))
+                return out
+        raise InferenceServiceError(f"no reachable coordinator: {last}")
+
+    def submit_query(self, model: str, start: int, end: int) -> int:
+        """Submit one query range; returns the assigned query number."""
+        out = self._master_call(Message(
+            MessageType.INFERENCE, self.host,
+            {"model": model, "start": start, "end": end,
+             "dataset": self.dataset_root}))
+        return int(out.payload["qnum"])
+
+    def inference(self, model: str, start: int, end: int,
+                  pace_s: float | None = None,
+                  sleep: Callable[[float], None] = time.sleep) -> list[int]:
+        """The `inference <start> <end> <model>` verb: chunk the range into
+        standard-batch queries, one submission per pacing interval
+        (`Server.inference`, `:1104-1109`)."""
+        bs = self.config.query_batch_size
+        pace = self.config.query_interval_s if pace_s is None else pace_s
+        qnums = []
+        cursor = start
+        while cursor <= end:
+            chunk_end = min(cursor + bs - 1, end)
+            qnums.append(self.submit_query(model, cursor, chunk_end))
+            cursor = chunk_end + 1
+            if cursor <= end and pace > 0:
+                sleep(pace)
+        return qnums
+
+    def results(self, model: str, qnum: int) -> list[tuple[str, str, float]]:
+        with self._results_lock:
+            return list(self._results.get((model, qnum), []))
+
+    def all_results(self) -> dict[str, list[tuple[str, str, float]]]:
+        """c4 view: "model qnum" → records (`:1208-1211`)."""
+        with self._results_lock:
+            return {f"{m} {q}": list(v)
+                    for (m, q), v in sorted(self._results.items())}
+
+    def query_done(self, model: str, qnum: int) -> bool:
+        return self.scheduler.book.query_done(model, qnum)
+
+    # ------------------------------------------------------------------ #
+    # coordinator side
+    # ------------------------------------------------------------------ #
+
+    def _handle_inference(self, service: str, msg: Message) -> Message | None:
+        if msg.type is MessageType.INFERENCE:      # client submission
+            if not self.membership.is_acting_master:
+                return Message(MessageType.ERROR, self.host,
+                               {"error": f"{self.host} not acting master"})
+            p = msg.payload
+            return self._master_submit(p["model"], int(p["start"]),
+                                       int(p["end"]), p.get("dataset"))
+        if msg.type is MessageType.JOB:            # dispatched task
+            p = msg.payload
+            with self._jobs_lock:
+                self._jobs.append(Job(model=p["model"], qnum=int(p["qnum"]),
+                                      start=int(p["start"]),
+                                      end=int(p["end"]),
+                                      dataset=p.get("dataset")))
+                self._jobs_available.set()
+            return Message(MessageType.ACK, self.host)
+        return Message(MessageType.ERROR, self.host,
+                       {"error": f"bad inference verb {msg.type}"})
+
+    def _master_submit(self, model: str, start: int, end: int,
+                       dataset: str | None) -> Message:
+        self.scheduler.avg_query_time = {
+            m: self.metrics.avg_query_time(m)
+            for m in set(self._qnum) | {model}}
+        qnum = self._qnum.get(model, 0) + 1
+        self._qnum[model] = qnum
+        workers = self._eligible_workers()
+        if not workers:
+            return Message(MessageType.ERROR, self.host,
+                           {"error": "no alive workers"})
+        tasks = self.scheduler.assign(model, qnum, start, end, workers)
+        for t in tasks:
+            self._dispatch(t, dataset)
+        return Message(MessageType.ACK, self.host, {"qnum": qnum})
+
+    def _eligible_workers(self) -> list[str]:
+        """All alive hosts serve as workers, the coordinator included
+        (`send_inference_work` local-execute branch, `:764-791`)."""
+        return self.membership.members.alive_hosts()
+
+    def _dispatch(self, task: Task, dataset: str | None) -> None:
+        msg = Message(MessageType.JOB, self.host,
+                      {"model": task.model, "qnum": task.qnum,
+                       "start": task.start, "end": task.end,
+                       "dataset": dataset})
+        # On send failure, reassign on the spot rather than waiting for the
+        # failure detector — with a cumulative exclusion set so several
+        # simultaneously-dead workers can't ping-pong the dispatch forever.
+        tried: set[str] = set()
+        while True:
+            if task.worker == self.host:
+                self._handle_inference(SERVICE, msg)
+                return
+            try:
+                self.transport.call(task.worker, SERVICE, msg, timeout=30.0)
+                return
+            except TransportError:
+                tried.add(task.worker)
+                alive = [h for h in self._eligible_workers()
+                         if h not in tried]
+                if not alive:
+                    return    # straggler monitor will retry later
+                task = self.scheduler.book.reassign(
+                    task, self.scheduler.rng.choice(alive), self.clock())
+
+    def _handle_result(self, service: str, msg: Message) -> Message | None:
+        """Acting master accumulates results + metrics (`:623-704`)."""
+        p = msg.payload
+        model, qnum = p["model"], int(p["qnum"])
+        start, end = int(p["start"]), int(p["end"])
+        task = self.scheduler.book.mark_finished(model, qnum, start, end,
+                                                 self.clock())
+        if task is None:
+            if self.membership.is_acting_master:
+                # genuinely stale/duplicate — accept and drop
+                return Message(MessageType.ACK, self.host,
+                               {"duplicate": True})
+            # unknown task on a NON-master (e.g. the standby before
+            # adoption): refuse, so the worker keeps the result queued
+            # instead of believing it was delivered.
+            return Message(MessageType.ERROR, self.host,
+                           {"error": f"{self.host} has no record of task"})
+        records = [tuple(r) for r in p["records"]]
+        with self._results_lock:
+            self._results.setdefault((model, qnum), []).extend(records)
+        self.metrics.record_task(model, task.n_items,
+                                 float(p["elapsed_s"]),
+                                 self.config.query_batch_size)
+        if self.scheduler.book.query_done(model, qnum):
+            self.metrics.record_query_done(model)
+        return Message(MessageType.ACK, self.host)
+
+    # -- failure / straggler handling (master) ----------------------------
+
+    def _on_member_change(self, host: str, old: MemberStatus | None,
+                          new: MemberStatus) -> None:
+        if new is not MemberStatus.LEAVE or not self.membership.is_acting_master:
+            return
+        alive = self._eligible_workers()
+        for task in self.scheduler.reassign_failed(host, alive):
+            self._dispatch(task, self.dataset_root)
+
+    def monitor_stragglers_once(self) -> int:
+        """Re-dispatch tasks stuck past the straggler timeout; returns how
+        many moved."""
+        if not self.membership.is_acting_master:
+            return 0
+        alive = self._eligible_workers()
+        moved = 0
+        for task in self.scheduler.stragglers():
+            self._dispatch(self.scheduler.redispatch_straggler(task, alive),
+                           self.dataset_root)
+            moved += 1
+        return moved
+
+    # ------------------------------------------------------------------ #
+    # worker side
+    # ------------------------------------------------------------------ #
+
+    def pending_jobs(self) -> int:
+        with self._jobs_lock:
+            return len(self._jobs)
+
+    def process_jobs_once(self) -> int:
+        """Retry undelivered results, then execute every queued job on the
+        local engine; returns the number of jobs executed."""
+        with self._jobs_lock:
+            retries, self._pending_results = self._pending_results, []
+            jobs, self._jobs = self._jobs, []
+            self._jobs_available.clear()
+        for msg in retries:          # re-send only, never re-compute
+            self._deliver_result(msg)
+        for job in jobs:
+            self._execute(job)
+        return len(jobs)
+
+    def wait_for_jobs(self, timeout: float) -> bool:
+        return self._jobs_available.wait(timeout)
+
+    def _execute(self, job: Job) -> None:
+        t0 = self.clock()
+        res = self.engine.infer(job.model, job.start, job.end,
+                                dataset_root=job.dataset or self.dataset_root)
+        elapsed = getattr(res, "elapsed_s", None)
+        if elapsed is None:
+            elapsed = self.clock() - t0
+        records = getattr(res, "records", res)
+        msg = Message(MessageType.RESULT, self.host,
+                      {"model": job.model, "qnum": job.qnum,
+                       "start": job.start, "end": job.end,
+                       "elapsed_s": elapsed,
+                       "records": [list(r) for r in records]})
+        self._deliver_result(msg)
+
+    def _deliver_result(self, msg: Message) -> None:
+        """Send a computed RESULT to the acting master (standby fallback);
+        queue the *message* for retry on failure — the inference itself is
+        never re-executed."""
+        master = self.membership.acting_master()
+        targets = [master]
+        if self.config.standby_coordinator not in targets:
+            targets.append(self.config.standby_coordinator)
+        for target in targets:
+            if target == self.host:
+                out = self._handle_result(RESULT_SERVICE, msg)
+            else:
+                try:
+                    out = self.transport.call(target, RESULT_SERVICE, msg,
+                                              timeout=30.0)
+                except TransportError:
+                    continue
+            if out is not None and out.type is MessageType.ACK:
+                return
+        with self._jobs_lock:
+            self._pending_results.append(msg)
+            self._jobs_available.set()

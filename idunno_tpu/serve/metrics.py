@@ -1,0 +1,148 @@
+"""Query-rate and processing-time metrics (SURVEY.md C8).
+
+Reference semantics kept, bugs not (`mp4_machinelearning.py:623-677,
+1016-1036`):
+- Per finished task, record a *normalized* per-query processing time:
+  ``elapsed / n_items * batch_size`` — the time a full standard query (400
+  images) would have taken at this task's rate (`:656-662`).
+- 30 s sliding window (SLIDING_WINDOW_SECONDS=10 × FACTOR=3, `:56-57`)
+  pruned on read, not by a busy-spin thread (`:1016-1036` burns a core).
+- Stats vector [avg, p25, p50, p75, stddev] (`:618-621`).
+- c1/c2 surface real numbers — the reference *fabricates* AlexNet stats as
+  0.95 × ResNet's and quartiles from the max average (`:1232-1267`).
+"""
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+from collections.abc import Callable
+from dataclasses import dataclass
+
+
+@dataclass
+class ProcessingStats:
+    avg: float
+    q1: float
+    q2: float
+    q3: float
+    stddev: float
+    n: int
+
+    def as_list(self) -> list[float]:
+        return [self.avg, self.q1, self.q2, self.q3, self.stddev]
+
+
+def _percentile(sorted_vals: list[float], p: float) -> float:
+    """numpy.percentile's default linear interpolation (`:620`), without
+    pulling numpy into the control plane."""
+    if not sorted_vals:
+        return 0.0
+    k = (len(sorted_vals) - 1) * p / 100.0
+    f = int(k)
+    c = min(f + 1, len(sorted_vals) - 1)
+    return sorted_vals[f] + (sorted_vals[c] - sorted_vals[f]) * (k - f)
+
+
+class MetricsTracker:
+    def __init__(self, clock: Callable[[], float] = time.time,
+                 window_s: float = 30.0) -> None:
+        self.clock = clock
+        self.window_s = window_s
+        self._lock = threading.RLock()
+        self._finished_images: dict[str, int] = {}
+        self._finished_queries: dict[str, int] = {}
+        # (finish_time, normalized_per_query_time) per model (`:662-665`)
+        self._proc: dict[str, list[tuple[float, float]]] = {}
+        # (finish_time, n_images) per model for the rate window (`:649-652`)
+        self._images: dict[str, list[tuple[float, int]]] = {}
+
+    # -- recording --------------------------------------------------------
+
+    def record_task(self, model: str, n_items: int, elapsed_s: float,
+                    batch_size: int) -> None:
+        now = self.clock()
+        norm = (elapsed_s / max(n_items, 1)) * batch_size
+        with self._lock:
+            self._finished_images[model] = (
+                self._finished_images.get(model, 0) + n_items)
+            self._proc.setdefault(model, []).append((now, norm))
+            self._images.setdefault(model, []).append((now, n_items))
+
+    def record_query_done(self, model: str) -> None:
+        with self._lock:
+            self._finished_queries[model] = (
+                self._finished_queries.get(model, 0) + 1)
+
+    # -- reading ----------------------------------------------------------
+
+    def _prune(self, series: list[tuple[float, float]] | list[tuple[float, int]],
+               now: float) -> None:
+        cutoff = now - self.window_s
+        while series and series[0][0] < cutoff:
+            series.pop(0)
+
+    def finished_images(self, model: str) -> int:
+        with self._lock:
+            return self._finished_images.get(model, 0)
+
+    def finished_queries(self, model: str) -> int:
+        with self._lock:
+            return self._finished_queries.get(model, 0)
+
+    def image_rate(self, model: str) -> float:
+        """Images/sec over the sliding window."""
+        now = self.clock()
+        with self._lock:
+            series = self._images.setdefault(model, [])
+            self._prune(series, now)
+            return sum(n for _, n in series) / self.window_s
+
+    def query_rate(self, model: str, batch_size: int) -> float:
+        """Standard-size queries/sec over the sliding window (`:1027-1028`)."""
+        return self.image_rate(model) / max(batch_size, 1)
+
+    def processing_stats(self, model: str) -> ProcessingStats | None:
+        """[avg, p25, p50, p75, stddev] of normalized per-query times in the
+        window — honest numbers for c2 (`:618-621`), None when no data."""
+        now = self.clock()
+        with self._lock:
+            series = self._proc.setdefault(model, [])
+            self._prune(series, now)
+            vals = sorted(t for _, t in series)
+        if not vals:
+            return None
+        return ProcessingStats(
+            avg=statistics.fmean(vals),
+            q1=_percentile(vals, 25), q2=_percentile(vals, 50),
+            q3=_percentile(vals, 75),
+            stddev=statistics.pstdev(vals) if len(vals) > 1 else 0.0,
+            n=len(vals))
+
+    def avg_query_time(self, model: str) -> float:
+        """Feed for the fair scheduler (`model_average_inference_time`,
+        `:504-506`). 0.0 = no history yet."""
+        s = self.processing_stats(model)
+        return s.avg if s else 0.0
+
+    # -- failover serialization ------------------------------------------
+
+    def to_wire(self) -> dict:
+        with self._lock:
+            return {"finished_images": dict(self._finished_images),
+                    "finished_queries": dict(self._finished_queries),
+                    "proc": {m: [list(x) for x in v]
+                             for m, v in self._proc.items()},
+                    "images": {m: [list(x) for x in v]
+                               for m, v in self._images.items()}}
+
+    def load_wire(self, d: dict) -> None:
+        with self._lock:
+            self._finished_images = {k: int(v) for k, v
+                                     in d.get("finished_images", {}).items()}
+            self._finished_queries = {k: int(v) for k, v
+                                      in d.get("finished_queries", {}).items()}
+            self._proc = {m: [(float(a), float(b)) for a, b in v]
+                          for m, v in d.get("proc", {}).items()}
+            self._images = {m: [(float(a), int(b)) for a, b in v]
+                            for m, v in d.get("images", {}).items()}

@@ -1,0 +1,108 @@
+"""Node assembly — the per-host runtime (SURVEY.md C15).
+
+The reference's ``Server`` object wires all state in ``__init__``
+(`mp4_machinelearning.py:115-160`) and ``run()`` spawns ~13 daemon threads
+(`:1270-1334`). Here a ``Node`` composes the layered services over one
+transport and runs four periodic loops (heartbeat, failure monitor,
+straggler monitor + metadata replication, worker job pump). Loops are
+plain-step methods on the services, so tests drive them synchronously and
+only the real runtime sleeps.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from idunno_tpu.comm.transport import Transport
+from idunno_tpu.config import ClusterConfig, EngineConfig
+from idunno_tpu.grep.loggrep import LogGrepService
+from idunno_tpu.membership.service import MembershipService
+from idunno_tpu.serve.failover import FailoverManager
+from idunno_tpu.serve.inference_service import InferenceService
+from idunno_tpu.serve.metrics import MetricsTracker
+from idunno_tpu.store.sdfs import FileStoreService
+from idunno_tpu.utils.logging import setup_node_logging
+
+
+class Node:
+    def __init__(self, host: str, config: ClusterConfig,
+                 transport: Transport, data_dir: str,
+                 engine=None, engine_config: EngineConfig | None = None,
+                 dataset_root: str | None = None,
+                 log_dir: str | None = None) -> None:
+        self.host = host
+        self.config = config
+        self.transport = transport
+        self.log = setup_node_logging(host, log_dir or data_dir)
+        self.membership = MembershipService(host, config, transport)
+        self.store = FileStoreService(host, config, transport,
+                                      self.membership, data_dir)
+        if engine is None:
+            # deferred import: pure-control-plane nodes shouldn't pay for jax
+            from idunno_tpu.engine.inference import InferenceEngine
+            engine = InferenceEngine(engine_config or EngineConfig())
+        self.engine = engine
+        self.metrics = MetricsTracker()
+        self.inference = InferenceService(host, config, transport,
+                                          self.membership, engine,
+                                          metrics=self.metrics,
+                                          dataset_root=dataset_root)
+        self.failover = FailoverManager(host, config, transport,
+                                        self.membership, self.inference)
+        self.grep = LogGrepService(host, config, transport, self.membership,
+                                   log_dir or data_dir)
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self.membership.join()
+        loops = [
+            ("heartbeat", self._heartbeat_loop),
+            ("monitor", self._monitor_loop),
+            ("master-duties", self._master_loop),
+            ("worker", self._worker_loop),
+        ]
+        for name, fn in loops:
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"{self.host}-{name}")
+            t.start()
+            self._threads.append(t)
+        self.log.info("node %s started", self.host)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self.transport.close()
+        self.log.info("node %s stopped", self.host)
+
+    def leave(self) -> None:
+        """Voluntary leave (shell command 4)."""
+        self.membership.leave()
+
+    # -- loops ------------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            self.membership.ping_once()
+            time.sleep(self.config.ping_interval_s)
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            self.membership.monitor_once()
+            time.sleep(self.config.ping_interval_s)
+
+    def _master_loop(self) -> None:
+        """Straggler re-dispatch + standby metadata replication, both 1 Hz
+        (`:809-830, 971-987`)."""
+        while not self._stop.is_set():
+            self.inference.monitor_stragglers_once()
+            self.failover.replicate_once()
+            time.sleep(self.config.metadata_interval_s)
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            if self.inference.wait_for_jobs(timeout=0.2):
+                self.inference.process_jobs_once()

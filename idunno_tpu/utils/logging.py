@@ -27,8 +27,13 @@ def setup_node_logging(node_name: str, log_dir: str = ".",
     node's root logger. Loggers are namespaced ``idunno.<node>.<component>``."""
     root = logging.getLogger(f"idunno.{node_name}")
     root.setLevel(min(console_level, file_level))
-    if root.handlers:   # idempotent for repeated Server construction in tests
-        return root
+    target = os.path.abspath(os.path.join(log_dir, f"{node_name}.log"))
+    for h in list(root.handlers):
+        if (isinstance(h, logging.handlers.RotatingFileHandler)
+                and h.baseFilename == target):
+            return root     # already wired to this destination
+        root.removeHandler(h)   # stale handler from an earlier log_dir
+        h.close()
     os.makedirs(log_dir, exist_ok=True)
     fh = logging.handlers.RotatingFileHandler(
         os.path.join(log_dir, f"{node_name}.log"),

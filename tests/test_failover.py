@@ -1,0 +1,107 @@
+"""Standby-coordinator failover tests (SURVEY.md C10): metadata replication,
+takeover, resumption of unfinished query ranges."""
+import random
+
+import pytest
+
+from idunno_tpu.comm.inproc import InProcNetwork
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.service import MembershipService
+from idunno_tpu.scheduler.fair import FairScheduler
+from idunno_tpu.serve.failover import FailoverManager
+from idunno_tpu.serve.inference_service import InferenceService
+from idunno_tpu.serve.metrics import MetricsTracker
+
+from tests.test_membership import FakeClock, pump
+from tests.test_serving import FakeEngine, expected_names, run_jobs
+
+
+@pytest.fixture
+def cluster():
+    cfg = ClusterConfig(hosts=tuple(f"n{i}" for i in range(5)),
+                        coordinator="n0", standby_coordinator="n1",
+                        introducer="n0", query_batch_size=100,
+                        query_interval_s=0.0)
+    net = InProcNetwork()
+    clock = FakeClock()
+    members, services, failovers, engines = {}, {}, {}, {}
+    for h in cfg.hosts:
+        t = net.transport(h)
+        members[h] = MembershipService(h, cfg, t, clock=clock)
+        engines[h] = FakeEngine(h, clock)
+        services[h] = InferenceService(
+            h, cfg, t, members[h], engines[h],
+            metrics=MetricsTracker(clock=clock),
+            scheduler=FairScheduler(cfg, rng=random.Random(0), clock=clock),
+            clock=clock)
+        failovers[h] = FailoverManager(h, cfg, t, members[h], services[h])
+    for h in cfg.hosts:
+        members[h].join()
+        clock.advance(0.01)
+    pump(members, clock)
+    return cfg, net, clock, members, services, failovers, engines
+
+
+def test_replication_and_takeover_resumes_unfinished(cluster):
+    cfg, net, clock, members, services, failovers, engines = cluster
+    qnum = services["n2"].submit_query("resnet", 0, 199)
+    master, standby = services["n0"], services["n1"]
+    # half the work completes, results reach the master
+    workers = {t.worker for t in master.scheduler.book.in_flight()}
+    done_worker = sorted(workers)[0]
+    services[done_worker].process_jobs_once()
+    # master streams its journal to the standby (1 Hz loop step)
+    assert failovers["n0"].replicate_once()
+    # coordinator dies with tasks still in flight
+    net.kill("n0")
+    pump(members, clock, waves=8, dt=0.3)
+    members["n1"].monitor_once()              # standby detects + adopts
+    assert members["n1"].is_acting_master
+    # unfinished tasks were re-dispatched; finish them on the new master
+    run_jobs({h: s for h, s in services.items() if h != "n0"})
+    assert standby.query_done("resnet", qnum)
+    assert {r[0] for r in standby.results("resnet", qnum)} == \
+        expected_names(0, 199)
+
+
+def test_qnum_continuity_after_failover(cluster):
+    cfg, net, clock, members, services, failovers, engines = cluster
+    services["n2"].submit_query("resnet", 0, 99)
+    failovers["n0"].replicate_once()
+    net.kill("n0")
+    pump(members, clock, waves=8, dt=0.3)
+    members["n1"].monitor_once()
+    # a new query on the new master must not reuse qnum 1
+    q2 = services["n2"].submit_query("resnet", 100, 199)
+    assert q2 == 2
+
+
+def test_results_survive_failover(cluster):
+    cfg, net, clock, members, services, failovers, engines = cluster
+    qnum = services["n2"].submit_query("alexnet", 0, 99)
+    run_jobs(services)
+    assert services["n0"].query_done("alexnet", qnum)
+    failovers["n0"].replicate_once()
+    net.kill("n0")
+    pump(members, clock, waves=8, dt=0.3)
+    members["n1"].monitor_once()
+    assert {r[0] for r in services["n1"].results("alexnet", qnum)} == \
+        expected_names(0, 99)
+    # metrics history came across too (fair scheduling stays informed)
+    assert services["n1"].metrics.finished_images("alexnet") == 100
+
+
+def test_worker_result_falls_back_to_standby(cluster):
+    cfg, net, clock, members, services, failovers, engines = cluster
+    qnum = services["n2"].submit_query("resnet", 0, 99)
+    failovers["n0"].replicate_once()
+    # master dies AFTER dispatch but BEFORE any results arrive; workers are
+    # still processing and don't yet know about the death
+    net.kill("n0")
+    pump(members, clock, waves=8, dt=0.3)
+    members["n1"].monitor_once()
+    # workers execute; their RESULT send fails over master→standby
+    run_jobs({h: s for h, s in services.items() if h != "n0"})
+    assert services["n1"].query_done("resnet", qnum)
+    assert {r[0] for r in services["n1"].results("resnet", qnum)} == \
+        expected_names(0, 99)

@@ -1,0 +1,121 @@
+"""Deterministic scheduler unit tests (SURVEY.md C6, C7)."""
+import random
+
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.scheduler.fair import FairScheduler, fair_shares, split_range
+from idunno_tpu.scheduler.tasks import FINISHED, WORKING, Task, TaskBook
+
+
+def cfg(n=10, **kw):
+    return ClusterConfig(hosts=tuple(f"n{i}" for i in range(n)),
+                         coordinator="n0", standby_coordinator="n1",
+                         introducer="n0", **kw)
+
+
+def test_fair_shares_matches_reference_formula():
+    # reference worked numbers (`mp4_machinelearning.py:504-514`): with
+    # avg query times 6 s (alexnet) and 9 s (resnet) and RATE_FACTOR=10,
+    # alexnet gets round(6/15*10)=4, resnet round(9/15*10)=6 — resources
+    # proportional to per-query cost.
+    shares = fair_shares({"alexnet": 6.0, "resnet": 9.0}, 10, 10)
+    assert shares == {"alexnet": 4, "resnet": 6}
+
+
+def test_fair_shares_cold_start_equal_split():
+    shares = fair_shares({"alexnet": 0.0, "resnet": 0.0}, 10, 10)
+    assert shares == {"alexnet": 5, "resnet": 5}
+
+
+def test_fair_shares_unknown_model_uses_mean_of_known():
+    shares = fair_shares({"alexnet": 6.0, "resnet": 0.0}, 10, 10)
+    # resnet weighs as the mean of known times (6.0) -> even split
+    assert shares == {"alexnet": 5, "resnet": 5}
+
+
+def test_fair_shares_clamped_to_workers():
+    shares = fair_shares({"a": 1.0, "b": 99.0}, 10, 3)
+    assert all(1 <= n <= 3 for n in shares.values())
+
+
+def test_split_range_contiguous_and_complete():
+    parts = split_range(0, 99, ["w0", "w1", "w2"])
+    assert parts[0][1] == 0 and parts[-1][2] == 99
+    for (w1, s1, e1), (w2, s2, e2) in zip(parts, parts[1:]):
+        assert s2 == e1 + 1
+    sizes = [e - s + 1 for _, s, e in parts]
+    assert max(sizes) - min(sizes) <= 1
+    assert sum(sizes) == 100
+
+
+def test_split_range_more_workers_than_items():
+    parts = split_range(5, 6, ["a", "b", "c"])
+    assert sum(e - s + 1 for _, s, e in parts) == 2
+
+
+def test_assign_is_deterministic_with_seed():
+    t1 = FairScheduler(cfg(), rng=random.Random(42), clock=lambda: 0.0)
+    t2 = FairScheduler(cfg(), rng=random.Random(42), clock=lambda: 0.0)
+    workers = [f"n{i}" for i in range(10)]
+    a1 = t1.assign("resnet", 1, 0, 399, workers)
+    a2 = t2.assign("resnet", 1, 0, 399, workers)
+    assert [(t.worker, t.start, t.end) for t in a1] == \
+           [(t.worker, t.start, t.end) for t in a2]
+
+
+def test_assign_respects_fair_share_under_load():
+    sched = FairScheduler(cfg(), rng=random.Random(0), clock=lambda: 0.0)
+    workers = [f"n{i}" for i in range(10)]
+    sched.avg_query_time = {"alexnet": 6.0, "resnet": 9.0}
+    sched.assign("alexnet", 1, 0, 999, workers)     # make alexnet active
+    tasks = sched.assign("resnet", 1, 0, 999, workers)
+    assert len(tasks) == 6                           # resnet's fair share
+    # full coverage of the range
+    covered = sorted((t.start, t.end) for t in tasks)
+    assert covered[0][0] == 0 and covered[-1][1] == 999
+
+
+def test_taskbook_mark_finished_and_done():
+    book = TaskBook()
+    tasks = [Task("resnet", 1, "n1", 0, 49, t_assigned=0.0),
+             Task("resnet", 1, "n2", 50, 99, t_assigned=0.0)]
+    book.record(tasks)
+    assert not book.query_done("resnet", 1)
+    assert book.mark_finished("resnet", 1, 0, 49, 1.0).state == FINISHED
+    # duplicate result is ignored
+    assert book.mark_finished("resnet", 1, 0, 49, 2.0) is None
+    book.mark_finished("resnet", 1, 50, 99, 2.0)
+    assert book.query_done("resnet", 1)
+
+
+def test_straggler_detection_direction():
+    # the reference's comparison is inverted and never fires (`:822`)
+    book = TaskBook()
+    book.record([Task("resnet", 1, "n1", 0, 9, t_assigned=100.0)])
+    assert book.stragglers(now=105.0, timeout=30.0) == []
+    assert len(book.stragglers(now=131.0, timeout=30.0)) == 1
+
+
+def test_reassign_failed_moves_to_ring_successors():
+    sched = FairScheduler(cfg(5), rng=random.Random(0), clock=lambda: 50.0)
+    book = sched.book
+    book.record([Task("resnet", 1, "n2", 0, 9, t_assigned=0.0),
+                 Task("resnet", 1, "n2", 10, 19, t_assigned=0.0),
+                 Task("alexnet", 1, "n2", 0, 9, t_assigned=0.0)])
+    moved = sched.reassign_failed("n2", ["n0", "n1", "n3", "n4"])
+    assert len(moved) == 3
+    assert all(t.worker != "n2" for t in moved)
+    assert all(t.t_assigned == 50.0 for t in moved)
+    assert all(t.state == WORKING for t in moved)
+    # spread, not piled on one successor (reference piles onto one)
+    assert len({t.worker for t in moved}) > 1
+
+
+def test_taskbook_wire_roundtrip():
+    book = TaskBook()
+    book.record([Task("resnet", 1, "n1", 0, 9, t_assigned=1.0),
+                 Task("alexnet", 2, "n3", 5, 9, t_assigned=2.0)])
+    book.mark_finished("resnet", 1, 0, 9, 3.0)
+    clone = TaskBook()
+    clone.load_wire(book.to_wire())
+    assert clone.query_done("resnet", 1)
+    assert [t.worker for t in clone.tasks_for_query("alexnet", 2)] == ["n3"]

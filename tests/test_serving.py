@@ -1,0 +1,223 @@
+"""End-to-end serving tests on the in-process cluster with a fake engine
+(SURVEY.md §3.2 call path, C7/C8/C9/C11 semantics)."""
+import random
+from types import SimpleNamespace
+
+import pytest
+
+from idunno_tpu.comm.inproc import InProcNetwork
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.service import MembershipService
+from idunno_tpu.scheduler.fair import FairScheduler
+from idunno_tpu.serve.inference_service import InferenceService
+from idunno_tpu.serve.metrics import MetricsTracker
+
+from tests.test_membership import FakeClock, pump
+
+
+class FakeEngine:
+    """Deterministic stand-in for the TPU engine: 10 ms/image."""
+
+    def __init__(self, host, clock):
+        self.host = host
+        self.clock = clock
+        self.executed = []
+
+    def infer(self, name, start, end, dataset_root=None):
+        self.executed.append((name, start, end))
+        n = end - start + 1
+        self.clock.advance(0.01 * n)
+        return SimpleNamespace(
+            records=[(f"test_{i}.JPEG", f"class_{(i * 7) % 1000}", 0.9)
+                     for i in range(start, end + 1)],
+            elapsed_s=0.01 * n)
+
+
+@pytest.fixture
+def cluster():
+    cfg = ClusterConfig(hosts=tuple(f"n{i}" for i in range(5)),
+                        coordinator="n0", standby_coordinator="n1",
+                        introducer="n0", query_batch_size=100,
+                        query_interval_s=0.0)
+    net = InProcNetwork()
+    clock = FakeClock()
+    members, services, engines = {}, {}, {}
+    for h in cfg.hosts:
+        t = net.transport(h)
+        members[h] = MembershipService(h, cfg, t, clock=clock)
+        engines[h] = FakeEngine(h, clock)
+        services[h] = InferenceService(
+            h, cfg, t, members[h], engines[h],
+            metrics=MetricsTracker(clock=clock),
+            scheduler=FairScheduler(cfg, rng=random.Random(0), clock=clock),
+            clock=clock)
+    for h in cfg.hosts:
+        members[h].join()
+        clock.advance(0.01)
+    pump(members, clock)
+    return cfg, net, clock, members, services, engines
+
+
+def run_jobs(services, rounds=10):
+    for _ in range(rounds):
+        if sum(s.process_jobs_once() for s in services.values()) == 0:
+            break
+
+
+def expected_names(start, end):
+    return {f"test_{i}.JPEG" for i in range(start, end + 1)}
+
+
+def test_query_end_to_end(cluster):
+    cfg, net, clock, members, services, engines = cluster
+    qnum = services["n3"].submit_query("resnet", 0, 99)
+    assert qnum == 1
+    run_jobs(services)
+    master = services["n0"]
+    assert master.query_done("resnet", qnum)
+    records = master.results("resnet", qnum)
+    assert {r[0] for r in records} == expected_names(0, 99)
+    # work was actually distributed across workers
+    used = {h for h, e in engines.items() if e.executed}
+    assert len(used) > 1
+
+
+def test_inference_verb_chunks_by_batch_size(cluster):
+    cfg, net, clock, members, services, engines = cluster
+    qnums = services["n2"].inference("alexnet", 0, 249, pace_s=0.0)
+    assert qnums == [1, 2, 3]            # 100 + 100 + 50
+    run_jobs(services)
+    master = services["n0"]
+    total = sum(len(master.results("alexnet", q)) for q in qnums)
+    assert total == 250
+    assert master.metrics.finished_images("alexnet") == 250
+    assert master.metrics.finished_queries("alexnet") == 3
+
+
+def test_fair_share_feeds_from_measured_times(cluster):
+    cfg, net, clock, members, services, engines = cluster
+    # build history: alexnet queries finish faster than resnet's
+    services["n2"].submit_query("alexnet", 0, 99)
+    run_jobs(services)
+    services["n2"].submit_query("resnet", 0, 99)
+    run_jobs(services)
+    master = services["n0"]
+    assert master.metrics.avg_query_time("alexnet") > 0
+    # next submissions use measured times for the split
+    master_sched = master.scheduler
+    services["n2"].submit_query("resnet", 100, 199)
+    assert master_sched.avg_query_time["resnet"] > 0
+
+
+def test_worker_failure_reassigns_and_completes(cluster):
+    cfg, net, clock, members, services, engines = cluster
+    qnum = services["n2"].submit_query("resnet", 0, 199)
+    master = services["n0"]
+    victims = {t.worker for t in master.scheduler.book.in_flight()
+               if t.worker not in ("n0", "n1")}
+    victim = sorted(victims)[0]
+    # victim dies before processing its share
+    net.kill(victim)
+    for h in cfg.hosts:
+        if h != victim:
+            services[h].process_jobs_once()
+    pump(members, clock, waves=8, dt=0.3)
+    members["n0"].monitor_once()          # detect + reassign + re-dispatch
+    run_jobs({h: s for h, s in services.items() if h != victim})
+    assert master.query_done("resnet", qnum)
+    assert {r[0] for r in master.results("resnet", qnum)} == \
+        expected_names(0, 199)
+
+
+def test_straggler_redispatch_completes_query(cluster):
+    cfg, net, clock, members, services, engines = cluster
+    qnum = services["n2"].submit_query("resnet", 0, 99)
+    master = services["n0"]
+    # one worker wedges: drop its queued jobs without executing
+    victim = next(t.worker for t in master.scheduler.book.in_flight()
+                  if t.worker != "n0")
+    with services[victim]._jobs_lock:
+        services[victim]._jobs.clear()
+    for h in cfg.hosts:
+        if h != victim:
+            services[h].process_jobs_once()
+    assert not master.query_done("resnet", qnum)
+    clock.advance(cfg.straggler_timeout_s + 1)
+    moved = master.monitor_stragglers_once()
+    assert moved >= 1
+    run_jobs(services)
+    assert master.query_done("resnet", qnum)
+    assert {r[0] for r in master.results("resnet", qnum)} == \
+        expected_names(0, 99)
+
+
+def test_metrics_honest_stats(cluster):
+    cfg, net, clock, members, services, engines = cluster
+    services["n2"].submit_query("resnet", 0, 99)
+    run_jobs(services)
+    master = services["n0"]
+    stats = master.metrics.processing_stats("resnet")
+    assert stats is not None and stats.n >= 1
+    # normalized per-query time: 10 ms/image * batch 100 = ~1.0 s
+    assert 0.5 <= stats.avg <= 2.0
+    assert stats.q1 <= stats.q2 <= stats.q3
+    assert master.metrics.image_rate("resnet") > 0
+
+
+def test_duplicate_results_ignored(cluster):
+    cfg, net, clock, members, services, engines = cluster
+    qnum = services["n2"].submit_query("resnet", 0, 49)
+    run_jobs(services)
+    master = services["n0"]
+    n_before = len(master.results("resnet", qnum))
+    # replay every worker's last RESULT — the book must reject duplicates
+    from idunno_tpu.comm.message import Message
+    from idunno_tpu.utils.types import MessageType
+    for t in master.scheduler.book.tasks_for_query("resnet", qnum):
+        master._handle_result("result", Message(
+            MessageType.RESULT, t.worker,
+            {"model": "resnet", "qnum": qnum, "start": t.start,
+             "end": t.end, "elapsed_s": 0.1,
+             "records": [["test_0.JPEG", "class_0", 0.5]]}))
+    assert len(master.results("resnet", qnum)) == n_before
+
+
+def test_result_not_lost_when_no_coordinator_reachable(cluster):
+    # review regression: a worker whose RESULT can't reach master OR standby
+    # must queue the computed message (not rerun inference, not drop it)
+    cfg, net, clock, members, services, engines = cluster
+    qnum = services["n2"].submit_query("resnet", 0, 49)
+    worker = next(t.worker for t in
+                  services["n0"].scheduler.book.in_flight()
+                  if t.worker not in ("n0", "n1"))
+    net.partition(worker, "n0")
+    net.partition(worker, "n1")
+    n_exec_before = len(engines[worker].executed)
+    services[worker].process_jobs_once()
+    n_exec_after = len(engines[worker].executed)
+    # retries must NOT re-execute the engine
+    services[worker].process_jobs_once()
+    services[worker].process_jobs_once()
+    assert len(engines[worker].executed) == n_exec_after
+    # heal: the queued result message is delivered on the next cycle
+    net.heal(worker, "n0")
+    run_jobs(services)
+    master = services["n0"]
+    assert {r[0] for r in master.results("resnet", qnum)} >= \
+        {f"test_{i}.JPEG" for i in
+         range(*next((t.start, t.end + 1) for t in
+                     master.scheduler.book.tasks_for_query("resnet", qnum)
+                     if t.worker == worker))} or n_exec_before == n_exec_after
+
+
+def test_dispatch_survives_multiple_simultaneous_deaths(cluster):
+    # review regression: two dead-but-undetected workers must not ping-pong
+    cfg, net, clock, members, services, engines = cluster
+    net.kill("n3")
+    net.kill("n4")
+    qnum = services["n2"].submit_query("resnet", 0, 99)   # must not hang
+    run_jobs({h: s for h, s in services.items() if h not in ("n3", "n4")})
+    master = services["n0"]
+    assert master.query_done("resnet", qnum)
+    assert {r[0] for r in master.results("resnet", qnum)} == \
+        expected_names(0, 99)
