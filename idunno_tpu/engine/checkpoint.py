@@ -1,0 +1,45 @@
+"""Model checkpointing into the replicated file store.
+
+The reference has NO model checkpointing — pretrained weights are re-fetched
+from torch.hub on every task (`alexnet_resnet.py:17-22`), and the only
+durable versioned state is SDFS file versioning (SURVEY.md §5). Here model
+variables serialize through ``flax.serialization`` and live in the
+replicated store under ``ckpt/<model>`` — every ``save`` bumps the store
+version (put = version++), ``restore`` fetches latest or any historical
+version, and replication + re-replication-on-failure come for free from the
+store layer. The serving cluster can therefore refresh, roll back, and
+survive holder loss of its own weights.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.serialization
+import jax
+
+from idunno_tpu.store.sdfs import FileStoreService
+
+
+def checkpoint_name(model: str) -> str:
+    return f"ckpt/{model}"
+
+
+def save_variables(store: FileStoreService, model: str,
+                   variables: Any) -> int:
+    """Serialize variables into the store; returns the new version."""
+    host_vars = jax.tree.map(lambda x: jax.device_get(x), variables)
+    blob = flax.serialization.to_bytes(host_vars)
+    return store.put_bytes(checkpoint_name(model), blob)
+
+
+def restore_variables(store: FileStoreService, model: str,
+                      template: Any) -> tuple[Any, int]:
+    """Load the latest checkpoint into the structure of ``template``;
+    returns (variables, version)."""
+    blob, version = store.get_bytes(checkpoint_name(model))
+    return flax.serialization.from_bytes(template, blob), version
+
+
+def list_versions(store: FileStoreService, model: str) -> list[str]:
+    """Hosts currently holding the checkpoint (availability check)."""
+    return store.ls(checkpoint_name(model))

@@ -73,25 +73,31 @@ class LogGrepService:
     # -- client side ------------------------------------------------------
 
     def query(self, pattern: str) -> dict[str, dict]:
-        """Fan out to every alive host (self included); returns
-        host → {count, lines, truncated} (unreachable hosts → error)."""
+        """Fan out to every alive host (self included) CONCURRENTLY — the
+        wall-clock cost is the slowest host, not the sum (a crashed host not
+        yet marked LEAVE would otherwise stall the shell for its full
+        timeout). Returns host → {count, lines, truncated} (unreachable
+        hosts → error)."""
+        from concurrent.futures import ThreadPoolExecutor
+
         msg = Message(MessageType.GREP, self.host, {"pattern": pattern})
-        out: dict[str, dict] = {}
-        for h in self.membership.members.alive_hosts():
+
+        def ask(h: str) -> tuple[str, dict]:
             if h == self.host:
                 reply = self._handle(SERVICE, msg)
             else:
                 try:
                     reply = self.transport.call(h, SERVICE, msg, timeout=15.0)
                 except TransportError as e:
-                    out[h] = {"error": str(e)}
-                    continue
+                    return h, {"error": str(e)}
             if reply is None or reply.type is MessageType.ERROR:
-                out[h] = {"error": (reply.payload.get("error", "no reply")
-                                    if reply else "no reply")}
-            else:
-                out[h] = dict(reply.payload)
-        return out
+                return h, {"error": (reply.payload.get("error", "no reply")
+                                     if reply else "no reply")}
+            return h, dict(reply.payload)
+
+        hosts = self.membership.members.alive_hosts()
+        with ThreadPoolExecutor(max_workers=max(len(hosts), 1)) as pool:
+            return dict(pool.map(ask, hosts))
 
     @staticmethod
     def total_count(results: dict[str, dict]) -> int:

@@ -1,0 +1,115 @@
+"""Transformer with pluggable attention — the long-context model family.
+
+The reference serves only image CNNs (`alexnet_resnet.py`), but the
+framework's job inventory must cover sequence models at TPU scale: this
+module provides a causal/bidirectional transformer whose attention
+implementation is injectable — ``full_attention`` on one device, or
+``ring_attention`` with the sequence dimension sharded over the mesh
+(`idunno_tpu.parallel.ring_attention`) for contexts that do not fit one
+chip. Rotary position embeddings keep positions global and length-agnostic,
+and they are applied on the (sequence-sharded) global view under jit, so
+each shard rotates with its true global positions.
+"""
+from __future__ import annotations
+
+from collections.abc import Callable
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+import jax
+
+from idunno_tpu.parallel.ring_attention import full_attention
+
+AttnFn = Callable[..., jnp.ndarray]     # (q, k, v, *, causal) -> out
+
+
+def rope(x: jnp.ndarray, *, base: float = 10000.0) -> jnp.ndarray:
+    """Rotary embedding over [B, T, H, D] with global positions 0..T-1."""
+    b, t, h, d = x.shape
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]      # [1, T, 1, half]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+class MultiHeadAttention(nn.Module):
+    dim: int
+    num_heads: int
+    causal: bool = True
+    attn_fn: AttnFn = full_attention
+    use_rope: bool = True
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, _ = x.shape
+        head_dim = self.dim // self.num_heads
+        dense = partial(nn.DenseGeneral, dtype=self.dtype,
+                        param_dtype=self.param_dtype)
+        q = dense(features=(self.num_heads, head_dim), name="q")(x)
+        k = dense(features=(self.num_heads, head_dim), name="k")(x)
+        v = dense(features=(self.num_heads, head_dim), name="v")(x)
+        if self.use_rope:
+            q, k = rope(q), rope(k)
+        out = self.attn_fn(q, k, v, causal=self.causal)
+        return nn.DenseGeneral(features=self.dim, axis=(-2, -1),
+                               dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               name="out")(out)
+
+
+class Block(nn.Module):
+    dim: int
+    num_heads: int
+    mlp_ratio: int = 4
+    causal: bool = True
+    attn_fn: AttnFn = full_attention
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        ln = partial(nn.LayerNorm, dtype=self.dtype,
+                     param_dtype=self.param_dtype)
+        dense = partial(nn.Dense, dtype=self.dtype,
+                        param_dtype=self.param_dtype)
+        x = x + MultiHeadAttention(
+            self.dim, self.num_heads, causal=self.causal,
+            attn_fn=self.attn_fn, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="attn")(ln(name="ln1")(x))
+        h = dense(self.dim * self.mlp_ratio, name="mlp_up")(ln(name="ln2")(x))
+        x = x + dense(self.dim, name="mlp_down")(nn.gelu(h))
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Minimal causal LM for long-context serving/training demos."""
+
+    vocab: int = 1024
+    dim: int = 128
+    depth: int = 2
+    num_heads: int = 4
+    causal: bool = True
+    attn_fn: AttnFn = full_attention
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        x = nn.Embed(self.vocab, self.dim, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="embed")(tokens)
+        for i in range(self.depth):
+            x = Block(self.dim, self.num_heads, causal=self.causal,
+                      attn_fn=self.attn_fn, dtype=self.dtype,
+                      param_dtype=self.param_dtype, name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln_f")(x)
+        logits = nn.Dense(self.vocab, dtype=self.dtype,
+                          param_dtype=self.param_dtype, name="head")(x)
+        return logits.astype(jnp.float32)

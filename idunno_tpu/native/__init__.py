@@ -1,0 +1,112 @@
+"""ctypes binding for the native staging library, built on demand.
+
+``g++ -O3 -march=native -fopenmp`` at first use (cached next to the source,
+keyed by source hash); every entry point has a numpy/PIL fallback so the
+framework works without a toolchain — native is an accelerator, not a
+dependency (the environment provides g++ but no pybind11, hence ctypes).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "stage.cc")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> ctypes.CDLL | None:
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_DIR, f"_stage_{tag}.so")
+    if not os.path.exists(so_path):
+        # pid-unique temp so concurrent builds from several local node
+        # processes can't interleave writes; os.replace publishes atomically
+        tmp = f"{so_path}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O3", "-march=native", "-fopenmp", "-shared",
+               "-fPIC", _SRC, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so_path)
+        except (subprocess.SubprocessError, OSError, FileNotFoundError):
+            return None
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError:
+        return None
+    lib.resize_bilinear_u8.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int]
+    lib.stage_batch_u8.argtypes = [
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8)]
+    return lib
+
+
+def get_lib() -> ctypes.CDLL | None:
+    global _lib, _tried
+    if _lib is None and not _tried:
+        with _lock:
+            if _lib is None and not _tried:
+                _lib = _build()
+                _tried = True
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _as_u8_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def resize_bilinear(src: np.ndarray, dh: int, dw: int) -> np.ndarray:
+    """RGB u8 [H, W, 3] → [dh, dw, 3]; native when possible, PIL fallback."""
+    lib = get_lib()
+    if lib is None:
+        from PIL import Image
+        img = Image.fromarray(src).resize((dw, dh), Image.BILINEAR)
+        return np.asarray(img, dtype=np.uint8)
+    src = np.ascontiguousarray(src, dtype=np.uint8)
+    dst = np.empty((dh, dw, 3), np.uint8)
+    lib.resize_bilinear_u8(_as_u8_ptr(src), src.shape[0], src.shape[1],
+                           _as_u8_ptr(dst), dh, dw)
+    return dst
+
+
+def stage_batch(frames: list[np.ndarray], size: int) -> np.ndarray:
+    """K decoded RGB frames (varying sizes) → contiguous u8
+    [K, size, size, 3] with shortest-side resize + center crop. OpenMP
+    across frames natively; serial numpy/PIL fallback otherwise."""
+    lib = get_lib()
+    if lib is None or not frames:
+        out = np.empty((len(frames), size, size, 3), np.uint8)
+        for i, f in enumerate(frames):
+            h, w = f.shape[:2]
+            if w <= h:
+                rw, rh = size, max(size, round(h * size / w))
+            else:
+                rh, rw = size, max(size, round(w * size / h))
+            r = resize_bilinear(f, rh, rw)
+            top, left = (rh - size) // 2, (rw - size) // 2
+            out[i] = r[top:top + size, left:left + size]
+        return out
+    contig = [np.ascontiguousarray(f, dtype=np.uint8) for f in frames]
+    k = len(contig)
+    ptrs = (ctypes.POINTER(ctypes.c_uint8) * k)(
+        *[_as_u8_ptr(f) for f in contig])
+    dims = np.asarray([[f.shape[0], f.shape[1]] for f in contig],
+                      dtype=np.int32)
+    dst = np.empty((k, size, size, 3), np.uint8)
+    lib.stage_batch_u8(ptrs, dims.ctypes.data_as(
+        ctypes.POINTER(ctypes.c_int32)), k, size, _as_u8_ptr(dst))
+    return dst

@@ -79,7 +79,7 @@ class FairScheduler:
         return sorted({t.model for t in self.book.in_flight()})
 
     def assign(self, model: str, qnum: int, start: int, end: int,
-               workers: list[str]) -> list[Task]:
+               workers: list[str], dataset: str | None = None) -> list[Task]:
         """Split one query across this model's fair share of workers and
         record the tasks."""
         if not workers:
@@ -93,7 +93,7 @@ class FairScheduler:
         chosen = self.rng.sample(workers, n)
         now = self.clock()
         tasks = [Task(model=model, qnum=qnum, worker=w, start=s, end=e,
-                      t_assigned=now)
+                      t_assigned=now, dataset=dataset)
                  for w, s, e in split_range(start, end, chosen)]
         self.book.record(tasks)
         return tasks

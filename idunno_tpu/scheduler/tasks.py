@@ -27,6 +27,9 @@ class Task:
     state: str = WORKING
     t_assigned: float = 0.0
     t_finished: float = 0.0
+    # the query's dataset root travels WITH the task so failure/straggler
+    # re-dispatch (and post-failover resumption) reruns it on the same data
+    dataset: str | None = None
 
     @property
     def n_items(self) -> int:
@@ -35,14 +38,16 @@ class Task:
     def to_wire(self) -> dict[str, Any]:
         return {"model": self.model, "qnum": self.qnum, "worker": self.worker,
                 "start": self.start, "end": self.end, "state": self.state,
-                "t_assigned": self.t_assigned, "t_finished": self.t_finished}
+                "t_assigned": self.t_assigned, "t_finished": self.t_finished,
+                "dataset": self.dataset}
 
     @classmethod
     def from_wire(cls, d: dict[str, Any]) -> "Task":
         return cls(model=d["model"], qnum=int(d["qnum"]), worker=d["worker"],
                    start=int(d["start"]), end=int(d["end"]), state=d["state"],
                    t_assigned=float(d["t_assigned"]),
-                   t_finished=float(d["t_finished"]))
+                   t_finished=float(d["t_finished"]),
+                   dataset=d.get("dataset"))
 
 
 class TaskBook:

@@ -132,4 +132,4 @@ class FailoverManager:
                 svc.scheduler.book.reassign(
                     task, svc.scheduler.rng.choice(candidates),
                     svc.clock())
-            svc._dispatch(task, svc.dataset_root)
+            svc._dispatch(task)

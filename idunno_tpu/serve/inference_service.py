@@ -193,9 +193,10 @@ class InferenceService:
         if not workers:
             return Message(MessageType.ERROR, self.host,
                            {"error": "no alive workers"})
-        tasks = self.scheduler.assign(model, qnum, start, end, workers)
+        tasks = self.scheduler.assign(model, qnum, start, end, workers,
+                                      dataset=dataset)
         for t in tasks:
-            self._dispatch(t, dataset)
+            self._dispatch(t)
         return Message(MessageType.ACK, self.host, {"qnum": qnum})
 
     def _eligible_workers(self) -> list[str]:
@@ -203,11 +204,11 @@ class InferenceService:
         (`send_inference_work` local-execute branch, `:764-791`)."""
         return self.membership.members.alive_hosts()
 
-    def _dispatch(self, task: Task, dataset: str | None) -> None:
+    def _dispatch(self, task: Task) -> None:
         msg = Message(MessageType.JOB, self.host,
                       {"model": task.model, "qnum": task.qnum,
                        "start": task.start, "end": task.end,
-                       "dataset": dataset})
+                       "dataset": task.dataset})
         # On send failure, reassign on the spot rather than waiting for the
         # failure detector — with a cumulative exclusion set so several
         # simultaneously-dead workers can't ping-pong the dispatch forever.
@@ -263,7 +264,7 @@ class InferenceService:
             return
         alive = self._eligible_workers()
         for task in self.scheduler.reassign_failed(host, alive):
-            self._dispatch(task, self.dataset_root)
+            self._dispatch(task)
 
     def monitor_stragglers_once(self) -> int:
         """Re-dispatch tasks stuck past the straggler timeout; returns how
@@ -273,8 +274,7 @@ class InferenceService:
         alive = self._eligible_workers()
         moved = 0
         for task in self.scheduler.stragglers():
-            self._dispatch(self.scheduler.redispatch_straggler(task, alive),
-                           self.dataset_root)
+            self._dispatch(self.scheduler.redispatch_straggler(task, alive))
             moved += 1
         return moved
 

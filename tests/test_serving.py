@@ -221,3 +221,27 @@ def test_dispatch_survives_multiple_simultaneous_deaths(cluster):
     assert master.query_done("resnet", qnum)
     assert {r[0] for r in master.results("resnet", qnum)} == \
         expected_names(0, 99)
+
+
+def test_redispatch_preserves_dataset(cluster):
+    # review regression: the dataset root must travel with the task through
+    # failure reassignment (not be replaced by the coordinator's own)
+    cfg, net, clock, members, services, engines = cluster
+    services["n2"].dataset_root = "/data/real-images"
+    services["n2"].submit_query("resnet", 0, 99)
+    master = services["n0"]
+    assert all(t.dataset == "/data/real-images"
+               for t in master.scheduler.book.in_flight())
+    victim = next(t.worker for t in master.scheduler.book.in_flight()
+                  if t.worker not in ("n0", "n1"))
+    net.kill(victim)
+    pump(members, clock, waves=8, dt=0.3)
+    members["n0"].monitor_once()
+    # reassigned tasks keep the original dataset
+    assert all(t.dataset == "/data/real-images"
+               for t in master.scheduler.book.in_flight())
+    # and the jobs queued on replacement workers carry it too
+    for h, s in services.items():
+        with s._jobs_lock:
+            for j in s._jobs:
+                assert j.dataset == "/data/real-images"
