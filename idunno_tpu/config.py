@@ -123,3 +123,6 @@ class EngineConfig:
     resize_size: int = 256          # canonical host-decoded size
     compute_dtype: str = "bfloat16"  # MXU-friendly
     param_dtype: str = "float32"
+    # uint8→normalized preprocess: "auto" = Pallas kernel on TPU, XLA
+    # elsewhere; "pallas" / "xla" force one path.
+    preprocess: str = "auto"

@@ -40,6 +40,13 @@ def restore_variables(store: FileStoreService, model: str,
     return flax.serialization.from_bytes(template, blob), version
 
 
-def list_versions(store: FileStoreService, model: str) -> list[str]:
+def checkpoint_holders(store: FileStoreService, model: str) -> list[str]:
     """Hosts currently holding the checkpoint (availability check)."""
     return store.ls(checkpoint_name(model))
+
+
+def restore_version(store: FileStoreService, model: str, template: Any,
+                    version: int) -> Any:
+    """Load one historical checkpoint version (rollback target)."""
+    blob, _ = store.get_bytes(checkpoint_name(model), version=version)
+    return flax.serialization.from_bytes(template, blob)

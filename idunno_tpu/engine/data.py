@@ -12,10 +12,13 @@ A synthetic generator stands in for the dataset when no image files exist
 """
 from __future__ import annotations
 
+import logging
 import os
 from collections.abc import Iterator
 
 import numpy as np
+
+logger = logging.getLogger("idunno.data")
 
 CANONICAL_SIZE = 256
 
@@ -72,8 +75,12 @@ def load_range(root: str | None, start: int, end: int,
         if path and os.path.exists(path):
             try:
                 return decode_image(path)
-            except OSError:
-                pass
+            except OSError as e:
+                # present-but-undecodable is a data problem, not a missing
+                # index — surface it, then still classify a placeholder so
+                # the query's result count stays exact.
+                logger.warning("decode failed for %s (%s); "
+                               "substituting placeholder", path, e)
         return synthetic_image(i, size)
 
     if len(indices) > 1:

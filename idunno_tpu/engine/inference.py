@@ -77,6 +77,7 @@ class InferenceEngine:
         self.seed = seed
         self.pretrained = pretrained
         self._models: dict[str, _LoadedModel] = {}
+        self._pallas_ok: bool | None = None   # resolved on first load
         self.categories = imagenet_categories()
 
     # -- loading ----------------------------------------------------------
@@ -105,6 +106,17 @@ class InferenceEngine:
             module=module, variables=variables,
             predict=predict, predict_many=predict_many)
 
+    def _use_pallas(self) -> bool:
+        mode = self.config.preprocess
+        if mode == "pallas":
+            return True
+        if mode == "xla":
+            return False
+        if mode != "auto":
+            raise ValueError(
+                f"EngineConfig.preprocess={mode!r}: want auto|pallas|xla")
+        return self.mesh.devices.flatten()[0].platform == "tpu"
+
     def _build_predict(self, module):
         from jax.sharding import NamedSharding, PartitionSpec as P
         from idunno_tpu.parallel.mesh import DATA_AXIS
@@ -112,8 +124,44 @@ class InferenceEngine:
         bsharding = batch_sharding(self.mesh)
         rsharding = replicated_sharding(self.mesh)
 
+        if self._pallas_ok is None:
+            use_pallas = self._use_pallas()
+            if use_pallas and self.config.preprocess == "auto":
+                # auto mode must never take the engine down: smoke-compile
+                # the kernel once per engine and fall back to the XLA path
+                # if Mosaic rejects it.
+                try:
+                    from idunno_tpu.ops.pallas_preprocess import (
+                        preprocess_batch_pallas)
+                    n_data = self.mesh.shape[DATA_AXIS]
+                    probe = jnp.zeros((n_data, self.config.resize_size,
+                                       self.config.resize_size, 3), jnp.uint8)
+                    jax.block_until_ready(preprocess_batch_pallas(
+                        probe, crop=self.config.image_size))
+                except Exception as e:  # pragma: no cover - TPU-compile only
+                    import logging
+                    logging.getLogger("idunno.engine").warning(
+                        "pallas preprocess unavailable (%s); using XLA path",
+                        e)
+                    use_pallas = False
+            self._pallas_ok = use_pallas
+
+        if self._pallas_ok:
+            from jax import shard_map
+            from idunno_tpu.ops.pallas_preprocess import preprocess_batch_pallas
+
+            # pallas_call is a custom call XLA can't auto-partition; run it
+            # per-shard over the data axis explicitly.
+            preprocess = shard_map(
+                lambda u8: preprocess_batch_pallas(
+                    u8, crop=self.config.image_size),
+                mesh=self.mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))
+        else:
+            def preprocess(u8):
+                return preprocess_batch(u8, crop=self.config.image_size)
+
         def fwd(variables, images_u8):
-            x = preprocess_batch(images_u8, crop=self.config.image_size)
+            x = preprocess(images_u8)
             logits = module.apply(variables, x, train=False)
             return top1_from_logits(logits)
 

@@ -69,13 +69,39 @@ def _as_u8_ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 
 
+def _axis_coords(s: int, d: int):
+    """Half-pixel 16.16 fixed-point source coordinates for one axis —
+    bit-identical to stage.cc's ``x * x_step + x_step/2 - 2^15`` clamped."""
+    step = (s << 16) // d
+    c = np.arange(d, dtype=np.int64) * step + step // 2 - (1 << 15)
+    np.clip(c, 0, (s - 1) << 16, out=c)
+    lo = c >> 16
+    hi = np.minimum(lo + 1, s - 1)
+    frac = c & 0xFFFF
+    return lo, hi, frac
+
+
+def _resize_bilinear_np(src: np.ndarray, dh: int, dw: int) -> np.ndarray:
+    """Pure-numpy twin of stage.cc's resize_bilinear_u8 (same fixed point,
+    same rounding) so staging is pixel-identical with or without g++."""
+    sh, sw = src.shape[:2]
+    y0, y1, fy = _axis_coords(sh, dh)
+    x0, x1, fx = _axis_coords(sw, dw)
+    p = src.astype(np.int64)
+    r0, r1 = p[y0], p[y1]                       # [dh, sw, 3]
+    top = (r0[:, x0] << 16) + (r0[:, x1] - r0[:, x0]) * fx[None, :, None]
+    bot = (r1[:, x0] << 16) + (r1[:, x1] - r1[:, x0]) * fx[None, :, None]
+    val = (top << 16) + (bot - top) * fy[:, None, None]
+    return ((val + (1 << 31)) >> 32).astype(np.uint8)
+
+
 def resize_bilinear(src: np.ndarray, dh: int, dw: int) -> np.ndarray:
-    """RGB u8 [H, W, 3] → [dh, dw, 3]; native when possible, PIL fallback."""
+    """RGB u8 [H, W, 3] → [dh, dw, 3]; native when possible, bit-identical
+    numpy fallback otherwise."""
     lib = get_lib()
     if lib is None:
-        from PIL import Image
-        img = Image.fromarray(src).resize((dw, dh), Image.BILINEAR)
-        return np.asarray(img, dtype=np.uint8)
+        return _resize_bilinear_np(
+            np.ascontiguousarray(src, dtype=np.uint8), dh, dw)
     src = np.ascontiguousarray(src, dtype=np.uint8)
     dst = np.empty((dh, dw, 3), np.uint8)
     lib.resize_bilinear_u8(_as_u8_ptr(src), src.shape[0], src.shape[1],
@@ -83,23 +109,29 @@ def resize_bilinear(src: np.ndarray, dh: int, dw: int) -> np.ndarray:
     return dst
 
 
+def _stage_batch_np(frames: list[np.ndarray], size: int) -> np.ndarray:
+    out = np.empty((len(frames), size, size, 3), np.uint8)
+    for i, f in enumerate(frames):
+        h, w = f.shape[:2]
+        # rounded division, same integer formula as stage.cc
+        if w <= h:
+            rw, rh = size, max(size, (h * size + w // 2) // w)
+        else:
+            rh, rw = size, max(size, (w * size + h // 2) // h)
+        r = _resize_bilinear_np(
+            np.ascontiguousarray(f, dtype=np.uint8), rh, rw)
+        top, left = (rh - size) // 2, (rw - size) // 2
+        out[i] = r[top:top + size, left:left + size]
+    return out
+
+
 def stage_batch(frames: list[np.ndarray], size: int) -> np.ndarray:
     """K decoded RGB frames (varying sizes) → contiguous u8
     [K, size, size, 3] with shortest-side resize + center crop. OpenMP
-    across frames natively; serial numpy/PIL fallback otherwise."""
+    across frames natively; bit-identical serial numpy fallback otherwise."""
     lib = get_lib()
     if lib is None or not frames:
-        out = np.empty((len(frames), size, size, 3), np.uint8)
-        for i, f in enumerate(frames):
-            h, w = f.shape[:2]
-            if w <= h:
-                rw, rh = size, max(size, round(h * size / w))
-            else:
-                rh, rw = size, max(size, round(w * size / h))
-            r = resize_bilinear(f, rh, rw)
-            top, left = (rh - size) // 2, (rw - size) // 2
-            out[i] = r[top:top + size, left:left + size]
-        return out
+        return _stage_batch_np(frames, size)
     contig = [np.ascontiguousarray(f, dtype=np.uint8) for f in frames]
     k = len(contig)
     ptrs = (ctypes.POINTER(ctypes.c_uint8) * k)(

@@ -18,14 +18,23 @@
 
 extern "C" {
 
-// Bilinear resize of an interleaved RGB u8 image. Fixed-point (16.16).
+// Bilinear resize of an interleaved RGB u8 image. Fixed-point (16.16),
+// half-pixel convention: sx = (x + 0.5) * sw/dw - 0.5, clamped. The numpy
+// fallback in idunno_tpu/native/__init__.py implements the exact same
+// fixed-point math so native and fallback staging are pixel-identical
+// (cross-host determinism does not depend on the toolchain being present).
+static inline int64_t clamp64(int64_t v, int64_t lo, int64_t hi) {
+    return v < lo ? lo : (v > hi ? hi : v);
+}
+
 void resize_bilinear_u8(const uint8_t* src, int sh, int sw,
                         uint8_t* dst, int dh, int dw) {
-    const int64_t x_step = ((int64_t)(sw - 1) << 16) / std::max(dw - 1, 1);
-    const int64_t y_step = ((int64_t)(sh - 1) << 16) / std::max(dh - 1, 1);
+    const int64_t x_step = ((int64_t)sw << 16) / dw;
+    const int64_t y_step = ((int64_t)sh << 16) / dh;
 #pragma omp parallel for schedule(static)
     for (int y = 0; y < dh; ++y) {
-        const int64_t sy = y * y_step;
+        const int64_t sy = clamp64(
+            y * y_step + y_step / 2 - (1LL << 15), 0, (int64_t)(sh - 1) << 16);
         const int y0 = (int)(sy >> 16);
         const int y1 = std::min(y0 + 1, sh - 1);
         const int fy = (int)(sy & 0xffff);
@@ -33,7 +42,9 @@ void resize_bilinear_u8(const uint8_t* src, int sh, int sw,
         const uint8_t* row1 = src + (int64_t)y1 * sw * 3;
         uint8_t* out = dst + (int64_t)y * dw * 3;
         for (int x = 0; x < dw; ++x) {
-            const int64_t sx = x * x_step;
+            const int64_t sx = clamp64(
+                x * x_step + x_step / 2 - (1LL << 15), 0,
+                (int64_t)(sw - 1) << 16);
             const int x0 = (int)(sx >> 16);
             const int x1 = std::min(x0 + 1, sw - 1);
             const int fx = (int)(sx & 0xffff);
@@ -59,14 +70,17 @@ void stage_batch_u8(const uint8_t* const* frames, const int32_t* dims,
 #pragma omp parallel for schedule(dynamic)
     for (int i = 0; i < k; ++i) {
         const int sh = dims[i * 2], sw = dims[i * 2 + 1];
-        // shortest-side target dims
+        // shortest-side target dims, rounded division (matches the
+        // fallback's (d * size + s / 2) / s exactly)
         int rh, rw;
         if (sw <= sh) {
             rw = size;
-            rh = std::max(size, (int)((int64_t)sh * size / sw));
+            rh = std::max((int64_t)size,
+                          ((int64_t)sh * size + sw / 2) / sw);
         } else {
             rh = size;
-            rw = std::max(size, (int)((int64_t)sw * size / sh));
+            rw = std::max((int64_t)size,
+                          ((int64_t)sw * size + sh / 2) / sh);
         }
         uint8_t* tmp = new uint8_t[(int64_t)rh * rw * 3];
         resize_bilinear_u8(frames[i], sh, sw, tmp, rh, rw);

@@ -5,8 +5,9 @@ HBM bandwidth: read uint8 pixels once, write normalized bf16 once. This
 kernel performs the cast + scale + per-channel mean/std in a single VMEM
 pass over a [rows, W*C] view of the cropped image batch, with the channel
 index recovered as ``lane % 3`` via a 2-D broadcasted iota (TPU needs ≥2-D
-iota). The XLA fallback (`preprocess_batch`) produces identical values; the
-engine picks whichever measures faster on the running platform.
+iota). The XLA path (`preprocess_batch`) produces identical values; the
+engine (``InferenceEngine._use_pallas``) selects this kernel on TPU (or when
+``EngineConfig.preprocess == "pallas"``) and the XLA path elsewhere.
 
 Run on CPU with ``interpret=True`` (tests); compiled on TPU.
 """

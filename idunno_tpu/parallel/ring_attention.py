@@ -69,9 +69,12 @@ def _ring_attention_shard(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     o0 = jnp.zeros((b, h, t_q, d), jnp.float32)
     m0 = jnp.full((b, h, t_q), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, t_q), jnp.float32)
-    if hasattr(jax.lax, "pvary"):
-        # mark the replicated initial carry as device-varying so the loop
-        # carry type matches its output (shard_map vma typing)
+    # mark the replicated initial carry as device-varying so the loop
+    # carry type matches its output (shard_map vma typing)
+    if hasattr(jax.lax, "pcast"):
+        o0, m0, l0 = (jax.lax.pcast(x, (axis_name,), to="varying")
+                      for x in (o0, m0, l0))
+    elif hasattr(jax.lax, "pvary"):          # pragma: no cover - older jax
         o0, m0, l0 = (jax.lax.pvary(x, (axis_name,))
                       for x in (o0, m0, l0))
     o, m, l, _, _ = jax.lax.fori_loop(

@@ -214,9 +214,13 @@ class FileStoreService:
             f.write(blob)
         return version
 
-    def get_bytes(self, sdfs_name: str) -> tuple[bytes, int]:
-        out = self._master_call(Message(MessageType.GET, self.host,
-                                        {"name": sdfs_name}))
+    def get_bytes(self, sdfs_name: str,
+                  version: int | None = None) -> tuple[bytes, int]:
+        """Fetch the latest (or one specific historical) version."""
+        payload: dict = {"name": sdfs_name}
+        if version is not None:
+            payload["version"] = version
+        out = self._master_call(Message(MessageType.GET, self.host, payload))
         return out.blob, int(out.payload["version"])
 
     def get_versions(self, sdfs_name: str, num_versions: int,
@@ -281,7 +285,9 @@ class FileStoreService:
         if msg.type is MessageType.PUT:
             return self._master_put(name, msg.blob)
         if msg.type is MessageType.GET:
-            return self._master_get(name)
+            want = msg.payload.get("version")
+            return self._master_get(name,
+                                    None if want is None else int(want))
         if msg.type is MessageType.GET_VERSIONS:
             return self._master_get_versions(name, int(msg.payload["k"]))
         if msg.type is MessageType.DELETE:
@@ -347,11 +353,15 @@ class FileStoreService:
                 return None
             return self._versions[name], set(self._locations.get(name, set()))
 
-    def _master_get(self, name: str) -> Message:
+    def _master_get(self, name: str, want: int | None = None) -> Message:
         snap = self._snapshot(name)
         if snap is None:
             return self._err("file not found")   # FILE_NOT_EXIST (`:443-448`)
         version, holders = snap
+        if want is not None:
+            if not 1 <= want <= version:
+                return self._err(f"version {want} out of range 1..{version}")
+            version = want
         blob = self._fetch_version(name, version, holders)
         if blob is None:
             return self._err("no holder reachable")
