@@ -1,0 +1,143 @@
+"""Mixture-of-experts model family (switch-routed FFN).
+
+The reference's only multi-model mechanism is two whole-model jobs
+fair-sharing workers (`mp4_machinelearning.py:501-539`); it has no
+conditional computation. This adds a switch-style MoE FFN as a first-class
+model family: a learned router picks the top-1 expert per token, and the
+expert FFNs either all live on every device (``mesh=None``, the dense path
+— also the exact ground truth for tests) or are sharded over a mesh axis
+with all_to_all dispatch (`idunno_tpu.parallel.expert`).
+
+``MoETransformerLM`` is `idunno_tpu.models.transformer.TransformerLM` with
+the switch FFN plugged in via ``ffn_factory`` — by default on every block;
+``moe_every=2`` gives the Switch-Transformer every-other-block layout. It
+therefore composes with ring / Ulysses sequence parallelism for free.
+
+Training: top-1 routing collapses without pressure toward balance, so the
+layer sows the Switch-Transformer auxiliary load-balancing loss
+(E · Σ_e frac_routed_e · mean_prob_e) into the ``"losses"`` collection;
+``moe_aux_loss`` sums it for adding to the task loss.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from idunno_tpu.parallel.expert import (
+    EXPERT_AXIS, expert_parallel_apply, switch_dispatch)
+from idunno_tpu.models.transformer import AttnFn, TransformerLM
+from idunno_tpu.parallel.ring_attention import full_attention
+
+
+class SwitchFFN(nn.Module):
+    """Top-1 routed expert FFN. Input/output [B, T, dim]."""
+
+    dim: int
+    hidden: int
+    n_experts: int
+    capacity_factor: float = 2.0
+    mesh: Mesh | None = None            # None → dense (all experts local)
+    axis: str = EXPERT_AXIS
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def _expert_params(self):
+        e, d, h = self.n_experts, self.dim, self.hidden
+        init = nn.initializers.lecun_normal()
+        return {
+            "w1": self.param("w1", init, (e, d, h), self.param_dtype),
+            "b1": self.param("b1", nn.initializers.zeros, (e, h),
+                             self.param_dtype),
+            "w2": self.param("w2", init, (e, h, d), self.param_dtype),
+            "b2": self.param("b2", nn.initializers.zeros, (e, d),
+                             self.param_dtype),
+        }
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        n = b * t
+        router = nn.Dense(self.n_experts, dtype=jnp.float32,
+                          param_dtype=self.param_dtype, name="router")
+        probs = jax.nn.softmax(router(x.astype(jnp.float32)).reshape(
+            n, self.n_experts))
+        gate_idx = jnp.argmax(probs, axis=-1)
+        gate_w = jnp.max(probs, axis=-1)
+
+        # Switch-Transformer load-balance loss: E · Σ_e f_e · P_e, minimized
+        # (=1) at uniform routing. Without it top-1 routing collapses onto
+        # one expert and capacity drops kill most tokens' FFN output.
+        frac = jax.nn.one_hot(gate_idx, self.n_experts).mean(axis=0)
+        aux = self.n_experts * jnp.sum(frac * probs.mean(axis=0))
+        self.sow("losses", "moe_aux", aux)
+
+        params = self._expert_params()
+        flat = x.reshape(n, d)
+
+        def expert_fn(p, toks):
+            h = jnp.einsum("cd,dh->ch", toks.astype(self.dtype),
+                           p["w1"].astype(self.dtype)) + p["b1"]
+            return (jnp.einsum("ch,hd->cd", nn.gelu(h),
+                               p["w2"].astype(self.dtype))
+                    + p["b2"]).astype(jnp.float32)
+
+        if self.mesh is not None:
+            p_sz = self.mesh.shape[self.axis]
+            cap = self._capacity(n // p_sz)
+            out = expert_parallel_apply(expert_fn, params, flat, gate_idx,
+                                        gate_w, self.mesh, axis=self.axis,
+                                        capacity=cap)
+        else:
+            dispatch, combine = switch_dispatch(
+                gate_idx, gate_w, self.n_experts, self._capacity(n))
+            buf = jnp.einsum("nec,nd->ecd", dispatch, flat)
+            done = jax.vmap(expert_fn)(params, buf)
+            out = jnp.einsum("ecd,nec->nd", done, combine)
+        return out.reshape(b, t, d).astype(x.dtype)
+
+    def _capacity(self, tokens_per_shard: int) -> int:
+        return max(1, int(self.capacity_factor * tokens_per_shard
+                          / self.n_experts))
+
+
+def switch_ffn_factory(n_experts: int, capacity_factor: float = 2.0,
+                       mesh: Mesh | None = None, axis: str = EXPERT_AXIS,
+                       hidden_ratio: int = 4):
+    """An ``ffn_factory`` for `Block`/`TransformerLM` that builds a
+    SwitchFFN in place of the dense MLP."""
+    def make(dim: int, dtype, param_dtype, name: str) -> nn.Module:
+        return SwitchFFN(dim=dim, hidden=dim * hidden_ratio,
+                         n_experts=n_experts,
+                         capacity_factor=capacity_factor, mesh=mesh,
+                         axis=axis, dtype=dtype, param_dtype=param_dtype,
+                         name=name)
+    return make
+
+
+def MoETransformerLM(vocab: int = 1024, dim: int = 128, depth: int = 2,
+                     num_heads: int = 4, n_experts: int = 4,
+                     capacity_factor: float = 2.0, causal: bool = True,
+                     attn_fn: AttnFn = full_attention,
+                     mesh: Mesh | None = None, axis: str = EXPERT_AXIS,
+                     moe_every: int = 1, hidden_ratio: int = 4,
+                     dtype=jnp.float32, param_dtype=jnp.float32
+                     ) -> TransformerLM:
+    """Causal LM with switch-MoE FFNs — `TransformerLM` with the expert
+    layer plugged in every ``moe_every``-th block (1 = all blocks, 2 = the
+    Switch-Transformer interleave)."""
+    return TransformerLM(
+        vocab=vocab, dim=dim, depth=depth, num_heads=num_heads,
+        causal=causal, attn_fn=attn_fn,
+        ffn_factory=switch_ffn_factory(n_experts, capacity_factor, mesh,
+                                       axis, hidden_ratio),
+        ffn_every=moe_every, dtype=dtype, param_dtype=param_dtype)
+
+
+def moe_aux_loss(mutated_collections) -> jnp.ndarray:
+    """Sum every sowed ``moe_aux`` entry (one per MoE block): call
+    ``apply(..., mutable=["losses"])`` and feed the returned collections."""
+    losses = mutated_collections.get("losses", {})
+    return sum(jnp.sum(jnp.asarray(leaf))
+               for leaf in jax.tree.leaves(losses)) if losses else jnp.asarray(0.0)

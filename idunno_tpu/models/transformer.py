@@ -22,6 +22,8 @@ import jax
 from idunno_tpu.parallel.ring_attention import full_attention
 
 AttnFn = Callable[..., jnp.ndarray]     # (q, k, v, *, causal) -> out
+# (dim, dtype, param_dtype, name) -> flax module replacing the dense MLP
+FfnFactory = Callable[..., nn.Module]
 
 
 def rope(x: jnp.ndarray, *, base: float = 10000.0) -> jnp.ndarray:
@@ -65,11 +67,16 @@ class MultiHeadAttention(nn.Module):
 
 
 class Block(nn.Module):
+    """Pre-LN block with pluggable attention AND pluggable FFN — MoE and
+    other conditional-compute families swap the MLP via ``ffn_factory``
+    instead of duplicating the residual wiring."""
+
     dim: int
     num_heads: int
     mlp_ratio: int = 4
     causal: bool = True
     attn_fn: AttnFn = full_attention
+    ffn_factory: FfnFactory | None = None
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -77,19 +84,29 @@ class Block(nn.Module):
     def __call__(self, x):
         ln = partial(nn.LayerNorm, dtype=self.dtype,
                      param_dtype=self.param_dtype)
-        dense = partial(nn.Dense, dtype=self.dtype,
-                        param_dtype=self.param_dtype)
         x = x + MultiHeadAttention(
             self.dim, self.num_heads, causal=self.causal,
             attn_fn=self.attn_fn, dtype=self.dtype,
             param_dtype=self.param_dtype, name="attn")(ln(name="ln1")(x))
-        h = dense(self.dim * self.mlp_ratio, name="mlp_up")(ln(name="ln2")(x))
-        x = x + dense(self.dim, name="mlp_down")(nn.gelu(h))
-        return x
+        h_in = ln(name="ln2")(x)
+        if self.ffn_factory is not None:
+            return x + self.ffn_factory(
+                dim=self.dim, dtype=self.dtype,
+                param_dtype=self.param_dtype, name="ffn")(h_in)
+        dense = partial(nn.Dense, dtype=self.dtype,
+                        param_dtype=self.param_dtype)
+        h = dense(self.dim * self.mlp_ratio, name="mlp_up")(h_in)
+        return x + dense(self.dim, name="mlp_down")(nn.gelu(h))
 
 
 class TransformerLM(nn.Module):
-    """Minimal causal LM for long-context serving/training demos."""
+    """Minimal causal LM for long-context serving/training demos.
+
+    ``ffn_factory`` swaps the dense MLP for another FFN (e.g. a switch-MoE
+    layer) on every ``ffn_every``-th block (counting from the last block
+    backwards, the Switch-Transformer interleaving); the remaining blocks
+    keep the dense MLP.
+    """
 
     vocab: int = 1024
     dim: int = 128
@@ -97,16 +114,24 @@ class TransformerLM(nn.Module):
     num_heads: int = 4
     causal: bool = True
     attn_fn: AttnFn = full_attention
+    ffn_factory: FfnFactory | None = None
+    ffn_every: int = 1
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, tokens):
+        if self.ffn_every < 1:
+            raise ValueError(f"ffn_every={self.ffn_every}: must be >= 1")
         x = nn.Embed(self.vocab, self.dim, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="embed")(tokens)
         for i in range(self.depth):
+            use_ffn = (self.ffn_factory is not None
+                       and (self.depth - 1 - i) % self.ffn_every == 0)
             x = Block(self.dim, self.num_heads, causal=self.causal,
-                      attn_fn=self.attn_fn, dtype=self.dtype,
+                      attn_fn=self.attn_fn,
+                      ffn_factory=self.ffn_factory if use_ffn else None,
+                      dtype=self.dtype,
                       param_dtype=self.param_dtype, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln_f")(x)

@@ -1,0 +1,25 @@
+"""JAX version-compat shims shared by the parallel modules.
+
+One place for the API moves that affect shard_map-based code so the
+ring/ulysses/pipeline/expert implementations can't drift apart:
+  - ``shard_map`` graduated from jax.experimental to jax.* in v0.8
+  - ``pvary`` was replaced by ``pcast(..., to="varying")`` in v0.9
+"""
+from __future__ import annotations
+
+import jax
+
+try:
+    from jax import shard_map  # type: ignore  # noqa: F401  (jax >= 0.8)
+except ImportError:            # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore # noqa: F401
+
+
+def pvary(x, axis_name: str):
+    """Mark a replicated value as device-varying along ``axis_name`` (needed
+    to type shard_map loop carries whose inputs are replicated)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    if hasattr(jax.lax, "pvary"):   # pragma: no cover - older jax
+        return jax.lax.pvary(x, (axis_name,))
+    return x                        # pragma: no cover - very old jax

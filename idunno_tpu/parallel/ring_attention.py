@@ -23,12 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from idunno_tpu.parallel.mesh import DATA_AXIS
-
-try:                       # moved to jax.shard_map in newer releases
-    from jax import shard_map as _shard_map_mod  # type: ignore
-    shard_map = jax.shard_map
-except (ImportError, AttributeError):            # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+from idunno_tpu.parallel._compat import pvary, shard_map
 
 
 def _ring_attention_shard(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -71,12 +66,7 @@ def _ring_attention_shard(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     l0 = jnp.zeros((b, h, t_q), jnp.float32)
     # mark the replicated initial carry as device-varying so the loop
     # carry type matches its output (shard_map vma typing)
-    if hasattr(jax.lax, "pcast"):
-        o0, m0, l0 = (jax.lax.pcast(x, (axis_name,), to="varying")
-                      for x in (o0, m0, l0))
-    elif hasattr(jax.lax, "pvary"):          # pragma: no cover - older jax
-        o0, m0, l0 = (jax.lax.pvary(x, (axis_name,))
-                      for x in (o0, m0, l0))
+    o0, m0, l0 = (pvary(x, axis_name) for x in (o0, m0, l0))
     o, m, l, _, _ = jax.lax.fori_loop(
         0, p, step, (o0, m0, l0, k.astype(jnp.float32),
                      v.astype(jnp.float32)))

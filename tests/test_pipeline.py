@@ -1,0 +1,105 @@
+"""GPipe-style pipeline parallelism on the virtual 8-device mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import Mesh
+from idunno_tpu.parallel.pipeline import (
+    pipeline_apply, split_microbatches, stack_stage_params, STAGE_AXIS)
+
+
+def _stage_mesh(devices, p):
+    return Mesh(np.asarray(devices[:p]), (STAGE_AXIS,))
+
+
+def _dense_stage(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _make_params(key, p, d):
+    keys = jax.random.split(jax.random.PRNGKey(key), p)
+    return [{"w": jax.random.normal(k, (d, d)) / np.sqrt(d),
+             "b": jnp.zeros((d,))} for k in keys]
+
+
+def _sequential(per_stage, x):
+    for sp in per_stage:
+        x = _dense_stage(sp, x)
+    return x
+
+
+def test_pipeline_matches_sequential(eight_devices):
+    p, d, m, mb = 4, 16, 8, 4
+    mesh = _stage_mesh(eight_devices, p)
+    per_stage = _make_params(0, p, d)
+    stacked = stack_stage_params(per_stage)
+    x = jax.random.normal(jax.random.PRNGKey(1), (m * mb, d))
+    micro = split_microbatches(x, m)
+    got = pipeline_apply(_dense_stage, stacked, micro, mesh)
+    want = split_microbatches(_sequential(per_stage, x), m)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_full_eight_stages(eight_devices):
+    p, d, m, mb = 8, 8, 16, 2
+    mesh = _stage_mesh(eight_devices, p)
+    per_stage = _make_params(2, p, d)
+    x = jax.random.normal(jax.random.PRNGKey(3), (m * mb, d))
+    micro = split_microbatches(x, m)
+    got = pipeline_apply(_dense_stage, stack_stage_params(per_stage), micro,
+                         mesh)
+    want = split_microbatches(_sequential(per_stage, x), m)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_is_differentiable(eight_devices):
+    """Same pipeline function serves training: grads flow through the
+    ppermute schedule and match the sequential model's grads."""
+    p, d, m, mb = 4, 8, 4, 2
+    mesh = _stage_mesh(eight_devices, p)
+    per_stage = _make_params(4, p, d)
+    stacked = stack_stage_params(per_stage)
+    x = jax.random.normal(jax.random.PRNGKey(5), (m * mb, d))
+    micro = split_microbatches(x, m)
+
+    def loss_pipe(params):
+        return pipeline_apply(_dense_stage, params, micro, mesh).sum()
+
+    def loss_seq(stacked_params):
+        per = [jax.tree.map(lambda a: a[i], stacked_params)
+               for i in range(p)]
+        return _sequential(per, x).sum()
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4),
+        g_pipe, g_seq)
+
+
+def test_pipeline_transformer_blocks(eight_devices):
+    """Pipeline real flax transformer Blocks (depth = stages)."""
+    from idunno_tpu.models.transformer import Block
+
+    p, dim, heads, m, mb, t = 4, 32, 4, 4, 2, 8
+    mesh = _stage_mesh(eight_devices, p)
+    block = Block(dim=dim, num_heads=heads, causal=True)
+    x0 = jax.random.normal(jax.random.PRNGKey(0), (mb, t, dim))
+    per_stage = [block.init(jax.random.PRNGKey(10 + i), x0)
+                 for i in range(p)]
+
+    def stage_fn(variables, x):
+        return block.apply(variables, x)
+
+    xs = jax.random.normal(jax.random.PRNGKey(1), (m * mb, t, dim))
+    micro = split_microbatches(xs, m)
+    got = pipeline_apply(stage_fn, stack_stage_params(per_stage), micro, mesh)
+    want = xs
+    for sp in per_stage:
+        want = block.apply(sp, want)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(split_microbatches(want, m)),
+                               atol=2e-4, rtol=2e-4)
