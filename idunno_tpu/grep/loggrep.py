@@ -22,6 +22,18 @@ from idunno_tpu.utils.types import MessageType
 SERVICE = "grep"
 MAX_LINES = 10_000       # per-host reply cap; counts stay exact
 
+_REGEX_META = set(".^$*+?{}[]\\|()")
+
+
+def is_literal_pattern(pattern: str) -> bool:
+    """True when the pattern has no regex metacharacters — eligible for the
+    native mmap/OpenMP scanner (`idunno_tpu.native.grep_literal`). Patterns
+    containing line terminators are NOT literal-eligible: the native scanner
+    searches within single lines, while re.search sees the trailing
+    newline."""
+    return not (_REGEX_META & set(pattern)) and "\n" not in pattern \
+        and "\r" not in pattern
+
 
 class LogGrepService:
     def __init__(self, host: str, config: ClusterConfig,
@@ -40,27 +52,59 @@ class LogGrepService:
         if msg.type is not MessageType.GREP:
             return Message(MessageType.ERROR, self.host,
                            {"error": "bad grep verb"})
+        raw = msg.payload["pattern"]
         try:
-            pattern = re.compile(msg.payload["pattern"])
+            pattern = re.compile(raw)
         except re.error as e:
             return Message(MessageType.ERROR, self.host,
                            {"error": f"bad pattern: {e}"})
-        count, lines = self.grep_local(pattern)
+        count, lines = self.grep_local(pattern, raw)
         return Message(MessageType.ACK, self.host,
                        {"count": count, "lines": lines[:MAX_LINES],
                         "truncated": count > MAX_LINES})
 
-    def grep_local(self, pattern: re.Pattern) -> tuple[int, list[str]]:
+    def grep_local(self, pattern: re.Pattern,
+                   raw: str | None = None) -> tuple[int, list[str]]:
+        """Scan this host's log files. Literal patterns take the native
+        mmap/OpenMP scanner; regexes scan line-by-line in Python."""
         count, lines = 0, []
         try:
             log_files = sorted(f for f in os.listdir(self.log_dir)
                                if f.endswith(".log"))
         except FileNotFoundError:
             return 0, []
+        use_native = raw is not None and is_literal_pattern(raw)
         for fn in log_files:
+            path = os.path.join(self.log_dir, fn)
+            if use_native:
+                from idunno_tpu import native
+                room = max(MAX_LINES - len(lines), 0)
+                # hold the fd across scan + line extraction (the native
+                # scanner mmaps /proc/self/fd/N → same inode even if the
+                # log rotates underneath us mid-query)
+                try:
+                    f = open(path, "rb")
+                except OSError:
+                    continue
+                with f:
+                    fd_path = f"/proc/self/fd/{f.fileno()}"
+                    scan_path = fd_path if os.path.exists(fd_path) else path
+                    res = native.grep_literal(scan_path, raw,
+                                              max_offsets=room)
+                    if res is not None:
+                        n, offsets = res
+                        count += n
+                        try:
+                            for off in offsets:
+                                f.seek(off)
+                                text = f.readline().decode(
+                                    errors="replace").rstrip()
+                                lines.append(f"{fn}:{text}")
+                        except OSError:
+                            pass
+                        continue           # next file (native path done)
             try:
-                with open(os.path.join(self.log_dir, fn),
-                          errors="replace") as f:
+                with open(path, errors="replace") as f:
                     for line in f:
                         if pattern.search(line):
                             count += 1

@@ -1,9 +1,13 @@
-"""ctypes binding for the native staging library, built on demand.
+"""ctypes bindings for the native runtime library, built on demand.
 
-``g++ -O3 -march=native -fopenmp`` at first use (cached next to the source,
-keyed by source hash); every entry point has a numpy/PIL fallback so the
-framework works without a toolchain — native is an accelerator, not a
-dependency (the environment provides g++ but no pybind11, hence ctypes).
+One shared object holds every native component — image staging
+(`stage.cc`, the data-loader hot path) and the log-scan engine
+(`grepscan.cc`, the distributed-grep hot path). Built with
+``g++ -O3 -march=native -fopenmp`` at first use (cached next to the
+sources, keyed by their joint hash); every entry point has a pure-Python
+fallback so the framework works without a toolchain — native is an
+accelerator, not a dependency (the environment provides g++ but no
+pybind11, hence ctypes).
 """
 from __future__ import annotations
 
@@ -16,22 +20,26 @@ import threading
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "stage.cc")
+_SOURCES = [os.path.join(_DIR, "stage.cc"),
+            os.path.join(_DIR, "grepscan.cc")]
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _tried = False
 
 
 def _build() -> ctypes.CDLL | None:
-    with open(_SRC, "rb") as f:
-        tag = hashlib.sha256(f.read()).hexdigest()[:16]
-    so_path = os.path.join(_DIR, f"_stage_{tag}.so")
+    h = hashlib.sha256()
+    for src in _SOURCES:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    tag = h.hexdigest()[:16]
+    so_path = os.path.join(_DIR, f"_native_{tag}.so")
     if not os.path.exists(so_path):
         # pid-unique temp so concurrent builds from several local node
         # processes can't interleave writes; os.replace publishes atomically
         tmp = f"{so_path}.{os.getpid()}.tmp"
         cmd = ["g++", "-O3", "-march=native", "-fopenmp", "-shared",
-               "-fPIC", _SRC, "-o", tmp]
+               "-fPIC", *_SOURCES, "-o", tmp]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
             os.replace(tmp, so_path)
@@ -48,6 +56,11 @@ def _build() -> ctypes.CDLL | None:
         ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint8)]
+    lib.grep_literal.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.grep_literal.restype = ctypes.c_int64
     return lib
 
 
@@ -142,3 +155,23 @@ def stage_batch(frames: list[np.ndarray], size: int) -> np.ndarray:
     lib.stage_batch_u8(ptrs, dims.ctypes.data_as(
         ctypes.POINTER(ctypes.c_int32)), k, size, _as_u8_ptr(dst))
     return dst
+
+
+def grep_literal(path: str, needle: str,
+                 max_offsets: int = 10_000) -> tuple[int, list[int]] | None:
+    """Count lines of ``path`` containing the literal ``needle``; also
+    return up to ``max_offsets`` matching line-start byte offsets
+    (ascending). None when the native library is unavailable (caller falls
+    back to the Python scanner) or the file cannot be read."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    offsets = np.empty(max_offsets, np.int64)
+    n_written = ctypes.c_int64(0)
+    total = lib.grep_literal(
+        path.encode(), needle.encode(),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        max_offsets, ctypes.byref(n_written))
+    if total < 0:
+        return None
+    return int(total), offsets[:n_written.value].tolist()

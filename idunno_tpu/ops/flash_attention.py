@@ -1,0 +1,126 @@
+"""Pallas TPU flash attention (blockwise online-softmax attention).
+
+The memory-bound hot op of the transformer family: materializing the full
+[T, T] score matrix costs O(T²) HBM traffic and VMEM; this kernel streams
+K/V blocks through VMEM, keeping only a [block_q, D] accumulator plus the
+online-softmax running max/denominator, so scores never leave the chip.
+Same contract as `idunno_tpu.parallel.ring_attention.full_attention`
+(q/k/v [B, T, H, D] → [B, T, H, D]) and plugs into
+`idunno_tpu.models.transformer.TransformerLM` as ``attn_fn``, or into
+Ulysses sequence parallelism as the per-shard local attention — ring
+attention already achieves the same O(T²)-avoidance across chips; this
+achieves it within a chip.
+
+Grid: (batch·heads, q_blocks, k_blocks); the innermost (k) dimension is
+sequential on TPU, so the scratch accumulators carry across k steps and the
+output block is finalized on the last one. Causal masking skips
+fully-masked k blocks via ``pl.when`` (no wasted MXU work on the upper
+triangle) and applies the intra-block triangle with a broadcasted-iota
+mask.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30                  # safe -inf for masking (avoids inf-inf NaN)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  scale: float, causal: bool, block_q: int, block_k: int):
+    iq, jk = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: block (iq, jk) is dead when its lowest query position is
+    # strictly above its lowest key position's diagonal
+    live = (iq * block_q + block_q - 1 >= jk * block_k) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)                  # [bq, D]
+        k = k_ref[0].astype(jnp.float32)                  # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = jk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+        m_prev = m_ref[:].max(axis=-1, keepdims=True)     # [bq, 1] (bcast)
+        l_prev = l_ref[:].max(axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                            # [bq, bk]
+        l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        l = l_ref[:].max(axis=-1, keepdims=True)
+        l = jnp.where(l == 0.0, 1.0, l)                   # fully-masked rows
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = False, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: bool = False) -> jnp.ndarray:
+    """q/k/v [B, T, H, D] → [B, T, H, D]; T divisible by the block sizes
+    (blocks shrink to T automatically when T is smaller)."""
+    b, t, h, d = q.shape
+    block_q, block_k = min(block_q, t), min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"seq len {t} not divisible by blocks "
+                         f"({block_q}, {block_k})")
+    scale = 1.0 / (d ** 0.5)
+
+    def bh(x):          # [B, T, H, D] -> [B*H, T, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    scratch = [pltpu.VMEM((block_q, d), jnp.float32),    # acc
+               pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+               pltpu.VMEM((block_q, 128), jnp.float32)]  # running denom
+
+    try:        # under shard_map the out aval must carry the varying axes
+        vma = jax.typeof(q).vma
+        out_shape = jax.ShapeDtypeStruct((b * h, t, d), q.dtype, vma=vma)
+    except (AttributeError, TypeError):     # pragma: no cover - older jax
+        out_shape = jax.ShapeDtypeStruct((b * h, t, d), q.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        grid=(b * h, t // block_q, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(bh(q), bh(k), bh(v))
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -34,7 +34,7 @@ from idunno_tpu.parallel.ring_attention import full_attention
 
 
 def _ulysses_shard(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
-                   axis_name: str, causal: bool) -> jnp.ndarray:
+                   axis_name: str, causal: bool, local_attn) -> jnp.ndarray:
     """Per-shard body. q/k/v: [B, T_local, H, D] → same shape."""
     # seq-sharded → head-sharded: split heads into p groups, gather sequence.
     def to_heads(x):
@@ -46,18 +46,24 @@ def _ulysses_shard(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                                   tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)   # [B, T, H/p, D]
-    out = full_attention(qh, kh, vh, causal=causal)
+    out = local_attn(qh, kh, vh, causal=causal)
     return to_seq(out)                                    # [B, T/p, H, D]
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       mesh: Mesh, *, seq_axis: str = DATA_AXIS,
-                      causal: bool = False) -> jnp.ndarray:
+                      causal: bool = False,
+                      local_attn=full_attention) -> jnp.ndarray:
     """Attention with the sequence dim sharded over ``seq_axis``.
 
     q/k/v: [B, T, H, D] global, T divisible by the axis size, H divisible by
     the axis size. Returns [B, T, H, D] with the same sharding — a drop-in
     for ``ring_attention`` where the head count allows it.
+
+    ``local_attn`` is the within-shard attention over the full sequence for
+    the local head group — ``full_attention`` by default, or the Pallas
+    `idunno_tpu.ops.flash_attention.flash_attention` to also avoid the
+    O(T²) score materialization on-chip.
     """
     p = mesh.shape[seq_axis]
     if q.shape[2] % p:
@@ -65,6 +71,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             f"ulysses needs heads ({q.shape[2]}) divisible by the "
             f"{seq_axis!r} axis size ({p}); use ring_attention instead")
     spec = P(None, seq_axis, None, None)
-    fn = functools.partial(_ulysses_shard, axis_name=seq_axis, causal=causal)
+    fn = functools.partial(_ulysses_shard, axis_name=seq_axis, causal=causal,
+                           local_attn=local_attn)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec)(q, k, v)

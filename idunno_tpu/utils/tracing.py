@@ -1,0 +1,118 @@
+"""Tracing and device-side step timing.
+
+The reference has no tracer — its only timing is coarse host wall-clock
+around the whole per-task loop (`alexnet_resnet.py:16,91-92`) and around
+dispatch (`mp4_machinelearning.py:792-804`). On TPU, host wall-clock lies
+twice over: dispatch is async (the Python call returns before the chip
+runs) and the first call includes compilation. This module provides the
+honest primitives the serving metrics (`idunno_tpu.serve.metrics`) and
+benchmarks build on:
+
+- ``device_timed``: wrap a jitted callable so each call blocks until the
+  device result is ready and reports true execution seconds, separately
+  flagging warm-up (compile) calls.
+- ``StepTimer``: accumulate step durations and expose the reference's
+  stats tuple (avg/P25/P50/P75/stddev — the honest version of the c2
+  command, `mp4_machinelearning.py:1232-1254`, without the fudging).
+- ``trace``: context manager around ``jax.profiler`` emitting a TensorBoard
+  trace directory for the wrapped region (XLA per-op device timeline).
+- ``annotate``: named region inside a trace (shows up on the timeline).
+"""
+from __future__ import annotations
+
+import contextlib
+import statistics
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+
+
+@dataclass
+class TimedCall:
+    seconds: float
+    compiled: bool       # False on the first (trace+compile) call
+
+
+def device_timed(fn: Callable[..., Any]) -> Callable[..., tuple[Any, TimedCall]]:
+    """Wrap ``fn`` (typically jitted) → ``(out, TimedCall)`` per call.
+
+    Blocks on the result tree, so ``seconds`` covers actual device
+    execution, not async dispatch.
+    """
+    seen_shapes: set[tuple] = set()
+
+    def wrapped(*args, **kwargs):
+        key = tuple(
+            (getattr(a, "shape", None), str(getattr(a, "dtype", None)))
+            for a in jax.tree.leaves((args, kwargs)))
+        first = key not in seen_shapes
+        seen_shapes.add(key)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        return out, TimedCall(time.perf_counter() - t0, compiled=not first)
+
+    return wrapped
+
+
+@dataclass
+class StepTimer:
+    """Step-duration accumulator with the reference's stats shape."""
+
+    durations_s: list[float] = field(default_factory=list)
+
+    def record(self, seconds: float) -> None:
+        self.durations_s.append(seconds)
+
+    @contextlib.contextmanager
+    def measure(self, result_to_block: Any | None = None):
+        t0 = time.perf_counter()
+        out: dict[str, Any] = {}
+        yield out
+        if "result" in out:
+            jax.block_until_ready(out["result"])
+        elif result_to_block is not None:
+            jax.block_until_ready(result_to_block)
+        self.record(time.perf_counter() - t0)
+
+    def stats(self) -> dict[str, float] | None:
+        """avg / quartiles / stddev over recorded steps (None if empty)."""
+        d = sorted(self.durations_s)
+        if not d:
+            return None
+        n = len(d)
+
+        def pct(p: float) -> float:
+            if n == 1:
+                return d[0]
+            pos = p * (n - 1)
+            lo = int(pos)
+            hi = min(lo + 1, n - 1)
+            return d[lo] + (d[hi] - d[lo]) * (pos - lo)
+
+        return {
+            "count": float(n),
+            "average": sum(d) / n,
+            "p25": pct(0.25),
+            "p50": pct(0.50),
+            "p75": pct(0.75),
+            "stddev": statistics.pstdev(d) if n > 1 else 0.0,
+        }
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Profile the wrapped region into ``log_dir`` (TensorBoard/XPlane
+    format, includes the XLA device timeline)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named sub-region for the profiler timeline."""
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,84 @@
+"""Native mmap/OpenMP log scanner vs the Python fallback."""
+import numpy as np
+import pytest
+
+from idunno_tpu import native
+from idunno_tpu.grep.loggrep import is_literal_pattern
+
+
+def _write_log(path, n_lines=5000, needle="ERROR", every=7):
+    rng = np.random.default_rng(0)
+    lines = []
+    for i in range(n_lines):
+        tag = needle if i % every == 0 else "info"
+        lines.append(f"2026-07-29 12:00:{i % 60:02d} {tag} msg-{i} "
+                     f"x{rng.integers(0, 1e9)}")
+    path.write_text("\n".join(lines) + "\n")
+    return [i for i in range(n_lines) if i % every == 0]
+
+
+def test_is_literal_pattern():
+    assert is_literal_pattern("ERROR")
+    assert is_literal_pattern("msg-123 foo")
+    assert not is_literal_pattern("ERR.R")
+    assert not is_literal_pattern("^start")
+    assert not is_literal_pattern("a|b")
+    # line terminators must stay on the regex path (native scans per line)
+    assert not is_literal_pattern("ERROR\n")
+    assert not is_literal_pattern("a\rb")
+
+
+def test_native_grep_counts_and_offsets(tmp_path):
+    if not native.available():
+        pytest.skip("native library unavailable")
+    log = tmp_path / "host.log"
+    match_idx = _write_log(log, n_lines=5000)
+    res = native.grep_literal(str(log), "ERROR")
+    assert res is not None
+    count, offsets = res
+    assert count == len(match_idx)
+    # offsets point at the starts of exactly the matching lines
+    data = log.read_bytes()
+    for off in offsets[:20]:
+        line = data[off:data.index(b"\n", off)]
+        assert b"ERROR" in line
+    assert sorted(offsets) == offsets
+
+
+def test_native_grep_offset_cap(tmp_path):
+    if not native.available():
+        pytest.skip("native library unavailable")
+    log = tmp_path / "host.log"
+    _write_log(log, n_lines=1000, every=2)
+    count, offsets = native.grep_literal(str(log), "ERROR", max_offsets=10)
+    assert count == 500 and len(offsets) == 10
+
+
+def test_native_grep_missing_file():
+    if not native.available():
+        pytest.skip("native library unavailable")
+    assert native.grep_literal("/nonexistent/x.log", "a") is None
+
+
+def test_grep_service_native_matches_python(tmp_path):
+    """The service returns identical results whether the literal goes
+    through the native scanner or the Python regex path."""
+    import re
+    from idunno_tpu.comm.inproc import InProcNetwork
+    from idunno_tpu.config import ClusterConfig
+    from idunno_tpu.grep.loggrep import LogGrepService
+    from idunno_tpu.membership.service import MembershipService
+
+    cfg = ClusterConfig(hosts=("a",), coordinator="a",
+                        standby_coordinator="a", introducer="a")
+    net = InProcNetwork()
+    t = net.transport("a")
+    members = MembershipService("a", cfg, t)
+    svc = LogGrepService("a", cfg, t, members, log_dir=str(tmp_path))
+    _write_log(tmp_path / "host.log", n_lines=2000)
+
+    pat = re.compile("ERROR")
+    count_py, lines_py = svc.grep_local(pat, raw=None)       # python path
+    count_nat, lines_nat = svc.grep_local(pat, raw="ERROR")  # native path
+    assert count_nat == count_py
+    assert lines_nat == lines_py
