@@ -1,0 +1,80 @@
+"""Language-model training step — the long-context family's training path.
+
+Mirrors `idunno_tpu.engine.train` (which trains the reference's CNN
+families) for `idunno_tpu.models.transformer.TransformerLM` and the MoE
+variant: next-token cross-entropy, the sowed Switch aux load-balancing loss
+folded in with a coefficient, and the same TrainState/placement utilities —
+so DP, FSDP (ZeRO-3), tensor, sequence (ring/Ulysses attention via
+``attn_fn``) and expert parallelism all compose with training through
+sharding annotations alone.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from idunno_tpu.engine.train import TrainState
+from idunno_tpu.models.moe import moe_aux_loss
+from idunno_tpu.parallel.mesh import DATA_AXIS
+
+
+def create_lm_train_state(model: nn.Module, rng: jax.Array, seq_len: int,
+                          tx: optax.GradientTransformation,
+                          batch: int = 1) -> TrainState:
+    tokens = jnp.zeros((batch, seq_len), jnp.int32)
+    variables = model.init(rng, tokens)
+    params = variables["params"]
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      batch_stats={}, opt_state=tx.init(params))
+
+
+def make_lm_train_step(model: nn.Module, tx: optax.GradientTransformation,
+                       aux_coef: float = 0.01):
+    """Pure ``(state, tokens[int32 B,T]) -> (state, metrics)``: next-token
+    CE (targets = tokens rolled left one, final position masked — keeps the
+    model input length T so sequence sharding divisibility is preserved),
+    plus ``aux_coef`` × the sowed MoE balance loss (zero for dense
+    models)."""
+
+    def loss_fn(params, tokens):
+        logits, updates = model.apply({"params": params}, tokens,
+                                      mutable=["losses"])
+        targets = jnp.roll(tokens, -1, axis=1)
+        t = tokens.shape[1]
+        mask = (jnp.arange(t) < t - 1).astype(jnp.float32)[None, :]
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(log_probs, targets[..., None],
+                                   axis=-1)[..., 0]
+        denom = mask.sum() * tokens.shape[0]
+        ce = (nll * mask).sum() / denom
+        aux = moe_aux_loss(updates)
+        acc = ((jnp.argmax(logits, -1) == targets) * mask).sum() / denom
+        return ce + aux_coef * aux, (ce, aux, acc)
+
+    def train_step(state: TrainState, tokens: jnp.ndarray):
+        (loss, (ce, aux, acc)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params, tokens)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  opt_state=new_opt)
+        return new_state, {"loss": loss, "ce": ce, "aux": aux,
+                           "accuracy": acc}
+
+    return train_step
+
+
+def jit_lm_train_step(model: nn.Module, tx: optax.GradientTransformation,
+                      mesh: Mesh, aux_coef: float = 0.01, *,
+                      sequence_parallel: bool = False,
+                      axis: str = DATA_AXIS):
+    """jit the LM step over the mesh. Tokens [B, T] are sharded on the
+    batch dim over ``axis`` by default; with ``sequence_parallel=True``
+    they are sharded on the SEQUENCE dim instead (``axis`` must then match
+    the ``seq_axis`` of the model's ring/Ulysses ``attn_fn``)."""
+    step = make_lm_train_step(model, tx, aux_coef)
+    spec = P(None, axis) if sequence_parallel else P(axis)
+    return jax.jit(step, in_shardings=(None, NamedSharding(mesh, spec)))
