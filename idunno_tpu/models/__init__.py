@@ -13,12 +13,15 @@ import flax.linen as nn
 
 from idunno_tpu.models.alexnet import AlexNet
 from idunno_tpu.models.resnet import ResNet, resnet18, resnet34
+from idunno_tpu.models.vit import ViT, vit_s16, vit_tiny
 
 _REGISTRY: dict[str, Callable[..., nn.Module]] = {
     "alexnet": AlexNet,
     "resnet": resnet18,      # the reference's "resnet" means ResNet-18
     "resnet18": resnet18,
     "resnet34": resnet34,
+    "vit": vit_s16,
+    "vit_tiny": vit_tiny,
 }
 
 
@@ -38,5 +41,5 @@ def register_model(name: str, factory: Callable[..., nn.Module]) -> None:
     _REGISTRY[name] = factory
 
 
-__all__ = ["AlexNet", "ResNet", "resnet18", "resnet34", "create_model",
-           "available_models", "register_model"]
+__all__ = ["AlexNet", "ResNet", "ViT", "resnet18", "resnet34", "vit_s16",
+           "vit_tiny", "create_model", "available_models", "register_model"]

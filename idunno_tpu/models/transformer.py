@@ -77,6 +77,7 @@ class Block(nn.Module):
     causal: bool = True
     attn_fn: AttnFn = full_attention
     ffn_factory: FfnFactory | None = None
+    use_rope: bool = True
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -86,7 +87,7 @@ class Block(nn.Module):
                      param_dtype=self.param_dtype)
         x = x + MultiHeadAttention(
             self.dim, self.num_heads, causal=self.causal,
-            attn_fn=self.attn_fn, dtype=self.dtype,
+            attn_fn=self.attn_fn, use_rope=self.use_rope, dtype=self.dtype,
             param_dtype=self.param_dtype, name="attn")(ln(name="ln1")(x))
         h_in = ln(name="ln2")(x)
         if self.ffn_factory is not None:

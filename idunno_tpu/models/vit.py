@@ -1,0 +1,78 @@
+"""Vision Transformer — a third servable image-classification family.
+
+The reference serves exactly two torchvision CNNs
+(`alexnet_resnet.py:17-22`); the registry here is extensible
+(`idunno_tpu.models.register_model`) and ViT demonstrates that the serving
+engine, scheduler, and shell are model-agnostic: ViT drops into
+`InferenceEngine` through the same ``(images, train=False) → logits``
+contract as AlexNet/ResNet, and is an even better MXU fit (its FLOPs are
+plain batched matmuls).
+
+Reuses the pre-LN `idunno_tpu.models.transformer.Block` (bidirectional, no
+RoPE — learned position embeddings, the standard ViT recipe), so kernel
+improvements (e.g. the Pallas flash attention ``attn_fn``) apply to the
+vision family automatically.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from idunno_tpu.models.transformer import AttnFn, Block
+from idunno_tpu.parallel.ring_attention import full_attention
+
+
+class ViT(nn.Module):
+    """ViT-/16 style classifier over NHWC uint8-preprocessed images."""
+
+    num_classes: int = 1000
+    patch: int = 16
+    dim: int = 384            # ViT-S defaults
+    depth: int = 12
+    num_heads: int = 6
+    attn_fn: AttnFn = full_attention
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        b, h, w, _ = x.shape
+        if h % self.patch or w % self.patch:
+            raise ValueError(f"image {h}x{w} not divisible by "
+                             f"patch {self.patch}")
+        x = nn.Conv(self.dim, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), padding="VALID",
+                    dtype=self.dtype, param_dtype=self.param_dtype,
+                    name="embed")(x.astype(self.dtype))
+        n = (h // self.patch) * (w // self.patch)
+        x = x.reshape(b, n, self.dim)
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.dim),
+                         self.param_dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.dim)).astype(
+            self.dtype), x], axis=1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, n + 1, self.dim), self.param_dtype)
+        x = x + pos.astype(self.dtype)
+        for i in range(self.depth):
+            x = Block(self.dim, self.num_heads, causal=False,
+                      attn_fn=self.attn_fn, use_rope=False,
+                      dtype=self.dtype, param_dtype=self.param_dtype,
+                      name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln_f")(x)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          param_dtype=self.param_dtype, name="head")(x[:, 0])
+        return logits.astype(jnp.float32)
+
+
+def vit_s16(**kwargs) -> ViT:
+    return ViT(**kwargs)
+
+
+def vit_tiny(**kwargs) -> ViT:
+    """ViT-Ti/16 — small enough for CPU-mesh tests."""
+    kwargs.setdefault("dim", 192)
+    kwargs.setdefault("depth", 4)
+    kwargs.setdefault("num_heads", 3)
+    return ViT(**kwargs)

@@ -32,7 +32,8 @@ _NEG_INF = -1e30                  # safe -inf for masking (avoids inf-inf NaN)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale: float, causal: bool, block_q: int, block_k: int):
+                  scale: float, causal: bool, block_q: int, block_k: int,
+                  seq_len: int):
     iq, jk = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -54,12 +55,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        k_pos = jk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
             q_pos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            k_pos = jk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        if seq_len % block_k:            # ragged tail: mask padded keys
+            s = jnp.where(k_pos < seq_len, s, _NEG_INF)
 
         m_prev = m_ref[:].max(axis=-1, keepdims=True)     # [bq, 1] (bcast)
         l_prev = l_ref[:].max(axis=-1, keepdims=True)
@@ -86,34 +89,37 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     causal: bool = False, block_q: int = 128,
                     block_k: int = 128,
                     interpret: bool = False) -> jnp.ndarray:
-    """q/k/v [B, T, H, D] → [B, T, H, D]; T divisible by the block sizes
-    (blocks shrink to T automatically when T is smaller)."""
+    """q/k/v [B, T, H, D] → [B, T, H, D]. Ragged T is padded up to the
+    block size internally (padded keys are masked, padded query rows are
+    sliced off), so any sequence length works — e.g. ViT's n_patches+1."""
     b, t, h, d = q.shape
     block_q, block_k = min(block_q, t), min(block_k, t)
-    if t % block_q or t % block_k:
-        raise ValueError(f"seq len {t} not divisible by blocks "
-                         f"({block_q}, {block_k})")
+    t_pad = -(-t // block_q) * block_q
+    t_pad = -(-t_pad // block_k) * block_k
+    if t_pad != t:
+        pad = [(0, 0), (0, t_pad - t), (0, 0), (0, 0)]
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
     scale = 1.0 / (d ** 0.5)
 
-    def bh(x):          # [B, T, H, D] -> [B*H, T, D]
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    def bh(x):          # [B, T_pad, H, D] -> [B*H, T_pad, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t_pad, d)
 
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k, seq_len=t)
     scratch = [pltpu.VMEM((block_q, d), jnp.float32),    # acc
                pltpu.VMEM((block_q, 128), jnp.float32),  # running max
                pltpu.VMEM((block_q, 128), jnp.float32)]  # running denom
 
     try:        # under shard_map the out aval must carry the varying axes
         vma = jax.typeof(q).vma
-        out_shape = jax.ShapeDtypeStruct((b * h, t, d), q.dtype, vma=vma)
+        out_shape = jax.ShapeDtypeStruct((b * h, t_pad, d), q.dtype, vma=vma)
     except (AttributeError, TypeError):     # pragma: no cover - older jax
-        out_shape = jax.ShapeDtypeStruct((b * h, t, d), q.dtype)
+        out_shape = jax.ShapeDtypeStruct((b * h, t_pad, d), q.dtype)
 
     out = pl.pallas_call(
         kernel,
         out_shape=out_shape,
-        grid=(b * h, t // block_q, t // block_k),
+        grid=(b * h, t_pad // block_q, t_pad // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
@@ -123,4 +129,4 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         scratch_shapes=scratch,
         interpret=interpret,
     )(bh(q), bh(k), bh(v))
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, t_pad, d).transpose(0, 2, 1, 3)[:, :t]

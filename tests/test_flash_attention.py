@@ -36,10 +36,17 @@ def test_flash_single_block():
                                atol=2e-5, rtol=2e-5)
 
 
-def test_flash_rejects_ragged_seq():
-    q, k, v = _qkv(2, t=96)
-    with pytest.raises(ValueError, match="not divisible"):
-        flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+@pytest.mark.parametrize("t,causal", [(96, False), (96, True), (17, False),
+                                      (65, True)])
+def test_flash_ragged_seq_padded_and_masked(t, causal):
+    """T not divisible by the blocks: internal padding + key masking must
+    be invisible (ViT's n_patches+1 token counts hit this constantly)."""
+    q, k, v = _qkv(2, t=t)
+    want = full_attention(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
 
 
 def test_flash_as_transformer_attn_fn():

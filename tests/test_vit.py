@@ -1,0 +1,68 @@
+"""ViT model family: forward contract + serving through the engine."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idunno_tpu.models import available_models, create_model
+from idunno_tpu.models.vit import ViT
+
+
+def test_vit_registered():
+    assert "vit" in available_models()
+    assert "vit_tiny" in available_models()
+
+
+def test_vit_forward_shape():
+    model = create_model("vit_tiny")
+    x = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 1000)
+    assert logits.dtype == jnp.float32
+    # token count: (64/16)^2 + cls
+    assert variables["params"]["pos_embed"].shape == (1, 17, 192)
+
+
+def test_vit_rejects_ragged_patches():
+    model = ViT(patch=16)
+    with pytest.raises(ValueError, match="not divisible"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 65, 65, 3)),
+                   train=False)
+
+
+def test_vit_serves_through_engine(eight_devices):
+    """The engine is model-agnostic: ViT serves a query range exactly like
+    the reference's two CNNs."""
+    from idunno_tpu.config import EngineConfig
+    from idunno_tpu.engine.inference import InferenceEngine
+    from idunno_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8, 1, devices=eight_devices)
+    eng = InferenceEngine(EngineConfig(batch_size=8), mesh=mesh,
+                          pretrained=False)
+    res = eng.infer("vit_tiny", 0, 15)
+    assert len(res.records) == 16
+    name, category, prob = res.records[0]
+    assert name == "test_0.JPEG" and 0.0 <= prob <= 1.0
+
+
+def test_vit_with_flash_attention():
+    """Flash attention slots into the vision family via attn_fn — ViT's
+    ragged token count (16 patches + cls = 17) exercises the padded path,
+    and logits must match the dense-attention model exactly."""
+    from idunno_tpu.ops.flash_attention import flash_attention
+
+    flash = functools.partial(flash_attention, block_q=16, block_k=16,
+                              interpret=True)
+    kw = dict(patch=16, dim=64, depth=1, num_heads=4, num_classes=10)
+    model_flash = ViT(**kw, attn_fn=flash)
+    model_ref = ViT(**kw)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 64, 3))
+    variables = model_ref.init(jax.random.PRNGKey(1), x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(model_flash.apply(variables, x, train=False)),
+        np.asarray(model_ref.apply(variables, x, train=False)),
+        atol=2e-4, rtol=2e-4)
