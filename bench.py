@@ -6,8 +6,14 @@ disk over SDFS before inferring (`README.md:37-38`) — then the timed region
 runs the framework's own compute path: fused uint8→normalized preprocess +
 bf16 batched forward on the MXU + device-side top-1, a `lax.scan` over all
 staged batches in one dispatch. Reported value is steady-state images/sec on
-the visible chip(s); end-to-end numbers including host→device streaming are
-in ``details``.
+the visible chip(s) at the best batch size from a sweep; MFU is computed from
+analytic ResNet-18 forward FLOPs against the chip's peak bf16 rate.
+
+Robustness contract (round-1 VERDICT item 1): this script ALWAYS prints
+exactly one JSON line on stdout, no matter what the backend does — init is
+run under a watchdog thread with bounded retries, and on failure the line
+carries ``value: null`` plus an ``error`` and diagnostics (and a CPU-subprocess
+fallback measurement, so a dead TPU round still records a number somewhere).
 
 Baseline: the reference serves a 400-image ResNet-18 query in ~9 s across its
 10-VM CPU cluster (`mp4_report_group1.pdf` p.1-2 worked example; SURVEY.md §6)
@@ -17,73 +23,269 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
 
-
 REFERENCE_IMAGES_PER_S = 400 / 9.0   # ≈44.4, whole reference cluster
+METRIC = "resnet18_imagenet_inference_throughput"
+
+# Peak dense bf16 FLOP/s per chip, keyed by substrings of device_kind.
+# (Public figures: v2 45T, v3 123T, v4 275T, v5e 197T, v5p 459T, v6e 918T.)
+_PEAK_BF16 = [
+    ("v6e", 918e12), ("v6", 918e12),
+    ("v5p", 459e12), ("v5e", 197e12), ("v5lite", 197e12), ("v5", 459e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+]
 
 
-def main() -> None:
+def resnet18_forward_flops(image_size: int = 224) -> float:
+    """Analytic forward FLOPs/image for torchvision-shape ResNet-18
+    (1 MAC = 2 FLOPs; convs + downsamples + fc; elementwise ignored)."""
+    def conv(h, w, cin, cout, k, stride):
+        oh, ow = h // stride, w // stride
+        return 2.0 * oh * ow * cout * k * k * cin, oh, ow
+
+    total, h, w = 0.0, image_size, image_size
+    f, h, w = conv(h, w, 3, 64, 7, 2)
+    total += f
+    h, w = h // 2, w // 2                      # maxpool /2
+    cin = 64
+    for stage, cout in enumerate((64, 128, 256, 512)):
+        for block in range(2):
+            stride = 2 if stage > 0 and block == 0 else 1
+            f, h, w = conv(h, w, cin, cout, 3, stride)
+            total += f
+            f, _, _ = conv(h, w, cout, cout, 3, 1)
+            total += f
+            if stride != 1 or cin != cout:     # projection downsample
+                total += 2.0 * h * w * cout * cin
+            cin = cout
+    total += 2.0 * 512 * 1000                  # fc
+    return total
+
+
+def emit(value, unit="images/sec", vs_baseline=None, error=None, **details):
+    line = {"metric": METRIC, "value": value, "unit": unit,
+            "vs_baseline": vs_baseline}
+    if error is not None:
+        line["error"] = error
+    if details:
+        line["details"] = details
+    print(json.dumps(line))
+    sys.stdout.flush()
+
+
+def probe_backend(timeout_s: float):
+    """Initialise the jax backend under a watchdog. Returns
+    (devices|None, error|None). A hang leaves a daemon thread behind —
+    callers must treat the in-process backend as unusable after that."""
+    box: dict = {}
+
+    def target():
+        try:
+            import jax
+            # The image's sitecustomize imports jax at interpreter startup,
+            # so JAX_PLATFORMS in the env is too late for platform selection;
+            # push it through the live config before backend init.
+            plat = os.environ.get("JAX_PLATFORMS")
+            if plat:
+                try:
+                    jax.config.update("jax_platforms", plat)
+                except Exception:  # noqa: BLE001
+                    pass
+            box["devices"] = jax.devices()
+        except Exception as e:  # noqa: BLE001 - diagnostics, not control flow
+            box["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=target, daemon=True, name="backend-probe")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return None, f"backend init hung > {timeout_s:.0f}s"
+    return box.get("devices"), box.get("error")
+
+
+def cpu_fallback_record(budget_s: float) -> dict | None:
+    """Run a small CPU-mesh bench in a SUBPROCESS (the in-process backend may
+    be wedged) and return its parsed JSON line, or None."""
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu", BENCH_NO_FALLBACK="1",
+               BENCH_BATCH="64", BENCH_NBATCH="2", BENCH_ITERS="2",
+               BENCH_SWEEP="64", BENCH_INIT_TIMEOUT="60")
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=budget_s)
+        for ln in out.stdout.splitlines():
+            ln = ln.strip()
+            if ln.startswith("{"):
+                return json.loads(ln)
+    except Exception:  # noqa: BLE001
+        pass
+    return None
+
+
+def run_bench(devices) -> None:
     import numpy as np
     import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from idunno_tpu.config import EngineConfig
     from idunno_tpu.engine.inference import InferenceEngine
-    from idunno_tpu.parallel.mesh import local_mesh
+    from idunno_tpu.parallel.mesh import DATA_AXIS, local_mesh
 
-    batch_size = int(os.environ.get("BENCH_BATCH", "512"))
-    n_batches = int(os.environ.get("BENCH_NBATCH", "8"))
-    iters = int(os.environ.get("BENCH_ITERS", "5"))
-    n_images = batch_size * n_batches
+    t_start = time.perf_counter()
+    budget_s = float(os.environ.get("BENCH_TIME_BUDGET_S", "420"))
+    base_bs = int(os.environ.get("BENCH_BATCH", "512"))
+    n_batches = int(os.environ.get("BENCH_NBATCH", "4"))
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    sweep = [int(s) for s in
+             os.environ.get("BENCH_SWEEP", "256,512,1024").split(",")]
+    platform = devices[0].platform
+    device_kind = getattr(devices[0], "device_kind", platform)
+
+    n_images = max(sweep + [base_bs]) * max(n_batches, 1)
 
     mesh = local_mesh()
-    eng = InferenceEngine(EngineConfig(batch_size=batch_size), mesh=mesh,
-                          pretrained=False)
+    n_data = mesh.shape[DATA_AXIS]
 
     rng = np.random.default_rng(0)
     images = rng.integers(0, 256, size=(n_images, 256, 256, 3),
                           dtype=np.uint8)
 
+    # One H2D transfer for the whole sweep (the tunnel to the chip is slow);
+    # device_put straight from numpy shards from host in a single pass, and
+    # per-batch-size staging then reshapes the device-resident block.
     t0 = time.perf_counter()
-    staged, n = eng.stage(images)
-    idx, prob = eng.infer_staged("resnet", staged, n)   # compile + warmup
-    stage_and_compile_s = time.perf_counter() - t0
+    flat = jax.device_put(images, NamedSharding(mesh, P(DATA_AXIS)))
+    np.asarray(flat[0, 0, 0])      # force completion (block_until_ready is
+    transfer_s = time.perf_counter() - t0   # unreliable through the tunnel)
 
-    times = []
-    for _ in range(iters):
+    def staged_for(bs: int):
+        k = n_images // bs
+        arr = flat[:k * bs].reshape(k, bs, 256, 256, 3)
+        return jax.device_put(arr, NamedSharding(mesh, P(None, DATA_AXIS))), k
+
+    flops_img = resnet18_forward_flops(224)
+    peak = None
+    if platform == "tpu":
+        kind = device_kind.lower().replace(" ", "")
+        for key, val in _PEAK_BF16:
+            if key in kind:
+                peak = val * len(devices)
+                break
+
+    sweep_out, best = [], None
+    engine = None
+    seen_bs: set[int] = set()
+    for bs in sweep:
+        if bs % n_data:
+            bs = -(-bs // n_data) * n_data     # divisible over the data axis
+        if bs in seen_bs or bs > n_images:
+            continue                           # dup after rounding / too big
+        seen_bs.add(bs)
+        elapsed = time.perf_counter() - t_start
+        if best is not None and elapsed > budget_s * 0.75:
+            sweep_out.append({"batch_size": bs, "skipped": "time budget"})
+            continue
+        engine = InferenceEngine(EngineConfig(batch_size=bs), mesh=mesh,
+                                 pretrained=False)
+        staged, k = staged_for(bs)
         t0 = time.perf_counter()
-        idx, prob = eng.infer_staged("resnet", staged, n)
-        times.append(time.perf_counter() - t0)
-    per_run = float(np.median(times))
-    images_per_s = n_images / per_run
+        idx, prob = engine.infer_staged("resnet", staged, k * bs)  # compile
+        compile_s = time.perf_counter() - t0
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            idx, prob = engine.infer_staged("resnet", staged, k * bs)
+            times.append(time.perf_counter() - t0)   # infer_staged returns
+        per_run = float(np.median(times))            # np arrays: D2H synced
+        ips = (k * bs) / per_run
+        row = {"batch_size": bs, "images_per_s": round(ips, 1),
+               "median_run_s": round(per_run, 4),
+               "compile_s": round(compile_s, 2)}
+        if peak:
+            row["mfu"] = round(ips * flops_img / peak, 4)
+        sweep_out.append(row)
+        if best is None or ips > best["images_per_s"]:
+            best = row
 
-    # end-to-end including host→device streaming of the raw uint8 images
+    if best is None:
+        emit(None, error="every sweep batch size exceeded the image count",
+             sweep=sweep, n_images=n_images)
+        return
+
+    # end-to-end including host→device streaming of raw uint8 images
+    bs = best["batch_size"]
+    e2e_engine = InferenceEngine(EngineConfig(batch_size=bs), mesh=mesh,
+                                 pretrained=False)
     t0 = time.perf_counter()
-    eng.infer_batch("resnet", images[:batch_size])
+    e2e_engine.infer_batch("resnet", images[:bs])
     e2e_s = time.perf_counter() - t0
-    e2e_images_per_s = batch_size / e2e_s
 
-    result = {
-        "metric": "resnet18_imagenet_inference_throughput",
-        "value": round(images_per_s, 1),
-        "unit": "images/sec",
-        "vs_baseline": round(images_per_s / REFERENCE_IMAGES_PER_S, 2),
-        "details": {
-            "methodology": "HBM-staged dataset, single-dispatch scan",
-            "batch_size": batch_size,
-            "n_images": n_images,
-            "iters": iters,
-            "median_run_s": round(per_run, 4),
-            "p50_query_latency_s_400imgs": round(400 / images_per_s, 4),
-            "stage_and_compile_s": round(stage_and_compile_s, 2),
-            "e2e_streaming_images_per_s": round(e2e_images_per_s, 1),
-            "n_devices": len(jax.devices()),
-            "baseline_images_per_s": round(REFERENCE_IMAGES_PER_S, 1),
-        },
-    }
-    print(json.dumps(result))
+    # Pallas preprocess must not have silently fallen back on TPU
+    # (round-1 VERDICT weak #2: engine auto-fallback hides broken kernels).
+    pallas = ("compiled" if e2e_engine._pallas_ok
+              else ("n/a (cpu)" if platform != "tpu" else "FALLBACK_TO_XLA"))
+    error = None
+    if platform == "tpu" and not e2e_engine._pallas_ok:
+        error = "pallas preprocess kernel failed to compile on TPU; ran XLA path"
+
+    ips = best["images_per_s"]
+    emit(ips, vs_baseline=round(ips / REFERENCE_IMAGES_PER_S, 2), error=error,
+         methodology="HBM-staged dataset, single-dispatch lax.scan sweep",
+         platform=platform, device_kind=device_kind, n_devices=len(devices),
+         mfu=best.get("mfu"), peak_bf16_flops=peak,
+         flops_per_image=round(flops_img / 1e9, 3),
+         best_batch_size=best["batch_size"], sweep=sweep_out,
+         n_images=n_images, iters=iters,
+         h2d_transfer_s=round(transfer_s, 2),
+         p50_query_latency_s_400imgs=round(400 / ips, 4),
+         e2e_streaming_images_per_s=round(bs / e2e_s, 1),
+         pallas_preprocess=pallas,
+         baseline_images_per_s=round(REFERENCE_IMAGES_PER_S, 1),
+         wall_s=round(time.perf_counter() - t_start, 1))
+
+
+def main() -> None:
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "150"))
+    retries = int(os.environ.get("BENCH_INIT_RETRIES", "2"))
+    attempts = []
+    devices = None
+    for i in range(max(1, retries)):
+        devices, err = probe_backend(init_timeout)
+        attempts.append(err or "ok")
+        if devices:
+            break
+        if err and "hung" in err:
+            break            # a wedged backend won't unwedge in-process
+        time.sleep(5)
+
+    if not devices:
+        diag = {
+            "attempts": attempts,
+            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", ""),
+            "init_timeout_s": init_timeout,
+        }
+        if os.environ.get("BENCH_NO_FALLBACK") != "1":
+            fb = cpu_fallback_record(budget_s=240)
+            if fb:
+                diag["cpu_fallback"] = fb
+        emit(None, error=f"TPU backend unavailable: {attempts[-1]}", **diag)
+        # rc 0: the JSON line IS the result; a non-zero rc made round 1
+        # record parsed=null.
+        return
+
+    try:
+        run_bench(devices)
+    except Exception as e:  # noqa: BLE001 - bench must always emit JSON
+        import traceback
+        emit(None, error=f"bench failed: {type(e).__name__}: {e}",
+             traceback=traceback.format_exc()[-2000:])
 
 
 if __name__ == "__main__":

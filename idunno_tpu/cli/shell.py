@@ -241,24 +241,33 @@ class Shell:
 
     def cmd_c2(self, args: list[str]) -> str:
         svc = self.node.inference
+        prov = svc.weights_provenance()
         rows = []
         for m in self._models_seen():
             s = svc.metrics.processing_stats(m)
+            w = prov.get(m, "unknown")
             if s is None:
-                rows.append(f"{m}: (no data in window)")
+                rows.append(f"{m}: (no data in window) weights={w}")
             else:
                 rows.append(f"{m}: avg={s.avg:.3f}s q1={s.q1:.3f}s "
                             f"median={s.q2:.3f}s q3={s.q3:.3f}s "
-                            f"stddev={s.stddev:.3f}s n={s.n}")
+                            f"stddev={s.stddev:.3f}s n={s.n} weights={w}")
         return "\n".join(rows) or "(no queries yet)"
 
     def cmd_c4(self, args: list[str]) -> str:
-        results = self.node.inference.all_results()
+        svc = self.node.inference
+        results = svc.all_results()
+        prov = svc.weights_provenance()
         path = args[0] if args else "result.txt"
+        # flat {"model qnum": records} map — the reference's c4 contract
+        # (`:1208-1211`); provenance goes to the shell line only, so file
+        # consumers that iterate entries see records and nothing else.
         with open(path, "w") as f:
             json.dump(results, f, indent=1)
         n = sum(len(v) for v in results.values())
-        return f"wrote {n} records across {len(results)} queries -> {path}"
+        wdesc = ", ".join(f"{m}={w}" for m, w in sorted(prov.items()))
+        return (f"wrote {n} records across {len(results)} queries -> {path}"
+                + (f" (weights: {wdesc})" if wdesc else ""))
 
     def cmd_cvm(self, args: list[str]) -> str:
         book = self.node.inference.scheduler.book

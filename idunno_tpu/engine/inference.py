@@ -42,11 +42,16 @@ from idunno_tpu.parallel.sharding import (
 @dataclass
 class QueryResult:
     """One executed (sub)query — the reference's return contract
-    (`alexnet_resnet.py:92`) plus throughput accounting."""
+    (`alexnet_resnet.py:92`) plus throughput accounting.
+
+    ``weights`` is the provenance marker ("pretrained" | "random"): random
+    init must never masquerade as real classifications (round-1 VERDICT
+    weak #6 — silent random-weight serving)."""
 
     model: str
     records: list[tuple[str, str, float]]   # (image_name, category, prob)
     elapsed_s: float
+    weights: str = "unknown"
 
     @property
     def images_per_s(self) -> float:
@@ -59,6 +64,7 @@ class _LoadedModel:
     variables: Any          # on-device, replicated
     predict: Any            # jitted (variables, u8 batch) -> (idx, prob)
     predict_many: Any       # jitted (variables, u8 [K,B,...]) -> ([K,B], [K,B])
+    provenance: str = "random"   # "pretrained" | "random"
 
 
 class InferenceEngine:
@@ -89,13 +95,19 @@ class InferenceEngine:
         module = create_model(name,
                               dtype=jnp.dtype(self.config.compute_dtype),
                               param_dtype=jnp.dtype(self.config.param_dtype))
-        variables = None
+        variables, provenance = None, "random"
         if self.pretrained:
             from idunno_tpu.models.convert import try_load_torchvision
             variables = try_load_torchvision(name)
             if variables is not None:
                 variables = jax.tree.map(jnp.asarray, variables)
+                provenance = "pretrained"
         if variables is None:
+            if self.pretrained:
+                import logging
+                logging.getLogger("idunno.engine").warning(
+                    "no cached pretrained checkpoint for %s: serving RANDOM "
+                    "weights (results carry weights='random')", name)
             rng = jax.random.PRNGKey(self.seed)
             dummy = jnp.zeros((1, self.config.image_size,
                                self.config.image_size, 3), jnp.float32)
@@ -104,7 +116,14 @@ class InferenceEngine:
         predict, predict_many = self._build_predict(module)
         self._models[name] = _LoadedModel(
             module=module, variables=variables,
-            predict=predict, predict_many=predict_many)
+            predict=predict, predict_many=predict_many,
+            provenance=provenance)
+
+    def weights_provenance(self, name: str) -> str:
+        """"pretrained" | "random" for an already-loaded model; "unknown"
+        if not loaded (never triggers a load just to read a string)."""
+        m = self._models.get(name)
+        return m.provenance if m else "unknown"
 
     def _use_pallas(self) -> bool:
         mode = self.config.preprocess
@@ -147,7 +166,7 @@ class InferenceEngine:
             self._pallas_ok = use_pallas
 
         if self._pallas_ok:
-            from jax import shard_map
+            from idunno_tpu.parallel._compat import shard_map
             from idunno_tpu.ops.pallas_preprocess import preprocess_batch_pallas
 
             # pallas_call is a custom call XLA can't auto-partition; run it
@@ -270,7 +289,8 @@ class InferenceEngine:
         records = [(names[i], self.categories[int(idx[i])], float(prob[i]))
                    for i in range(len(names))]
         return QueryResult(model=name, records=records,
-                           elapsed_s=time.time() - t0)
+                           elapsed_s=time.time() - t0,
+                           weights=self._models[name].provenance)
 
     def warmup(self, name: str) -> float:
         """Compile + run one full batch; returns compile+run seconds."""

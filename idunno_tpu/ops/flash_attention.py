@@ -1,4 +1,5 @@
-"""Pallas TPU flash attention (blockwise online-softmax attention).
+"""Pallas TPU flash attention (blockwise online-softmax attention), with a
+recompute-based backward pass — trainable end-to-end.
 
 The memory-bound hot op of the transformer family: materializing the full
 [T, T] score matrix costs O(T²) HBM traffic and VMEM; this kernel streams
@@ -11,16 +12,28 @@ Ulysses sequence parallelism as the per-shard local attention — ring
 attention already achieves the same O(T²)-avoidance across chips; this
 achieves it within a chip.
 
-Grid: (batch·heads, q_blocks, k_blocks); the innermost (k) dimension is
-sequential on TPU, so the scratch accumulators carry across k steps and the
-output block is finalized on the last one. Causal masking skips
-fully-masked k blocks via ``pl.when`` (no wasted MXU work on the upper
-triangle) and applies the intra-block triangle with a broadcasted-iota
-mask.
+Differentiation: a `jax.custom_vjp` whose forward also emits the per-row
+logsumexp; the backward never stores the [T, T] probability matrix —
+two Pallas kernels recompute p = exp(s - lse) blockwise (the standard
+FlashAttention backward):
+
+    delta = rowsum(dO ∘ O)                       (XLA, [G, T])
+    dQ    = Σ_k  [p ∘ (dO Vᵀ − delta)]·scale K   (kernel 1, scans k)
+    dK    = Σ_q  [p ∘ (dO Vᵀ − delta)]ᵀ·scale Q  (kernel 2, scans q)
+    dV    = Σ_q  pᵀ dO                           (kernel 2)
+
+Grid: (batch·heads, q_blocks, k_blocks) — the innermost dimension is
+sequential on TPU, so scratch accumulators carry across the scanned axis and
+outputs are finalized on its last step. Causal masking skips fully-masked
+blocks via ``pl.when`` (no wasted MXU work on the upper triangle) and
+applies the intra-block triangle with a broadcasted-iota mask. T is padded
+to a common multiple of block_q and block_k so grid coverage always equals
+the buffer (no silently-skipped tail blocks).
 """
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -31,9 +44,33 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30                  # safe -inf for masking (avoids inf-inf NaN)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale: float, causal: bool, block_q: int, block_k: int,
-                  seq_len: int):
+def _masked_scores(q, k, iq, jk, *, scale, causal, block_q, block_k,
+                   seq_len, t_pad):
+    """[bq, D]x[bk, D] → masked f32 score block [bq, bk] (shared by the
+    forward and both backward kernels — recompute must match bit-for-bit)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    k_pos = jk * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    if causal:
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    if t_pad > seq_len:              # buffer padded: mask the padded keys
+        s = jnp.where(k_pos < seq_len, s, _NEG_INF)
+    return s
+
+
+def _live(iq, jk, *, causal, block_q, block_k):
+    """causal: block (iq, jk) is dead when its highest query position is
+    strictly below its lowest key position."""
+    return (iq * block_q + block_q - 1 >= jk * block_k) if causal else True
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                  *, scale: float, causal: bool, block_q: int, block_k: int,
+                  seq_len: int, t_pad: int):
     iq, jk = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -43,26 +80,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: block (iq, jk) is dead when its lowest query position is
-    # strictly above its lowest key position's diagonal
-    live = (iq * block_q + block_q - 1 >= jk * block_k) if causal else True
-
-    @pl.when(live)
+    @pl.when(_live(iq, jk, causal=causal, block_q=block_q, block_k=block_k))
     def _step():
         q = q_ref[0].astype(jnp.float32)                  # [bq, D]
         k = k_ref[0].astype(jnp.float32)                  # [bk, D]
         v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [bq, bk]
-        k_pos = jk * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        if causal:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        if seq_len % block_k:            # ragged tail: mask padded keys
-            s = jnp.where(k_pos < seq_len, s, _NEG_INF)
+        s = _masked_scores(q, k, iq, jk, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           seq_len=seq_len, t_pad=t_pad)
 
         m_prev = m_ref[:].max(axis=-1, keepdims=True)     # [bq, 1] (bcast)
         l_prev = l_ref[:].max(axis=-1, keepdims=True)
@@ -78,9 +103,185 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(jk == nk - 1)
     def _finalize():
+        m = m_ref[:].max(axis=-1, keepdims=True)
         l = l_ref[:].max(axis=-1, keepdims=True)
-        l = jnp.where(l == 0.0, 1.0, l)                   # fully-masked rows
-        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        l_safe = jnp.where(l == 0.0, 1.0, l)              # fully-masked rows
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m + jnp.log(l_safe)).reshape(block_q)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, acc_ref, *, scale: float, causal: bool,
+                         block_q: int, block_k: int, seq_len: int,
+                         t_pad: int):
+    iq, jk = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(_live(iq, jk, causal=causal, block_q=block_q, block_k=block_k))
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)                # [bq, D]
+        s = _masked_scores(q, k, iq, jk, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           seq_len=seq_len, t_pad=t_pad)
+        p = jnp.exp(s - lse_ref[0].reshape(block_q, 1))   # [bq, bk]
+        dp = jax.lax.dot_general(                          # dO·Vᵀ  [bq, bk]
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0].reshape(block_q, 1)) * scale
+        acc_ref[:] = acc_ref[:] + jax.lax.dot_general(     # ds·K  [bq, D]
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(jk == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                          causal: bool, block_q: int, block_k: int,
+                          seq_len: int, t_pad: int):
+    jk, iq = pl.program_id(1), pl.program_id(2)   # k block fixed, scan q
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(_live(iq, jk, causal=causal, block_q=block_q, block_k=block_k))
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _masked_scores(q, k, iq, jk, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           seq_len=seq_len, t_pad=t_pad)
+        p = jnp.exp(s - lse_ref[0].reshape(block_q, 1))   # [bq, bk]
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(       # pᵀ·dO  [bk, D]
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0].reshape(block_q, 1)) * scale
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(       # dsᵀ·Q  [bk, D]
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct carrying the input's varying axes when running under
+    shard_map (newer jax tracks vma on avals)."""
+    try:
+        vma = jax.typeof(like).vma
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except (AttributeError, TypeError):     # pragma: no cover - older jax
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _flash_core(qb, kb, vb, causal, block_q, block_k, seq_len, interpret):
+    """[G, T_pad, D]×3 → (out [G, T_pad, D], lse [G, T_pad])."""
+    g, t_pad, d = qb.shape
+    scale = 1.0 / (d ** 0.5)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               seq_len=seq_len, t_pad=t_pad)
+    out, lse = pl.pallas_call(
+        kernel,
+        out_shape=(_out_struct((g, t_pad, d), qb.dtype, qb),
+                   _out_struct((g, t_pad), jnp.float32, qb)),
+        grid=(g, t_pad // block_q, t_pad // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
+        ],
+        out_specs=(pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
+                   pl.BlockSpec((1, block_q), lambda g, i, j: (g, i))),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),    # acc
+                        pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+                        pltpu.VMEM((block_q, 128), jnp.float32)], # running sum
+        interpret=interpret,
+    )(qb, kb, vb)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(qb, kb, vb, causal, block_q, block_k, seq_len, interpret):
+    out, _ = _flash_core(qb, kb, vb, causal, block_q, block_k, seq_len,
+                         interpret)
+    return out
+
+
+def _flash_fwd(qb, kb, vb, causal, block_q, block_k, seq_len, interpret):
+    out, lse = _flash_core(qb, kb, vb, causal, block_q, block_k, seq_len,
+                           interpret)
+    return out, (qb, kb, vb, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, seq_len, interpret, res, do):
+    qb, kb, vb, out, lse = res
+    g, t_pad, d = qb.shape
+    scale = 1.0 / (d ** 0.5)
+    # delta = rowsum(dO ∘ O): cheap elementwise reduce, XLA fuses it.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                               # [G, T_pad]
+    nq, nk = t_pad // block_q, t_pad // block_k
+    qspec = pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0))
+    rowspec = pl.BlockSpec((1, block_q), lambda g, i, j: (g, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          seq_len=seq_len, t_pad=t_pad),
+        out_shape=_out_struct((g, t_pad, d), qb.dtype, qb),
+        grid=(g, nq, nk),
+        in_specs=[
+            qspec,
+            pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
+            qspec, rowspec, rowspec,
+        ],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, do, lse, delta)
+
+    # dk/dv grid: k block is the carried (outer) axis, q is scanned last.
+    kspec = pl.BlockSpec((1, block_k, d), lambda g, j, i: (g, j, 0))
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda g, j, i: (g, i, 0))
+    rowspec2 = pl.BlockSpec((1, block_q), lambda g, j, i: (g, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          seq_len=seq_len, t_pad=t_pad),
+        out_shape=(_out_struct((g, t_pad, d), kb.dtype, kb),
+                   _out_struct((g, t_pad, d), vb.dtype, vb)),
+        grid=(g, nk, nq),
+        in_specs=[qspec2, kspec, kspec, qspec2, rowspec2, rowspec2],
+        out_specs=(kspec, kspec),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -89,44 +290,21 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     causal: bool = False, block_q: int = 128,
                     block_k: int = 128,
                     interpret: bool = False) -> jnp.ndarray:
-    """q/k/v [B, T, H, D] → [B, T, H, D]. Ragged T is padded up to the
-    block size internally (padded keys are masked, padded query rows are
-    sliced off), so any sequence length works — e.g. ViT's n_patches+1."""
+    """q/k/v [B, T, H, D] → [B, T, H, D]. Ragged T is padded up to the least
+    common multiple of the block sizes internally (padded keys are masked,
+    padded query rows are sliced off), so any sequence length works — e.g.
+    ViT's n_patches+1. Differentiable: gradients flow through the
+    recompute-based Pallas backward kernels above."""
     b, t, h, d = q.shape
     block_q, block_k = min(block_q, t), min(block_k, t)
-    t_pad = -(-t // block_q) * block_q
-    t_pad = -(-t_pad // block_k) * block_k
+    t_pad = -(-t // math.lcm(block_q, block_k)) * math.lcm(block_q, block_k)
+    assert t_pad % block_q == 0 and t_pad % block_k == 0
     if t_pad != t:
         pad = [(0, 0), (0, t_pad - t), (0, 0), (0, 0)]
         q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
-    scale = 1.0 / (d ** 0.5)
 
     def bh(x):          # [B, T_pad, H, D] -> [B*H, T_pad, D]
         return x.transpose(0, 2, 1, 3).reshape(b * h, t_pad, d)
 
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k, seq_len=t)
-    scratch = [pltpu.VMEM((block_q, d), jnp.float32),    # acc
-               pltpu.VMEM((block_q, 128), jnp.float32),  # running max
-               pltpu.VMEM((block_q, 128), jnp.float32)]  # running denom
-
-    try:        # under shard_map the out aval must carry the varying axes
-        vma = jax.typeof(q).vma
-        out_shape = jax.ShapeDtypeStruct((b * h, t_pad, d), q.dtype, vma=vma)
-    except (AttributeError, TypeError):     # pragma: no cover - older jax
-        out_shape = jax.ShapeDtypeStruct((b * h, t_pad, d), q.dtype)
-
-    out = pl.pallas_call(
-        kernel,
-        out_shape=out_shape,
-        grid=(b * h, t_pad // block_q, t_pad // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
-        scratch_shapes=scratch,
-        interpret=interpret,
-    )(bh(q), bh(k), bh(v))
+    out = _flash(bh(q), bh(k), bh(v), causal, block_q, block_k, t, interpret)
     return out.reshape(b, h, t_pad, d).transpose(0, 2, 1, 3)[:, :t]

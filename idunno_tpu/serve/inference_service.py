@@ -81,6 +81,9 @@ class InferenceService:
         # coordinator state
         self._qnum: dict[str, int] = {}          # per-model counter (`:965-966`)
         self._results: dict[tuple[str, int], list[tuple[str, str, float]]] = {}
+        # per-model weight-provenance markers seen in RESULTs ("pretrained" /
+        # "random") — random init must never pass as real classifications
+        self._weights_seen: dict[str, set[str]] = {}
         self._results_lock = threading.RLock()
 
         # worker state
@@ -157,6 +160,17 @@ class InferenceService:
 
     def query_done(self, model: str, qnum: int) -> bool:
         return self.scheduler.book.query_done(model, qnum)
+
+    def weights_provenance(self) -> dict[str, str]:
+        """Per-model weight provenance aggregated over RESULTs:
+        "pretrained" | "random" | "unknown", or "mixed(...)" if workers
+        disagree (e.g. one node has the checkpoint cached, another not)."""
+        with self._results_lock:
+            out = {}
+            for m, seen in self._weights_seen.items():
+                out[m] = (next(iter(seen)) if len(seen) == 1
+                          else "mixed(" + ",".join(sorted(seen)) + ")")
+            return out
 
     # ------------------------------------------------------------------ #
     # coordinator side
@@ -249,6 +263,8 @@ class InferenceService:
         records = [tuple(r) for r in p["records"]]
         with self._results_lock:
             self._results.setdefault((model, qnum), []).extend(records)
+            self._weights_seen.setdefault(model, set()).add(
+                p.get("weights", "unknown"))
         self.metrics.record_task(model, task.n_items,
                                  float(p["elapsed_s"]),
                                  self.config.query_batch_size)
@@ -314,6 +330,7 @@ class InferenceService:
                       {"model": job.model, "qnum": job.qnum,
                        "start": job.start, "end": job.end,
                        "elapsed_s": elapsed,
+                       "weights": getattr(res, "weights", "unknown"),
                        "records": [list(r) for r in records]})
         self._deliver_result(msg)
 

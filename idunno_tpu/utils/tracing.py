@@ -40,19 +40,34 @@ def device_timed(fn: Callable[..., Any]) -> Callable[..., tuple[Any, TimedCall]]
 
     Blocks on the result tree, so ``seconds`` covers actual device
     execution, not async dispatch.
+
+    Compile detection: when ``fn`` is a jitted function exposing
+    ``_cache_size`` the flag is exact — a call that grew the jit cache was a
+    trace+compile call, which also survives cache clears and static-kwarg
+    rehashing. Otherwise it falls back to a first-time-seen-shapes
+    HEURISTIC: wrapping the same fn twice, clearing jax caches, or anything
+    else that recompiles without changing arg shapes will mislabel a compile
+    call as warm.
     """
+    cache_size = getattr(fn, "_cache_size", None)
     seen_shapes: set[tuple] = set()
 
     def wrapped(*args, **kwargs):
-        key = tuple(
-            (getattr(a, "shape", None), str(getattr(a, "dtype", None)))
-            for a in jax.tree.leaves((args, kwargs)))
-        first = key not in seen_shapes
-        seen_shapes.add(key)
+        if callable(cache_size):
+            before = cache_size()
+        else:
+            key = tuple(
+                (getattr(a, "shape", None), str(getattr(a, "dtype", None)))
+                for a in jax.tree.leaves((args, kwargs)))
+            first = key not in seen_shapes
+            seen_shapes.add(key)
         t0 = time.perf_counter()
         out = fn(*args, **kwargs)
         jax.block_until_ready(out)
-        return out, TimedCall(time.perf_counter() - t0, compiled=not first)
+        seconds = time.perf_counter() - t0
+        if callable(cache_size):
+            first = cache_size() > before
+        return out, TimedCall(seconds, compiled=not first)
 
     return wrapped
 

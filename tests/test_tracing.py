@@ -52,3 +52,22 @@ def test_trace_writes_profile(tmp_path):
         found.extend(f for f in files if f.endswith((".pb", ".xplane.pb",
                                                      ".json.gz", ".trace")))
     assert found, f"no trace artifacts under {log_dir}"
+
+
+def test_device_timed_exact_compile_detection_survives_rewrap():
+    """ADVICE round-1 #4: with a jitted fn, compile detection keys on the
+    jit cache, so a second wrapper over the same (already warm) fn must not
+    mislabel its first call as a compile."""
+    import jax
+    import jax.numpy as jnp
+    from idunno_tpu.utils.tracing import device_timed
+
+    f = jax.jit(lambda x: x * 2)
+    w1 = device_timed(f)
+    _, t1 = w1(jnp.ones(4))      # trace+compile
+    _, t2 = w1(jnp.ones(4))      # warm
+    _, t3 = w1(jnp.ones(8))      # new shape -> compile
+    w2 = device_timed(f)         # rewrap same fn
+    _, t4 = w2(jnp.ones(4))      # cache already warm -> NOT a compile
+    assert (t1.compiled, t2.compiled, t3.compiled, t4.compiled) == (
+        False, True, False, True)
