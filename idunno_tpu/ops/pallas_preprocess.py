@@ -48,11 +48,19 @@ def preprocess_batch_pallas(images_u8: jnp.ndarray, *, crop: int = 224,
     mean = jnp.asarray([IMAGENET_MEAN], dtype=jnp.float32)          # [1, 3]
     inv_std = 1.0 / jnp.asarray([IMAGENET_STD], dtype=jnp.float32)  # [1, 3]
 
+    # carry the input's varying mesh axes on the out aval so the kernel can
+    # run inside shard_map with check_vma on (newer jax tracks vma)
+    try:
+        out_shape = jax.ShapeDtypeStruct((rows, w * ch), jnp.bfloat16,
+                                         vma=jax.typeof(flat).vma)
+    except (AttributeError, TypeError):      # pragma: no cover - older jax
+        out_shape = jax.ShapeDtypeStruct((rows, w * ch), jnp.bfloat16)
+
     block_rows = min(_ROWS_PER_BLOCK, rows)
     grid = (pl.cdiv(rows, block_rows),)
     out = pl.pallas_call(
         _norm_kernel,
-        out_shape=jax.ShapeDtypeStruct((rows, w * ch), jnp.bfloat16),
+        out_shape=out_shape,
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_rows, w * ch), lambda i: (i, 0)),
