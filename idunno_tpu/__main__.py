@@ -39,11 +39,22 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--cpu", action="store_true",
                     help="force the engine onto CPU (ops testing; several "
                          "local nodes can't share one TPU chip)")
+    ap.add_argument("--jax-coordinator", default=None,
+                    help="ip:port for jax.distributed bring-up (multi-host "
+                         "mesh over DCN); all nodes must pass the same value")
+    ap.add_argument("--jax-num-processes", type=int, default=None)
+    ap.add_argument("--jax-process-id", type=int, default=None)
     args = ap.parse_args(argv)
 
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
+
+    if args.jax_coordinator:
+        from idunno_tpu.parallel.mesh import initialize_distributed
+        initialize_distributed(args.jax_coordinator,
+                               num_processes=args.jax_num_processes,
+                               process_id=args.jax_process_id)
 
     from idunno_tpu.cli.shell import Shell
     from idunno_tpu.comm.net import NetTransport
@@ -51,10 +62,15 @@ def main(argv: list[str] | None = None) -> int:
     from idunno_tpu.serve.node import Node
 
     addresses: dict[str, str] = {}
+    engine_config = None
     if args.config:
         with open(args.config) as f:
             raw = json.load(f)
         addresses = raw.pop("addresses", {})
+        engine_raw = raw.pop("engine", None)
+        if engine_raw is not None:
+            from idunno_tpu.config import EngineConfig
+            engine_config = EngineConfig(**engine_raw)
         if "ports" in raw:
             from idunno_tpu.config import PortConfig
             raw["ports"] = PortConfig(**raw["ports"])
@@ -69,6 +85,7 @@ def main(argv: list[str] | None = None) -> int:
     transport = NetTransport(args.host, build_addr_of(config, addresses))
     node = Node(args.host, config, transport,
                 data_dir=args.data_dir or f"./{args.host}-data",
+                engine_config=engine_config,
                 dataset_root=args.dataset)
     node.start()
     try:

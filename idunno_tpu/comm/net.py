@@ -52,6 +52,21 @@ def _recv_frame(sock: socket.socket) -> tuple[str, Message]:
     return svc, Message.from_bytes(body)
 
 
+def oneshot_call(ip: str, tcp_port: int, service: str, msg: Message,
+                 timeout: float = 10.0) -> Message | None:
+    """Pure-client RPC: one framed request/response on a fresh connection,
+    no listener bound — how external tools (tests, ops scripts, the remote
+    CLI) talk to a node without becoming one."""
+    with socket.create_connection((ip, tcp_port), timeout=timeout) as sock:
+        _send_frame(sock, service, msg)
+        sock.shutdown(socket.SHUT_WR)
+        try:
+            _, out = _recv_frame(sock)
+            return out
+        except ConnectionError:
+            return None
+
+
 class NetTransport(Transport):
     def __init__(self, host: str, addr_of: AddrOf, bind_ip: str = "0.0.0.0",
                  accept_timeout: float = 0.2) -> None:
@@ -132,15 +147,8 @@ class NetTransport(Transport):
              timeout: float | None = None) -> Message | None:
         ip, tcp_port, _ = self._addr_of(host)
         try:
-            with socket.create_connection((ip, tcp_port),
-                                          timeout=timeout or 10.0) as sock:
-                _send_frame(sock, service, msg)
-                sock.shutdown(socket.SHUT_WR)
-                try:
-                    _, out = _recv_frame(sock)
-                    return out
-                except ConnectionError:
-                    return None     # handler had no reply
+            return oneshot_call(ip, tcp_port, service, msg,
+                                timeout=timeout or 10.0)
         except (OSError, socket.timeout) as e:
             raise TransportError(f"{host} unreachable: {e}") from e
 

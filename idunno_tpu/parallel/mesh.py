@@ -39,3 +39,57 @@ def local_mesh(n_model: int = 1) -> Mesh:
     if n % n_model:
         raise ValueError(f"{n} devices not divisible by model axis {n_model}")
     return make_mesh(n // n_model, n_model)
+
+
+# -- multi-host bring-up ----------------------------------------------------
+#
+# The reference "scales" by humans starting one process per VM against a
+# hardcoded IP table (`README.md:10-29`, `utils.py:70-92`). The TPU-native
+# equivalent is the JAX multi-process runtime: every host process calls
+# `jax.distributed.initialize` against one coordinator address (DCN), after
+# which `jax.devices()` is the GLOBAL device set and a mesh over it spans
+# hosts — collectives ride ICI within a slice and DCN across slices, all
+# inserted by XLA from the same sharding annotations as the single-host path.
+
+def initialize_distributed(coordinator_address: str,
+                           num_processes: int | None = None,
+                           process_id: int | None = None,
+                           local_device_ids=None) -> None:
+    """`jax.distributed.initialize` wrapper (idempotent): bring this process
+    into the multi-host runtime. On TPU pods num_processes/process_id are
+    inferred from the TPU metadata; on CPU/GPU fleets pass them explicitly
+    (``python -m idunno_tpu --jax-coordinator host:port
+    --jax-num-processes N --jax-process-id I``)."""
+    try:                                   # already initialised: keep going
+        from jax._src.distributed import global_state
+        if getattr(global_state, "client", None) is not None:
+            return
+    except ImportError:                    # pragma: no cover - private API
+        pass
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id,
+            local_device_ids=local_device_ids)
+    except RuntimeError as e:
+        # jax raises "distributed.initialize should only be called once."
+        msg = str(e).lower()
+        if "already" not in msg and "once" not in msg:
+            raise
+
+
+def global_mesh(n_model: int = 1) -> Mesh:
+    """(data, model) mesh over the GLOBAL device set (all processes); call
+    after `initialize_distributed`. Each process runs the same program;
+    arrays sharded over the data axis are globally sharded across hosts."""
+    devices = jax.devices()                # global across processes
+    n = len(devices)
+    if n % n_model:
+        raise ValueError(f"{n} global devices not divisible by model axis "
+                         f"{n_model}")
+    return make_mesh(n // n_model, n_model, devices=devices)
+
+
+def process_info() -> tuple[int, int]:
+    """(process_index, process_count) — host identity inside the runtime."""
+    return jax.process_index(), jax.process_count()

@@ -1,0 +1,91 @@
+"""Remote control/status RPC — drive a node from OUTSIDE its process.
+
+The reference is driven only by a human typing into each VM's interactive
+shell (`mp4_machinelearning.py:1111-1229`); there is no way to script the
+cluster from another process. This service exposes the same verb surface
+over the typed transport, so deployment tooling, integration tests and a
+remote CLI can run the shell's commands against any node:
+
+  status                     — membership view, acting master, loaded models
+  put/get/ls/store/delete    — the SDFS verbs (C4) executed by this node
+  inference                  — submit a query range (paced chunking like the
+                               shell's `inference` verb, C11)
+  query_done / results       — poll completion and fetch accumulated records
+                               (the master's c4 view, C9/C12)
+
+One request/one reply on the existing node transport; `comm.net.oneshot_call`
+is the matching client side (no listener needed).
+"""
+from __future__ import annotations
+
+import os
+from typing import TYPE_CHECKING
+
+from idunno_tpu.comm.message import Message
+from idunno_tpu.utils.types import MessageType
+
+if TYPE_CHECKING:                                    # pragma: no cover
+    from idunno_tpu.serve.node import Node
+
+SERVICE = "control"
+
+
+class ControlService:
+    def __init__(self, node: "Node") -> None:
+        self.node = node
+        node.transport.serve(SERVICE, self._handle)
+
+    def _handle(self, service: str, msg: Message) -> Message:
+        try:
+            out = self._dispatch(msg.payload.get("verb", ""), msg.payload)
+            return Message(MessageType.ACK, self.node.host, out)
+        except Exception as e:  # noqa: BLE001 - RPC boundary: report, don't die
+            return Message(MessageType.ERROR, self.node.host,
+                           {"error": f"{type(e).__name__}: {e}"})
+
+    def _dispatch(self, verb: str, p: dict) -> dict:
+        node = self.node
+        if verb == "status":
+            members = {e.host: e.status.value
+                       for e in node.membership.members.entries()}
+            return {"host": node.host,
+                    "acting_master": node.membership.acting_master(),
+                    "members": members,
+                    "models": node.engine.loaded_models()
+                    if hasattr(node.engine, "loaded_models") else []}
+        if verb == "put":
+            version = node.store.put(p["local"], p["name"])
+            return {"version": version}
+        if verb == "put_bytes":
+            version = node.store.put_bytes(
+                p["name"], p["data"].encode("latin-1"))
+            return {"version": version}
+        if verb == "get":
+            version = node.store.get(p["name"], p["local"])
+            return {"version": version,
+                    "size": os.path.getsize(p["local"])}
+        if verb == "get_bytes":
+            blob, version = node.store.get_bytes(p["name"])
+            return {"version": version, "data": blob.decode("latin-1")}
+        if verb == "ls":
+            return {"hosts": node.store.ls(p["name"])}
+        if verb == "store":
+            return {"files": node.store.local_files()}
+        if verb == "delete":
+            node.store.delete(p["name"])
+            return {}
+        if verb == "inference":
+            qnums = node.inference.inference(
+                p["model"], int(p["start"]), int(p["end"]),
+                pace_s=float(p.get("pace_s", 0.0)))
+            return {"qnums": qnums}
+        if verb == "query_done":
+            return {"done": node.inference.query_done(p["model"],
+                                                      int(p["qnum"]))}
+        if verb == "results":
+            recs = node.inference.results(p["model"], int(p["qnum"]))
+            return {"records": [list(r) for r in recs],
+                    "weights": node.inference.weights_provenance()}
+        if verb == "grep":
+            return {"matches": node.grep.query(p["pattern"])}
+        raise ValueError(f"unknown control verb {verb!r}")

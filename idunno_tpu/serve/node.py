@@ -17,6 +17,7 @@ from idunno_tpu.comm.transport import Transport
 from idunno_tpu.config import ClusterConfig, EngineConfig
 from idunno_tpu.grep.loggrep import LogGrepService
 from idunno_tpu.membership.service import MembershipService
+from idunno_tpu.serve.control import ControlService
 from idunno_tpu.serve.failover import FailoverManager
 from idunno_tpu.serve.inference_service import InferenceService
 from idunno_tpu.serve.metrics import MetricsTracker
@@ -51,6 +52,7 @@ class Node:
                                         self.membership, self.inference)
         self.grep = LogGrepService(host, config, transport, self.membership,
                                    log_dir or data_dir)
+        self.control = ControlService(self)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 

@@ -1,0 +1,198 @@
+"""Real multi-process deployment (round-1 VERDICT missing #2).
+
+Two integration surfaces the in-process cluster tests cannot cover:
+
+1. ``test_jax_distributed_two_process_mesh`` — the multi-host runtime:
+   two OS processes `jax.distributed.initialize` against one coordinator,
+   build a GLOBAL mesh (`idunno_tpu.parallel.mesh.global_mesh`) and run a
+   cross-process reduction whose value proves both hosts' shards took part.
+
+2. ``test_cluster_multiprocess_kill9`` — the deployment story end to end,
+   matching the reference's only system test (`README.md:10-35`: start the
+   processes, run commands, Ctrl-C a VM): three real
+   ``python -m idunno_tpu --cpu --no-shell`` OS processes join over real
+   sockets; a 4th process (this test) drives put/get and an inference query
+   through the control RPC, then SIGKILLs one worker mid-query and verifies
+   the cluster completes the full range anyway (failure detection →
+   reassignment → results).
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from idunno_tpu.comm.message import Message
+from idunno_tpu.comm.net import oneshot_call
+from idunno_tpu.utils.types import MessageType
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port_base(n: int = 3, spread: int = 100) -> int:
+    """A UDP/TCP port base such that base..base+spread*n is plausibly free
+    (bind-probe the first few)."""
+    for base in range(21000 + (os.getpid() * 7) % 2000, 64000, 777):
+        try:
+            for i in range(n):
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", base + spread * i))
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", base + 5 + spread * i))
+        except OSError:
+            continue
+        return base
+    raise RuntimeError("no free port range")
+
+
+def _env_cpu() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # one virtual device per node process keeps compile time down
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    return env
+
+
+def _control(port: int, verb: str, timeout: float = 30.0, **kw) -> dict:
+    out = oneshot_call("127.0.0.1", port, "control",
+                       Message(MessageType.INFERENCE, "client",
+                               {"verb": verb, **kw}), timeout=timeout)
+    assert out is not None, f"no reply to {verb}"
+    assert out.type is MessageType.ACK, out.payload
+    return out.payload
+
+
+def test_cluster_multiprocess_kill9(tmp_path):
+    base = _free_port_base()
+    hosts = ["n0", "n1", "n2"]
+    cfg = {
+        "hosts": hosts, "coordinator": "n0", "standby_coordinator": "n1",
+        "introducer": "n0",
+        "ports": {"membership": base, "store": base + 5,
+                  "inference": base + 10, "result": base + 15,
+                  "metadata": base + 20, "grep": base + 25},
+        "ping_interval_s": 0.2, "failure_timeout_s": 2.0,
+        "replication_factor": 2, "straggler_timeout_s": 8.0,
+        "query_batch_size": 192, "query_interval_s": 0.0,
+        "metadata_interval_s": 0.5,
+        "engine": {"batch_size": 8, "image_size": 64, "resize_size": 64},
+    }
+    cfg_path = tmp_path / "cluster.json"
+    cfg_path.write_text(json.dumps(cfg))
+    # control RPC goes to the node's single TCP listener (the "store" port)
+    tcp = {h: base + 5 + 100 * i for i, h in enumerate(hosts)}
+
+    procs: dict[str, subprocess.Popen] = {}
+    try:
+        for h in hosts:
+            procs[h] = subprocess.Popen(
+                [sys.executable, "-m", "idunno_tpu", "--host", h,
+                 "--config", str(cfg_path), "--cpu", "--no-shell",
+                 "--data-dir", str(tmp_path / h)],
+                cwd=REPO, env=_env_cpu(),
+                stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+
+        # -- join: all three RUNNING in the coordinator's view ------------
+        deadline = time.time() + 120
+        while True:
+            try:
+                st = _control(tcp["n0"], "status", timeout=5.0)
+                if (sorted(st["members"]) == hosts and
+                        all(v == "RUNNING" for v in st["members"].values())):
+                    break
+            except (AssertionError, OSError):
+                pass
+            assert time.time() < deadline, "cluster never converged"
+            time.sleep(0.5)
+        assert st["acting_master"] == "n0"
+
+        # -- SDFS through two different nodes -----------------------------
+        put = _control(tcp["n2"], "put_bytes", name="hello.txt",
+                       data="distributed file")
+        assert put["version"] == 1
+        got = _control(tcp["n1"], "get_bytes", name="hello.txt")
+        assert got["data"] == "distributed file" and got["version"] == 1
+        ls = _control(tcp["n0"], "ls", name="hello.txt")
+        assert len(ls["hosts"]) >= 2          # replicated
+
+        # -- inference + kill -9 a worker mid-query -----------------------
+        sub = _control(tcp["n0"], "inference", model="alexnet",
+                       start=0, end=191, timeout=60.0)
+        qnum = sub["qnums"][0]
+        # kill a non-coordinator worker while its task is still compiling
+        os.kill(procs["n2"].pid, signal.SIGKILL)
+        procs["n2"].wait(timeout=10)
+
+        deadline = time.time() + 240
+        while True:
+            done = _control(tcp["n0"], "query_done", model="alexnet",
+                            qnum=qnum, timeout=10.0)
+            if done["done"]:
+                break
+            assert time.time() < deadline, \
+                "query never completed after worker SIGKILL"
+            time.sleep(1.0)
+
+        res = _control(tcp["n0"], "results", model="alexnet", qnum=qnum,
+                       timeout=30.0)
+        names = {r[0] for r in res["records"]}
+        assert names == {f"test_{i}.JPEG" for i in range(192)}
+        assert res["weights"].get("alexnet") in ("random", "pretrained")
+
+        # the dead worker is marked LEAVE in the survivors' view
+        st = _control(tcp["n0"], "status")
+        assert st["members"]["n2"] == "LEAVE"
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_jax_distributed_two_process_mesh(tmp_path):
+    port = _free_port_base(n=1)
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        sys.path.insert(0, {REPO!r})
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        import jax.numpy as jnp
+        from idunno_tpu.parallel.mesh import (
+            global_mesh, initialize_distributed, process_info)
+
+        pid = int(sys.argv[1])
+        initialize_distributed("127.0.0.1:{port}", num_processes=2,
+                               process_id=pid)
+        idx, cnt = process_info()
+        assert cnt == 2 and idx == pid
+        mesh = global_mesh()
+        assert mesh.devices.size == 2          # global, not local
+        local = jnp.full((4,), float(idx + 1))
+        arr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("data")), local, (8,))
+        total = jax.jit(lambda a: a.sum(),
+                        out_shardings=NamedSharding(mesh, P()))(arr)
+        # 4*1 + 4*2: both processes' shards took part
+        assert float(total) == 12.0, float(total)
+        print("OK", idx)
+    """))
+    env = _env_cpu()
+    procs = [subprocess.Popen([sys.executable, str(script), str(i)],
+                              env=env, cwd=REPO, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for i in range(2)]
+    outs = [p.communicate(timeout=150)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+        assert "OK" in out

@@ -1,0 +1,115 @@
+"""Wall-clock failure-recovery measurement on the threaded Node runtime
+(round-1 VERDICT weak #7: all failover tests used FakeClock — no measured
+number existed to compare with the reference's recovery model).
+
+The reference quantifies recovery as ``t_detect (≈ failure timeout) +
+n · t_send`` for n in-flight tasks on the failed VM
+(`mp4_report_group1.pdf` p.2-4, SURVEY.md §6). This test reproduces that
+experiment on real threads and wall clocks: a 4-node cluster serves a query
+whose tasks are mid-execution when one worker is killed (transport-level
+kill -9); we record kill → detection and kill → query-complete latencies and
+write them to ``RECOVERY.json`` as the round's measured artifact.
+"""
+import json
+import os
+import time
+from types import SimpleNamespace
+
+from idunno_tpu.comm.inproc import InProcNetwork
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.serve.node import Node
+from idunno_tpu.utils.types import MemberStatus
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORK_S = 1.5                      # per-task compute time (controlled)
+
+
+class SlowEngine:
+    """Deterministic fake with a real compute duration, so tasks are
+    genuinely in flight when the worker dies."""
+
+    def infer(self, name, start, end, dataset_root=None):
+        time.sleep(WORK_S)
+        return SimpleNamespace(
+            records=[(f"test_{i}.JPEG", f"class_{i % 1000}", 0.9)
+                     for i in range(start, end + 1)],
+            elapsed_s=WORK_S, weights="random")
+
+
+def test_measured_recovery_after_worker_kill(tmp_path):
+    cfg = ClusterConfig(hosts=("n0", "n1", "n2", "n3"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0",
+                        replication_factor=2, query_batch_size=400,
+                        query_interval_s=0.0, ping_interval_s=0.1,
+                        failure_timeout_s=1.0, straggler_timeout_s=30.0,
+                        metadata_interval_s=0.2)
+    net = InProcNetwork()
+    nodes = {h: Node(h, cfg, net.transport(h), str(tmp_path / h),
+                     engine=SlowEngine()) for h in cfg.hosts}
+    detect_stamp = {}
+
+    def on_change(host, old, new):
+        if new is MemberStatus.LEAVE and host not in detect_stamp:
+            detect_stamp[host] = time.perf_counter()
+
+    nodes["n0"].membership.on_change(on_change)
+    try:
+        for n in nodes.values():
+            n.start()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not all(
+                len(n.membership.members.alive_hosts()) == 4
+                for n in nodes.values()):
+            time.sleep(0.02)
+
+        master = nodes["n0"].inference
+        qnum = master.inference("resnet", 0, 399, pace_s=0.0)[0]
+        time.sleep(0.3)                     # let tasks reach the workers
+        victim = "n3"
+        n_inflight = len(master.scheduler.book.in_flight(victim))
+        assert n_inflight >= 1, "victim held no in-flight tasks"
+
+        t_kill = time.perf_counter()
+        net.kill(victim)                    # kill -9: silent, mid-compute
+
+        deadline = time.time() + 20.0
+        while time.time() < deadline and victim not in detect_stamp:
+            time.sleep(0.005)
+        assert victim in detect_stamp, "failure never detected"
+        detect_s = detect_stamp[victim] - t_kill
+
+        while time.time() < deadline and not master.query_done("resnet",
+                                                               qnum):
+            time.sleep(0.01)
+        t_done = time.perf_counter()
+        assert master.query_done("resnet", qnum), "query never completed"
+        total_s = t_done - t_kill
+
+        recs = master.results("resnet", qnum)
+        assert {r[0] for r in recs} == {f"test_{i}.JPEG"
+                                        for i in range(400)}
+
+        # detection ≈ failure timeout (+ ping/monitor granularity + thread
+        # scheduling); completion adds the re-executed tasks' compute time
+        assert detect_s < cfg.failure_timeout_s + 1.5, detect_s
+        assert total_s < detect_s + n_inflight * WORK_S + 3.0, total_s
+
+        artifact = {
+            "experiment": "kill -9 one of 4 workers mid-query "
+                          "(threaded Node runtime, wall clock)",
+            "n_inflight_tasks_on_victim": n_inflight,
+            "task_compute_time_s": WORK_S,
+            "detect_s": round(detect_s, 3),
+            "kill_to_query_complete_s": round(total_s, 3),
+            "config": {"ping_interval_s": cfg.ping_interval_s,
+                       "failure_timeout_s": cfg.failure_timeout_s},
+            "reference_model": "t_detect (≈2 s timeout) + n × t_send "
+                               "(mp4_report_group1.pdf p.2-4)",
+            "reference_detect_s": 2.0,
+        }
+        with open(os.path.join(REPO, "RECOVERY.json"), "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
+    finally:
+        for n in nodes.values():
+            n.stop()
