@@ -3,7 +3,8 @@
 The reference's only multi-model mechanism is two whole-model jobs
 fair-sharing workers (`mp4_machinelearning.py:501-539`); it has no
 conditional computation. This adds a switch-style MoE FFN as a first-class
-model family: a learned router picks the top-1 expert per token, and the
+model family: a learned router picks the top-k experts per token (k=1 the
+Switch layer, k=2 the GShard configuration), and the
 expert FFNs either all live on every device (``mesh=None``, the dense path
 — also the exact ground truth for tests) or are sharded over a mesh axis
 with all_to_all dispatch (`idunno_tpu.parallel.expert`).
@@ -32,11 +33,18 @@ from idunno_tpu.parallel.ring_attention import full_attention
 
 
 class SwitchFFN(nn.Module):
-    """Top-1 routed expert FFN. Input/output [B, T, dim]."""
+    """Top-k routed expert FFN. Input/output [B, T, dim].
+
+    ``k=1`` is the Switch-Transformer layer (gate = raw top prob); ``k>1``
+    is GShard-style top-k routing: each token is sent to its k best experts
+    with gates renormalised over the chosen k. Routing-to-dispatch reuses
+    the top-1 machinery by treating each (token, choice) pair as its own
+    routing unit — capacity then naturally accounts for all k streams."""
 
     dim: int
     hidden: int
     n_experts: int
+    k: int = 1
     capacity_factor: float = 2.0
     mesh: Mesh | None = None            # None → dense (all experts local)
     axis: str = EXPERT_AXIS
@@ -61,20 +69,34 @@ class SwitchFFN(nn.Module):
         n = b * t
         router = nn.Dense(self.n_experts, dtype=jnp.float32,
                           param_dtype=self.param_dtype, name="router")
+        if not 1 <= self.k <= self.n_experts:
+            raise ValueError(f"k={self.k}: want 1..{self.n_experts}")
         probs = jax.nn.softmax(router(x.astype(jnp.float32)).reshape(
             n, self.n_experts))
-        gate_idx = jnp.argmax(probs, axis=-1)
-        gate_w = jnp.max(probs, axis=-1)
+        topk_w, topk_idx = jax.lax.top_k(probs, self.k)        # [n, k]
+        if self.k == 1:
+            gate_idx, gate_w = topk_idx[:, 0], topk_w[:, 0]    # switch
+        else:
+            # GShard top-k: renormalise the chosen gates; flatten so every
+            # (token, choice) is one routing unit in dispatch order
+            # [t0c0, t0c1, ..., t1c0, ...] (stays aligned with
+            # jnp.repeat(flat, k) below and with contiguous token sharding).
+            topk_w = topk_w / topk_w.sum(axis=-1, keepdims=True)
+            gate_idx, gate_w = topk_idx.reshape(-1), topk_w.reshape(-1)
 
-        # Switch-Transformer load-balance loss: E · Σ_e f_e · P_e, minimized
-        # (=1) at uniform routing. Without it top-1 routing collapses onto
-        # one expert and capacity drops kill most tokens' FFN output.
-        frac = jax.nn.one_hot(gate_idx, self.n_experts).mean(axis=0)
+        # Switch-Transformer load-balance loss: E · Σ_e f_e · P_e with f_e
+        # the top-1 routing fraction, minimized (=1) at uniform routing.
+        # Without it routing collapses onto one expert and capacity drops
+        # kill most tokens' FFN output.
+        frac = jax.nn.one_hot(topk_idx[:, 0], self.n_experts).mean(axis=0)
         aux = self.n_experts * jnp.sum(frac * probs.mean(axis=0))
         self.sow("losses", "moe_aux", aux)
 
         params = self._expert_params()
         flat = x.reshape(n, d)
+        if self.k > 1:
+            flat = jnp.repeat(flat, self.k, axis=0)            # [n*k, d]
+        n_units = n * self.k
 
         def expert_fn(p, toks):
             h = jnp.einsum("cd,dh->ch", toks.astype(self.dtype),
@@ -85,16 +107,18 @@ class SwitchFFN(nn.Module):
 
         if self.mesh is not None:
             p_sz = self.mesh.shape[self.axis]
-            cap = self._capacity(n // p_sz)
+            cap = self._capacity(n_units // p_sz)
             out = expert_parallel_apply(expert_fn, params, flat, gate_idx,
                                         gate_w, self.mesh, axis=self.axis,
                                         capacity=cap)
         else:
             dispatch, combine = switch_dispatch(
-                gate_idx, gate_w, self.n_experts, self._capacity(n))
+                gate_idx, gate_w, self.n_experts, self._capacity(n_units))
             buf = jnp.einsum("nec,nd->ecd", dispatch, flat)
             done = jax.vmap(expert_fn)(params, buf)
             out = jnp.einsum("ecd,nec->nd", done, combine)
+        if self.k > 1:
+            out = out.reshape(n, self.k, d).sum(axis=1)        # combine k
         return out.reshape(b, t, d).astype(x.dtype)
 
     def _capacity(self, tokens_per_shard: int) -> int:
@@ -104,12 +128,12 @@ class SwitchFFN(nn.Module):
 
 def switch_ffn_factory(n_experts: int, capacity_factor: float = 2.0,
                        mesh: Mesh | None = None, axis: str = EXPERT_AXIS,
-                       hidden_ratio: int = 4):
+                       hidden_ratio: int = 4, k: int = 1):
     """An ``ffn_factory`` for `Block`/`TransformerLM` that builds a
     SwitchFFN in place of the dense MLP."""
     def make(dim: int, dtype, param_dtype, name: str) -> nn.Module:
         return SwitchFFN(dim=dim, hidden=dim * hidden_ratio,
-                         n_experts=n_experts,
+                         n_experts=n_experts, k=k,
                          capacity_factor=capacity_factor, mesh=mesh,
                          axis=axis, dtype=dtype, param_dtype=param_dtype,
                          name=name)
@@ -121,17 +145,18 @@ def MoETransformerLM(vocab: int = 1024, dim: int = 128, depth: int = 2,
                      capacity_factor: float = 2.0, causal: bool = True,
                      attn_fn: AttnFn = full_attention,
                      mesh: Mesh | None = None, axis: str = EXPERT_AXIS,
-                     moe_every: int = 1, hidden_ratio: int = 4,
+                     moe_every: int = 1, hidden_ratio: int = 4, k: int = 1,
                      dtype=jnp.float32, param_dtype=jnp.float32
                      ) -> TransformerLM:
     """Causal LM with switch-MoE FFNs — `TransformerLM` with the expert
     layer plugged in every ``moe_every``-th block (1 = all blocks, 2 = the
-    Switch-Transformer interleave)."""
+    Switch-Transformer interleave); ``k`` routes each token to its top-k
+    experts (GShard top-2 when k=2)."""
     return TransformerLM(
         vocab=vocab, dim=dim, depth=depth, num_heads=num_heads,
         causal=causal, attn_fn=attn_fn,
         ffn_factory=switch_ffn_factory(n_experts, capacity_factor, mesh,
-                                       axis, hidden_ratio),
+                                       axis, hidden_ratio, k=k),
         ffn_every=moe_every, dtype=dtype, param_dtype=param_dtype)
 
 
