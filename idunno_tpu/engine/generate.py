@@ -1,0 +1,108 @@
+"""Autoregressive LM serving: KV-cached greedy/temperature decoding.
+
+The reference serves only feed-forward image classifiers
+(`alexnet_resnet.py:12-92`); a complete framework must also *serve* its
+sequence family, not just train it. TPU-first structure: the whole decode —
+prompt prefill and generation — is ONE jitted `lax.fori_loop` over a
+static-shape token buffer, with per-layer KV caches carried in the flax
+"cache" collection (`models.transformer.MultiHeadAttention._decode_step`).
+No per-token Python round-trips, no dynamic shapes, no recompiles across
+calls with the same (batch, lengths) signature.
+
+Each step costs O(max_len · d) attention against the static cache — the
+KV-cache linear-decode path — instead of the O(t²) full re-forward a naive
+generate would pay.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from idunno_tpu.models.transformer import TransformerLM
+
+
+def decode_model(model: TransformerLM, max_len: int) -> TransformerLM:
+    """The single-token serving twin of a trained model: same params tree,
+    decode-mode attention with a ``max_len`` KV cache."""
+    return dataclasses.replace(model, decode=True, max_decode_len=max_len)
+
+
+def init_cache(model: TransformerLM, batch: int, max_len: int) -> Any:
+    """Zeroed per-layer KV caches for a [batch] decode of ≤ max_len tokens.
+    Shapes come from `jax.eval_shape` (no parameter init or forward compute
+    is traced — the cache is zeros by construction)."""
+    dec = decode_model(model, max_len)
+    shapes = jax.eval_shape(dec.init, jax.random.PRNGKey(0),
+                            jnp.zeros((batch, 1), jnp.int32))
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                        shapes["cache"])
+
+
+@partial(jax.jit,
+         static_argnames=("model", "prompt_len", "max_new", "temperature"))
+def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
+             prompt_len: int, max_new: int, *, temperature: float = 0.0,
+             rng: jax.Array | None = None) -> jnp.ndarray:
+    """Generate ``max_new`` tokens after ``prompt[:, :prompt_len]``.
+
+    prompt: int32 [B, prompt_len] (static length — pad upstream and pass the
+    true length if needed). Returns int32 [B, prompt_len + max_new].
+    temperature 0 → greedy argmax; > 0 → softmax sampling (needs ``rng``).
+    """
+    if prompt.shape[1] != prompt_len:
+        raise ValueError(f"prompt is [B, {prompt.shape[1]}] but "
+                         f"prompt_len={prompt_len}; slice/pad upstream")
+    b = prompt.shape[0]
+    total = prompt_len + max_new
+    dec = decode_model(model, total)
+    cache = init_cache(model, b, total)
+    tokens = jnp.concatenate(
+        [prompt.astype(jnp.int32),
+         jnp.zeros((b, max_new), jnp.int32)], axis=1)       # [B, total]
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def step(t, carry):
+        tokens, cache, rng = carry
+        tok = jax.lax.dynamic_slice(tokens, (0, t), (b, 1))  # current input
+        logits, mutated = dec.apply({"params": params, "cache": cache},
+                                    tok, mutable=["cache"])
+        logits = logits[:, 0]                                # [B, vocab]
+        if temperature > 0.0:
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        # teacher-force while inside the prompt; append past it
+        write_at = jnp.minimum(t + 1, total - 1)
+        keep_prompt = t + 1 < prompt_len
+        cur = jax.lax.dynamic_slice(tokens, (0, write_at), (b, 1))[:, 0]
+        nxt = jnp.where(keep_prompt, cur, nxt.astype(jnp.int32))
+        tokens = jax.lax.dynamic_update_slice(
+            tokens, nxt[:, None], (0, write_at))
+        return tokens, mutated["cache"], rng
+
+    tokens, _, _ = jax.lax.fori_loop(0, total - 1, step,
+                                     (tokens, cache, rng))
+    return tokens
+
+
+def stepwise_logits(model: TransformerLM, params: Any,
+                    tokens: jnp.ndarray) -> jnp.ndarray:
+    """Teacher-forced single-token decode over a full [B, T] sequence,
+    returning [B, T, vocab] — must equal the batched full forward; the
+    correctness oracle for the cache (tests)."""
+    b, t = tokens.shape
+    dec = decode_model(model, t)
+    cache = init_cache(model, b, t)
+    outs = []
+    for i in range(t):
+        logits, mutated = dec.apply({"params": params, "cache": cache},
+                                    tokens[:, i:i + 1], mutable=["cache"])
+        cache = mutated["cache"]
+        outs.append(logits[:, 0])
+    return jnp.stack(outs, axis=1)

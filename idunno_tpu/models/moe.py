@@ -122,8 +122,11 @@ class SwitchFFN(nn.Module):
         return out.reshape(b, t, d).astype(x.dtype)
 
     def _capacity(self, tokens_per_shard: int) -> int:
-        return max(1, int(self.capacity_factor * tokens_per_shard
-                          / self.n_experts))
+        # floor at k: one token's k choices can all land on one expert, and
+        # for tiny token counts (single-token decode steps) the proportional
+        # capacity would otherwise guarantee dropped streams
+        return max(self.k, int(self.capacity_factor * tokens_per_shard
+                               / self.n_experts))
 
 
 def switch_ffn_factory(n_experts: int, capacity_factor: float = 2.0,

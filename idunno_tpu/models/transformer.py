@@ -26,12 +26,17 @@ AttnFn = Callable[..., jnp.ndarray]     # (q, k, v, *, causal) -> out
 FfnFactory = Callable[..., nn.Module]
 
 
-def rope(x: jnp.ndarray, *, base: float = 10000.0) -> jnp.ndarray:
-    """Rotary embedding over [B, T, H, D] with global positions 0..T-1."""
+def rope(x: jnp.ndarray, *, base: float = 10000.0,
+         positions: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Rotary embedding over [B, T, H, D]; ``positions`` [T] overrides the
+    default global positions 0..T-1 (decode steps pass their absolute
+    position so cached keys and the new query rotate consistently)."""
     b, t, h, d = x.shape
     half = d // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]
+    if positions is None:
+        positions = jnp.arange(t, dtype=jnp.float32)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
     cos = jnp.cos(angles)[None, :, None, :]      # [1, T, 1, half]
     sin = jnp.sin(angles)[None, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
@@ -40,11 +45,18 @@ def rope(x: jnp.ndarray, *, base: float = 10000.0) -> jnp.ndarray:
 
 
 class MultiHeadAttention(nn.Module):
+    """Pluggable-kernel attention; ``decode=True`` switches to single-token
+    autoregressive serving with a KV cache in the flax "cache" collection
+    (zero-init via `init`, threaded through `apply(..., mutable=["cache"])`
+    by `idunno_tpu.engine.generate`)."""
+
     dim: int
     num_heads: int
     causal: bool = True
     attn_fn: AttnFn = full_attention
     use_rope: bool = True
+    decode: bool = False
+    max_decode_len: int = 0
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -57,9 +69,62 @@ class MultiHeadAttention(nn.Module):
         q = dense(features=(self.num_heads, head_dim), name="q")(x)
         k = dense(features=(self.num_heads, head_dim), name="k")(x)
         v = dense(features=(self.num_heads, head_dim), name="v")(x)
+        if self.decode:
+            return self._decode_step(q, k, v)
         if self.use_rope:
             q, k = rope(q), rope(k)
         out = self.attn_fn(q, k, v, causal=self.causal)
+        return nn.DenseGeneral(features=self.dim, axis=(-2, -1),
+                               dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               name="out")(out)
+
+    def _decode_step(self, q, k, v):
+        """One token in, one token out: write this step's K/V at the cache
+        cursor, attend the query over every cached position ≤ cursor.
+
+        Uses its own cached softmax-attention kernel — any correct causal
+        ``attn_fn`` (full/ring/flash) is numerically equivalent, so the
+        training-time kernel choice does not matter here; non-causal models
+        cannot be decoded autoregressively and are rejected."""
+        if self.max_decode_len <= 0:
+            raise ValueError("decode=True needs max_decode_len > 0")
+        if not self.causal:
+            raise ValueError("decode=True requires causal=True "
+                             "(autoregressive serving of a bidirectional "
+                             "model would silently change its semantics)")
+        b, t, h, d = q.shape
+        if t != 1:
+            raise ValueError(f"decode step takes one token, got {t}")
+        ck = self.variable("cache", "cached_k", jnp.zeros,
+                           (b, self.max_decode_len, h, d), k.dtype)
+        cv = self.variable("cache", "cached_v", jnp.zeros,
+                           (b, self.max_decode_len, h, d), v.dtype)
+        cur = self.variable("cache", "cursor",
+                            lambda: jnp.zeros((), jnp.int32))
+        i = cur.value
+        if self.use_rope:
+            pos = i[None].astype(jnp.float32)
+            q = rope(q, positions=pos)
+            k = rope(k, positions=pos)
+        # overflow guard: past max_decode_len the write would clamp onto the
+        # last slot and the mask would unmask everything — keep the cache
+        # intact and poison the scores to NaN so misuse is loud, not silent
+        overflow = i >= self.max_decode_len
+        new_k = jax.lax.dynamic_update_slice(ck.value, k, (0, i, 0, 0))
+        new_v = jax.lax.dynamic_update_slice(cv.value, v, (0, i, 0, 0))
+        new_k = jnp.where(overflow, ck.value, new_k)
+        new_v = jnp.where(overflow, cv.value, new_v)
+        if not self.is_initializing():     # init must return a CLEAN cache
+            ck.value, cv.value, cur.value = new_k, new_v, i + 1
+        scores = jnp.einsum("bqhd,bthd->bhqt", q.astype(jnp.float32),
+                            new_k.astype(jnp.float32)) / (d ** 0.5)
+        scores = jnp.where(overflow, jnp.nan, scores)
+        mask = jnp.arange(self.max_decode_len) <= i       # [T]
+        scores = jnp.where(mask[None, None, None, :], scores, -jnp.inf)
+        weights = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqt,bthd->bqhd", weights,
+                         new_v.astype(jnp.float32)).astype(self.dtype)
         return nn.DenseGeneral(features=self.dim, axis=(-2, -1),
                                dtype=self.dtype,
                                param_dtype=self.param_dtype,
@@ -78,6 +143,8 @@ class Block(nn.Module):
     attn_fn: AttnFn = full_attention
     ffn_factory: FfnFactory | None = None
     use_rope: bool = True
+    decode: bool = False
+    max_decode_len: int = 0
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -87,7 +154,9 @@ class Block(nn.Module):
                      param_dtype=self.param_dtype)
         x = x + MultiHeadAttention(
             self.dim, self.num_heads, causal=self.causal,
-            attn_fn=self.attn_fn, use_rope=self.use_rope, dtype=self.dtype,
+            attn_fn=self.attn_fn, use_rope=self.use_rope,
+            decode=self.decode, max_decode_len=self.max_decode_len,
+            dtype=self.dtype,
             param_dtype=self.param_dtype, name="attn")(ln(name="ln1")(x))
         h_in = ln(name="ln2")(x)
         if self.ffn_factory is not None:
@@ -117,6 +186,8 @@ class TransformerLM(nn.Module):
     attn_fn: AttnFn = full_attention
     ffn_factory: FfnFactory | None = None
     ffn_every: int = 1
+    decode: bool = False
+    max_decode_len: int = 0
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -132,6 +203,8 @@ class TransformerLM(nn.Module):
             x = Block(self.dim, self.num_heads, causal=self.causal,
                       attn_fn=self.attn_fn,
                       ffn_factory=self.ffn_factory if use_ffn else None,
+                      decode=self.decode,
+                      max_decode_len=self.max_decode_len,
                       dtype=self.dtype,
                       param_dtype=self.param_dtype, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
