@@ -31,6 +31,12 @@ import time
 REFERENCE_IMAGES_PER_S = 400 / 9.0   # ≈44.4, whole reference cluster
 METRIC = "resnet18_imagenet_inference_throughput"
 
+# The TPU sits behind a tunnel that is intermittently down; a successful TPU
+# measurement is cached here so a later run on a dead tunnel can still report
+# the last real number in its diagnostics instead of only "unavailable".
+_LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "BENCH_LAST_GOOD.json")
+
 # Peak dense bf16 FLOP/s per chip, keyed by substrings of device_kind.
 # (Public figures: v2 45T, v3 123T, v4 275T, v5e 197T, v5p 459T, v6e 918T.)
 _PEAK_BF16 = [
@@ -73,8 +79,22 @@ def emit(value, unit="images/sec", vs_baseline=None, error=None, **details):
         line["error"] = error
     if details:
         line["details"] = details
+    if value is not None and details.get("platform") == "tpu":
+        try:
+            with open(_LAST_GOOD, "w") as f:
+                json.dump(dict(line, recorded_at=time.time()), f)
+        except OSError:
+            pass
     print(json.dumps(line))
     sys.stdout.flush()
+
+
+def last_good_record() -> dict | None:
+    try:
+        with open(_LAST_GOOD) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def probe_backend(timeout_s: float):
@@ -138,13 +158,18 @@ def run_bench(devices) -> None:
     from idunno_tpu.engine.inference import InferenceEngine
     from idunno_tpu.parallel.mesh import DATA_AXIS, local_mesh
 
+    # persistent compile cache: the ~80 s/remote-compile through the tunnel
+    # drops to ~1 s on later runs of the same shapes (survives processes)
+    from idunno_tpu.utils.compile_cache import enable_persistent_cache
+    enable_persistent_cache()
+
     t_start = time.perf_counter()
     budget_s = float(os.environ.get("BENCH_TIME_BUDGET_S", "420"))
     base_bs = int(os.environ.get("BENCH_BATCH", "512"))
-    n_batches = int(os.environ.get("BENCH_NBATCH", "4"))
+    n_batches = int(os.environ.get("BENCH_NBATCH", "2"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
     sweep = [int(s) for s in
-             os.environ.get("BENCH_SWEEP", "256,512,1024").split(",")]
+             os.environ.get("BENCH_SWEEP", "256,1024").split(",")]
     platform = devices[0].platform
     device_kind = getattr(devices[0], "device_kind", platform)
 
@@ -275,6 +300,9 @@ def main() -> None:
             fb = cpu_fallback_record(budget_s=240)
             if fb:
                 diag["cpu_fallback"] = fb
+        lg = last_good_record()
+        if lg:
+            diag["last_good_tpu_run"] = lg
         emit(None, error=f"TPU backend unavailable: {attempts[-1]}", **diag)
         # rc 0: the JSON line IS the result; a non-zero rc made round 1
         # record parsed=null.

@@ -50,6 +50,9 @@ def main(argv: list[str] | None = None) -> int:
         import jax
         jax.config.update("jax_platforms", "cpu")
 
+    from idunno_tpu.utils.compile_cache import enable_persistent_cache
+    enable_persistent_cache()
+
     if args.jax_coordinator:
         from idunno_tpu.parallel.mesh import initialize_distributed
         initialize_distributed(args.jax_coordinator,

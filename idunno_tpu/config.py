@@ -126,3 +126,13 @@ class EngineConfig:
     # uint8→normalized preprocess: "auto" = Pallas kernel on TPU, XLA
     # elsewhere; "pallas" / "xla" force one path.
     preprocess: str = "auto"
+    # models to load + compile in the background at node start, so the first
+    # query doesn't pay the (remote) compile — the reference instead paid a
+    # model download+load on EVERY task (`alexnet_resnet.py:17-22`) and its
+    # second job took 40-49 s to start (BASELINE.md)
+    warmup_models: tuple = ()
+
+    def __post_init__(self) -> None:
+        # JSON configs carry lists; keep the dataclass hashable/frozen-safe
+        object.__setattr__(self, "warmup_models",
+                           tuple(self.warmup_models))

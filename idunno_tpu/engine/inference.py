@@ -78,18 +78,30 @@ class InferenceEngine:
 
     def __init__(self, config: EngineConfig | None = None, mesh=None,
                  seed: int = 0, pretrained: bool = True):
+        import threading
+
         self.config = config or EngineConfig()
         self.mesh = mesh if mesh is not None else local_mesh()
         self.seed = seed
         self.pretrained = pretrained
         self._models: dict[str, _LoadedModel] = {}
+        self._load_lock = threading.Lock()
         self._pallas_ok: bool | None = None   # resolved on first load
         self.categories = imagenet_categories()
 
     # -- loading ----------------------------------------------------------
 
     def load(self, name: str) -> None:
-        """Initialise (or convert) weights once and pin them in HBM."""
+        """Initialise (or convert) weights once and pin them in HBM.
+        Thread-safe: a warmup thread and the worker loop may race here; the
+        lock guarantees one _LoadedModel (and so one shared jit cache) per
+        name."""
+        if name in self._models:
+            return
+        with self._load_lock:
+            self._load_locked(name)
+
+    def _load_locked(self, name: str) -> None:
         if name in self._models:
             return
         module = create_model(name,

@@ -26,6 +26,40 @@ AttnFn = Callable[..., jnp.ndarray]     # (q, k, v, *, causal) -> out
 FfnFactory = Callable[..., nn.Module]
 
 
+def make_attn_fn(kind: str = "auto", *, mesh=None, axis: str = "data",
+                 **kw) -> AttnFn:
+    """One knob for the attention kernel family:
+
+      full    — reference XLA attention (single device)
+      flash   — Pallas blockwise kernel, training-capable (custom VJP);
+                pass ``interpret=True`` off-TPU
+      ring    — blockwise ring attention, sequence sharded over ``mesh``
+      ulysses — all-to-all head re-sharding over ``mesh``
+      auto    — flash on TPU, full elsewhere
+
+    ring/ulysses require ``mesh`` (the sequence axis is ``axis``)."""
+    from functools import partial as _p
+
+    if kind == "auto":
+        import jax as _jax
+        kind = "flash" if _jax.devices()[0].platform == "tpu" else "full"
+    if kind == "full":
+        return full_attention
+    if kind == "flash":
+        from idunno_tpu.ops.flash_attention import flash_attention
+        return _p(flash_attention, **kw) if kw else flash_attention
+    if kind in ("ring", "ulysses"):
+        if mesh is None:
+            raise ValueError(f"attn kind {kind!r} needs a mesh")
+        if kind == "ring":
+            from idunno_tpu.parallel.ring_attention import ring_attention
+            return _p(ring_attention, mesh=mesh, seq_axis=axis, **kw)
+        from idunno_tpu.parallel.ulysses import ulysses_attention
+        return _p(ulysses_attention, mesh=mesh, seq_axis=axis, **kw)
+    raise ValueError(f"unknown attention kind {kind!r}; "
+                     "want auto|full|flash|ring|ulysses")
+
+
 def rope(x: jnp.ndarray, *, base: float = 10000.0,
          positions: jnp.ndarray | None = None) -> jnp.ndarray:
     """Rotary embedding over [B, T, H, D]; ``positions`` [T] overrides the

@@ -66,12 +66,29 @@ class Node:
             ("master-duties", self._master_loop),
             ("worker", self._worker_loop),
         ]
+        warmup = getattr(getattr(self.engine, "config", None),
+                         "warmup_models", ())
+        if warmup and hasattr(self.engine, "warmup"):
+            loops.append(("warmup", lambda: self._warmup(warmup)))
         for name, fn in loops:
             t = threading.Thread(target=fn, daemon=True,
                                  name=f"{self.host}-{name}")
             t.start()
             self._threads.append(t)
         self.log.info("node %s started", self.host)
+
+    def _warmup(self, models) -> None:
+        """Compile the configured models before the first job arrives (the
+        worker loop still serves: jobs for a still-compiling model simply
+        block on the same jit cache entry)."""
+        for name in models:
+            if self._stop.is_set():
+                return
+            try:
+                secs = self.engine.warmup(name)
+                self.log.info("warmed %s in %.1fs", name, secs)
+            except Exception as e:  # noqa: BLE001 - warmup must not kill node
+                self.log.warning("warmup %s failed: %s", name, e)
 
     def stop(self) -> None:
         self._stop.set()

@@ -279,3 +279,42 @@ def test_weights_provenance_mixed_when_workers_disagree(cluster):
     run_jobs(services)
     assert (services["n0"].weights_provenance()["alexnet"]
             == "mixed(pretrained,random)")
+
+
+def test_node_warmup_thread(tmp_path):
+    """EngineConfig.warmup_models compiles models at node start so the first
+    query skips the compile (reference 2nd-job start: 40-49 s, BASELINE.md)."""
+    import time
+
+    from idunno_tpu.comm.inproc import InProcNetwork
+    from idunno_tpu.config import ClusterConfig, EngineConfig
+    from idunno_tpu.serve.node import Node
+
+    class WarmupEngine:
+        config = EngineConfig(warmup_models=("resnet", "bogus"))
+
+        def __init__(self):
+            self.warmed = []
+
+        def warmup(self, name):
+            if name == "bogus":
+                raise ValueError("no such model")   # must not kill the node
+            self.warmed.append(name)
+            return 0.0
+
+        def infer(self, name, start, end, dataset_root=None):
+            raise AssertionError("not used")
+
+    cfg = ClusterConfig(hosts=("n0",), coordinator="n0",
+                        standby_coordinator="n0", introducer="n0")
+    net = InProcNetwork()
+    eng = WarmupEngine()
+    node = Node("n0", cfg, net.transport("n0"), str(tmp_path), engine=eng)
+    node.start()
+    try:
+        deadline = time.time() + 5.0
+        while time.time() < deadline and eng.warmed != ["resnet"]:
+            time.sleep(0.02)
+        assert eng.warmed == ["resnet"]
+    finally:
+        node.stop()

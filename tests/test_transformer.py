@@ -11,6 +11,33 @@ from idunno_tpu.parallel.mesh import make_mesh
 from idunno_tpu.parallel.ring_attention import ring_attention
 
 
+def test_make_attn_fn_selector(eight_devices):
+    """One knob selects every attention family and they agree numerically."""
+    import pytest
+    from idunno_tpu.models.transformer import full_attention, make_attn_fn
+
+    mesh = make_mesh(8, 1, devices=eight_devices)
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 8, 16))
+    k = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 8, 16))
+    v = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 8, 16))
+    want = full_attention(q, k, v, causal=True)
+
+    assert make_attn_fn("auto") is full_attention      # cpu → full
+    for kind, kw in (("flash", {"interpret": True, "block_q": 16,
+                                "block_k": 16}),
+                     ("ring", {"mesh": mesh}),
+                     ("ulysses", {"mesh": mesh})):
+        fn = make_attn_fn(kind, **kw)
+        got = fn(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    with pytest.raises(ValueError, match="needs a mesh"):
+        make_attn_fn("ring")
+    with pytest.raises(ValueError, match="unknown attention"):
+        make_attn_fn("bogus")
+
+
 def test_lm_forward_shapes():
     model = TransformerLM(vocab=64, dim=32, depth=1, num_heads=2)
     tokens = jnp.zeros((2, 16), jnp.int32)
