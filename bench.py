@@ -79,7 +79,8 @@ def emit(value, unit="images/sec", vs_baseline=None, error=None, **details):
         line["error"] = error
     if details:
         line["details"] = details
-    if value is not None and details.get("platform") == "tpu":
+    if (value is not None and error is None
+            and details.get("platform") == "tpu"):
         try:
             with open(_LAST_GOOD, "w") as f:
                 json.dump(dict(line, recorded_at=time.time()), f)

@@ -222,10 +222,7 @@ class Shell:
     # -- stats ------------------------------------------------------------
 
     def _models_seen(self) -> list[str]:
-        svc = self.node.inference
-        models = {m for m, _ in svc.scheduler.book.queries()}
-        models.update(svc._qnum)
-        return sorted(models)
+        return self.node.inference.models_seen()
 
     def cmd_c1(self, args: list[str]) -> str:
         svc = self.node.inference

@@ -40,10 +40,16 @@ def make_attn_fn(kind: str = "auto", *, mesh=None, axis: str = "data",
     ring/ulysses require ``mesh`` (the sequence axis is ``axis``)."""
     from functools import partial as _p
 
-    if kind == "auto":
+    auto = kind == "auto"
+    if auto:
         import jax as _jax
         kind = "flash" if _jax.devices()[0].platform == "tpu" else "full"
     if kind == "full":
+        # auto may resolve here holding flash-only kwargs — drop them (the
+        # graceful-degradation path); an EXPLICIT 'full' with kwargs is a
+        # caller error and must not be silently ignored
+        if kw and not auto:
+            raise TypeError(f"full attention takes no kwargs, got {kw}")
         return full_attention
     if kind == "flash":
         from idunno_tpu.ops.flash_attention import flash_attention

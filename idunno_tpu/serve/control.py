@@ -86,6 +86,22 @@ class ControlService:
             recs = node.inference.results(p["model"], int(p["qnum"]))
             return {"records": [list(r) for r in recs],
                     "weights": node.inference.weights_provenance()}
+        if verb == "stats":
+            # remote c1/c2: per-model query rate + processing percentiles
+            m = node.metrics
+            out = {}
+            loaded = getattr(node.engine, "loaded_models", lambda: [])
+            for model in (p.get("models") or node.inference.models_seen()
+                          or loaded()):
+                ps = m.processing_stats(model)
+                out[model] = {
+                    "query_rate": m.query_rate(
+                        model, node.config.query_batch_size),
+                    "image_rate": m.image_rate(model),
+                    "finished_images": m.finished_images(model),
+                    "processing": ps.as_list() if ps else None,
+                }
+            return {"stats": out}
         if verb == "grep":
             return {"matches": node.grep.query(p["pattern"])}
         raise ValueError(f"unknown control verb {verb!r}")

@@ -55,10 +55,11 @@ class FailoverManager:
         with svc._results_lock:
             results = {f"{m}\x00{q}": [list(r) for r in v]
                        for (m, q), v in svc._results.items()}
+            qnum = dict(svc._qnum)
         self._seq += 1
         return {"seq": self._seq,
                 "tasks": svc.scheduler.book.to_wire(),
-                "qnum": dict(svc._qnum),
+                "qnum": qnum,
                 "metrics": svc.metrics.to_wire(),
                 "results": results}
 
@@ -106,8 +107,9 @@ class FailoverManager:
             self._adopted = True
         svc = self.service
         svc.scheduler.book.load_wire(snap["tasks"])
-        svc._qnum.update({m: max(int(q), svc._qnum.get(m, 0))
-                          for m, q in snap["qnum"].items()})
+        with svc._results_lock:
+            svc._qnum.update({m: max(int(q), svc._qnum.get(m, 0))
+                              for m, q in snap["qnum"].items()})
         svc.metrics.load_wire(snap["metrics"])
         with svc._results_lock:
             for key, recs in snap["results"].items():

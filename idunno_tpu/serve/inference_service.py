@@ -161,6 +161,16 @@ class InferenceService:
     def query_done(self, model: str, qnum: int) -> bool:
         return self.scheduler.book.query_done(model, qnum)
 
+    def models_seen(self) -> list[str]:
+        """Models with at least one known query — the single source for the
+        shell's c1/c2 and the remote stats verb (query counters plus the
+        task book, which can know models the counters don't after a
+        failover adoption)."""
+        models = {m for m, _ in self.scheduler.book.queries()}
+        with self._results_lock:
+            models.update(self._qnum)
+        return sorted(models)
+
     def weights_provenance(self) -> dict[str, str]:
         """Per-model weight provenance aggregated over RESULTs:
         "pretrained" | "random" | "unknown", or "mixed(...)" if workers
@@ -198,11 +208,12 @@ class InferenceService:
 
     def _master_submit(self, model: str, start: int, end: int,
                        dataset: str | None) -> Message:
-        self.scheduler.avg_query_time = {
-            m: self.metrics.avg_query_time(m)
-            for m in set(self._qnum) | {model}}
-        qnum = self._qnum.get(model, 0) + 1
-        self._qnum[model] = qnum
+        with self._results_lock:                 # _qnum guarded like results
+            self.scheduler.avg_query_time = {
+                m: self.metrics.avg_query_time(m)
+                for m in set(self._qnum) | {model}}
+            qnum = self._qnum.get(model, 0) + 1
+            self._qnum[model] = qnum
         workers = self._eligible_workers()
         if not workers:
             return Message(MessageType.ERROR, self.host,

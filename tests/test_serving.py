@@ -318,3 +318,67 @@ def test_node_warmup_thread(tmp_path):
         assert eng.warmed == ["resnet"]
     finally:
         node.stop()
+
+
+def test_control_rpc_verbs(tmp_path):
+    """The remote control surface (serve/control.py): status, SDFS verbs,
+    inference, results and stats driven through the transport — what an
+    external process (ops tooling, the multiprocess e2e) sees."""
+    import time
+
+    from idunno_tpu.comm.inproc import InProcNetwork
+    from idunno_tpu.comm.message import Message
+    from idunno_tpu.config import ClusterConfig
+    from idunno_tpu.serve.node import Node
+    from idunno_tpu.utils.types import MessageType
+    from tests.test_shell_grep import StubEngine
+
+    cfg = ClusterConfig(hosts=("n0", "n1"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0",
+                        replication_factor=2, query_batch_size=50,
+                        query_interval_s=0.0, ping_interval_s=0.05,
+                        failure_timeout_s=0.5, metadata_interval_s=0.1)
+    net = InProcNetwork()
+    nodes = {h: Node(h, cfg, net.transport(h), str(tmp_path / h),
+                     engine=StubEngine()) for h in cfg.hosts}
+
+    def control(host, verb, **kw):
+        out = net.transport("client").call(
+            host, "control",
+            Message(MessageType.INFERENCE, "client", {"verb": verb, **kw}))
+        assert out is not None and out.type is MessageType.ACK, out
+        return out.payload
+
+    try:
+        for n in nodes.values():
+            n.start()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not all(
+                len(n.membership.members.alive_hosts()) == 2
+                for n in nodes.values()):
+            time.sleep(0.02)
+
+        st = control("n0", "status")
+        assert st["acting_master"] == "n0"
+        assert sorted(st["members"]) == ["n0", "n1"]
+
+        assert control("n1", "put_bytes", name="f.txt",
+                       data="abc")["version"] == 1
+        assert control("n0", "get_bytes", name="f.txt")["data"] == "abc"
+        assert len(control("n0", "ls", name="f.txt")["hosts"]) == 2
+
+        q = control("n0", "inference", model="resnet", start=0, end=49)
+        qnum = q["qnums"][0]
+        deadline = time.time() + 10.0
+        while time.time() < deadline and not control(
+                "n0", "query_done", model="resnet", qnum=qnum)["done"]:
+            time.sleep(0.05)
+        res = control("n0", "results", model="resnet", qnum=qnum)
+        assert len(res["records"]) == 50
+
+        stats = control("n0", "stats")
+        assert stats["stats"]["resnet"]["finished_images"] == 50
+        assert stats["stats"]["resnet"]["processing"] is not None
+    finally:
+        for n in nodes.values():
+            n.stop()
