@@ -40,6 +40,11 @@ def make_attn_fn(kind: str = "auto", *, mesh=None, axis: str = "data",
     ring/ulysses require ``mesh`` (the sequence axis is ``axis``)."""
     from functools import partial as _p
 
+    if mesh is not None and kind not in ("ring", "ulysses"):
+        # a mesh means sequence parallelism, which only ring/ulysses do —
+        # silently dropping it would serve single-device attention
+        raise ValueError(f"attn kind {kind!r} ignores mesh; "
+                         "use kind='ring' or 'ulysses'")
     auto = kind == "auto"
     if auto:
         import jax as _jax

@@ -87,11 +87,16 @@ class ControlService:
             return {"records": [list(r) for r in recs],
                     "weights": node.inference.weights_provenance()}
         if verb == "stats":
-            # remote c1/c2: per-model query rate + processing percentiles
+            # remote c1/c2: per-model rates, counts, processing percentiles
+            # and the weights-provenance marker
             m = node.metrics
-            out = {}
+            models = p.get("models")
+            if isinstance(models, str):            # scalar like other verbs
+                models = [models]
             loaded = getattr(node.engine, "loaded_models", lambda: [])
-            for model in (p.get("models") or node.inference.models_seen()
+            provenance = node.inference.weights_provenance()
+            out = {}
+            for model in (models or node.inference.models_seen()
                           or loaded()):
                 ps = m.processing_stats(model)
                 out[model] = {
@@ -99,7 +104,9 @@ class ControlService:
                         model, node.config.query_batch_size),
                     "image_rate": m.image_rate(model),
                     "finished_images": m.finished_images(model),
+                    "finished_queries": m.finished_queries(model),
                     "processing": ps.as_list() if ps else None,
+                    "weights": provenance.get(model, "unknown"),
                 }
             return {"stats": out}
         if verb == "grep":

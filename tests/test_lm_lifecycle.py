@@ -1,0 +1,98 @@
+"""The LM user journey across components: train → checkpoint into the
+replicated store → restore on a DIFFERENT node → KV-cached generation —
+plus rollback to a historical version. Exercises engine/train_lm,
+engine/checkpoint, store/sdfs and engine/generate together, the workflow
+the reference could never do (no checkpointing, no sequence models)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from idunno_tpu.comm.inproc import InProcNetwork
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.engine.checkpoint import (
+    checkpoint_holders, restore_variables, restore_version, save_variables)
+from idunno_tpu.engine.generate import generate
+from idunno_tpu.engine.train_lm import (
+    create_lm_train_state, make_lm_train_step)
+from idunno_tpu.membership.service import MembershipService
+from idunno_tpu.models.transformer import TransformerLM
+from idunno_tpu.store.sdfs import FileStoreService
+
+from tests.test_membership import FakeClock, pump
+
+
+@pytest.fixture
+def stores(tmp_path):
+    cfg = ClusterConfig(hosts=("n0", "n1", "n2"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0",
+                        replication_factor=2)
+    net = InProcNetwork()
+    clock = FakeClock()
+    members, stores = {}, {}
+    for h in cfg.hosts:
+        t = net.transport(h)
+        members[h] = MembershipService(h, cfg, t, clock=clock)
+        stores[h] = FileStoreService(h, cfg, t, members[h],
+                                     str(tmp_path / h))
+    for h in cfg.hosts:
+        members[h].join()
+        clock.advance(0.01)
+    pump(members, clock)
+    return stores
+
+
+def test_train_checkpoint_restore_generate(stores):
+    model = TransformerLM(vocab=32, dim=32, depth=2, num_heads=4)
+    tx = optax.adam(1e-2)
+    state = create_lm_train_state(model, jax.random.PRNGKey(0), 16, tx)
+
+    # v1: the untrained weights (rollback target)
+    v1 = save_variables(stores["n0"], "lm", {"params": state.params})
+    assert v1 == 1
+
+    step = jax.jit(make_lm_train_step(model, tx))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 32)
+    for _ in range(10):
+        state, metrics = step(state, toks)
+    v2 = save_variables(stores["n0"], "lm", {"params": state.params})
+    assert v2 == 2
+    assert len(checkpoint_holders(stores["n1"], "lm")) >= 2  # replicated
+
+    # restore on a DIFFERENT node, structure from a fresh template
+    template = {"params": model.init(jax.random.PRNGKey(9),
+                                     jnp.zeros((1, 16), jnp.int32))["params"]}
+    restored, version = restore_variables(stores["n2"], "lm", template)
+    assert version == 2
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), restored["params"], state.params)
+
+    # generation from the restored weights == generation from the live ones
+    prompt = toks[:2, :4]
+    out_live = generate(model, state.params, prompt, prompt_len=4,
+                        max_new=6)
+    out_restored = generate(model, restored["params"], prompt, prompt_len=4,
+                            max_new=6)
+    np.testing.assert_array_equal(np.asarray(out_live),
+                                  np.asarray(out_restored))
+
+    # a trained LM should continue its own training distribution better
+    # than random init: compare next-token loss on the training batch
+    logits_trained = model.apply({"params": restored["params"]}, toks)
+    rolled = restore_version(stores["n1"], "lm", template, version=1)
+    logits_init = model.apply({"params": rolled["params"]}, toks)
+
+    def ce(logits):
+        lp = jax.nn.log_softmax(logits[:, :-1])
+        tgt = toks[:, 1:]
+        return float(-jnp.take_along_axis(
+            lp, tgt[..., None], axis=-1).mean())
+
+    assert ce(logits_trained) < ce(logits_init) * 0.8
+
+    # rollback generation differs from the trained one (sanity that
+    # versioned restore really returned the old weights)
+    out_rolled = generate(model, rolled["params"], prompt, prompt_len=4,
+                          max_new=6)
+    assert (np.asarray(out_rolled) != np.asarray(out_live)).any()
