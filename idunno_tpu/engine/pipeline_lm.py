@@ -42,7 +42,7 @@ from idunno_tpu.engine.train import TrainState
 from idunno_tpu.engine.train_lm import next_token_loss
 from idunno_tpu.models.transformer import Block, TransformerLM
 from idunno_tpu.parallel.pipeline import (
-    STAGE_AXIS, pipeline_apply, split_microbatches, stack_stage_params)
+    STAGE_AXIS, pipeline_apply, stack_stage_params)
 
 
 def _check_pipelineable(model: TransformerLM, num_stages: int) -> int:
@@ -98,9 +98,12 @@ def _submodules(model: TransformerLM):
 
 def make_pipelined_lm_apply(model: TransformerLM, mesh: Mesh,
                             num_microbatches: int, *,
-                            axis: str = STAGE_AXIS):
+                            axis: str = STAGE_AXIS,
+                            data_axis: str | None = None):
     """Pure ``(pp_params, tokens[B, T]) -> logits[B, T, vocab]`` running the
-    block stack through the GPipe schedule; B % num_microbatches == 0."""
+    block stack through the GPipe schedule; B % num_microbatches == 0.
+    With ``data_axis`` (2-D mesh) each microbatch's batch dim is sharded
+    over it — PP x DP from one function."""
     num_stages = mesh.shape[axis]
     _check_pipelineable(model, num_stages)
     block, embed, ln_f, head = _submodules(model)
@@ -114,10 +117,19 @@ def make_pipelined_lm_apply(model: TransformerLM, mesh: Mesh,
 
     def apply_fn(pp_params, tokens):
         b = tokens.shape[0]
+        if b % num_microbatches:
+            raise ValueError(f"batch {b} not divisible by "
+                             f"{num_microbatches} microbatches")
+        mb = b // num_microbatches
         x = embed.apply({"params": pp_params["outer"]["embed"]}, tokens)
-        micro = split_microbatches(x, num_microbatches)
+        # interleaved microbatch layout: micro[m, j] = x[j*M + m], so
+        # sharding the mb dim over data_axis keeps each data shard's rows
+        # CONTIGUOUS in the batch — the tokens' own P(data) sharding — and
+        # no resharding collective is needed entering/leaving the schedule
+        micro = x.reshape(mb, num_microbatches, *x.shape[1:]).swapaxes(0, 1)
         y = pipeline_apply(stage_fn, pp_params["stages"], micro, mesh,
-                           axis=axis)
+                           axis=axis, data_axis=data_axis)
+        y = y.swapaxes(0, 1)                       # [mb, M, T, dim]
         x = y.reshape(b, *y.shape[2:])
         x = ln_f.apply({"params": pp_params["outer"]["ln_f"]}, x)
         logits = head.apply({"params": pp_params["outer"]["head"]}, x)
@@ -166,11 +178,12 @@ def shard_pipelined_state(state: TrainState, mesh: Mesh, *,
 def make_pipelined_lm_train_step(model: TransformerLM, mesh: Mesh,
                                  tx: optax.GradientTransformation,
                                  num_microbatches: int, *,
-                                 axis: str = STAGE_AXIS):
+                                 axis: str = STAGE_AXIS,
+                                 data_axis: str | None = None):
     """Pure ``(state, tokens[int32 B,T]) -> (state, metrics)`` with loss +
     grads through the pipeline schedule."""
     apply_fn = make_pipelined_lm_apply(model, mesh, num_microbatches,
-                                       axis=axis)
+                                       axis=axis, data_axis=data_axis)
 
     def loss_fn(pp_params, tokens):
         ce, acc = next_token_loss(apply_fn(pp_params, tokens), tokens)
@@ -191,10 +204,12 @@ def make_pipelined_lm_train_step(model: TransformerLM, mesh: Mesh,
 def jit_pipelined_lm_train_step(model: TransformerLM, mesh: Mesh,
                                 tx: optax.GradientTransformation,
                                 num_microbatches: int, *,
-                                axis: str = STAGE_AXIS):
-    """jit the pipelined step: tokens replicated (the schedule microbatches
-    internally), param shardings inherited from the placed state."""
+                                axis: str = STAGE_AXIS,
+                                data_axis: str | None = None):
+    """jit the pipelined step: tokens replicated across stages (the schedule
+    microbatches internally) and batch-sharded over ``data_axis`` when
+    given; param shardings inherited from the placed state."""
     step = make_pipelined_lm_train_step(model, mesh, tx, num_microbatches,
-                                        axis=axis)
-    rep = NamedSharding(mesh, P())
-    return jax.jit(step, in_shardings=(None, rep))
+                                        axis=axis, data_axis=data_axis)
+    tok_spec = P(data_axis) if data_axis else P()
+    return jax.jit(step, in_shardings=(None, NamedSharding(mesh, tok_spec)))

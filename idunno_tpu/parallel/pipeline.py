@@ -44,7 +44,8 @@ def split_microbatches(x: jnp.ndarray, num: int) -> jnp.ndarray:
 
 def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                    stage_params: Any, microbatches: jnp.ndarray,
-                   mesh: Mesh, *, axis: str = STAGE_AXIS) -> jnp.ndarray:
+                   mesh: Mesh, *, axis: str = STAGE_AXIS,
+                   data_axis: str | None = None) -> jnp.ndarray:
     """Run microbatches through the p-stage pipeline.
 
     stage_fn: (one stage's params, activation [mb, ...]) → [mb, ...]
@@ -52,9 +53,20 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     stage_params: pytree with leaves [p, ...] (see ``stack_stage_params``).
     microbatches: [M, mb, ...] — the global input, replicated.
     Returns [M, mb, ...] — equal to stage_{p-1}(...stage_0(x)), replicated.
-    """
+
+    2-D composition: with ``data_axis`` set (a second mesh axis), the
+    microbatch dim mb is sharded over it — each data shard runs the same
+    GPipe schedule on its slice of every microbatch (PP × DP; stage params
+    stay replicated across ``data_axis``, so XLA all-reduces their grads
+    over it under AD, the standard DP contract)."""
     p = mesh.shape[axis]
     m = microbatches.shape[0]
+    if data_axis is not None:
+        dp = mesh.shape[data_axis]
+        if microbatches.shape[1] % dp:
+            raise ValueError(
+                f"microbatch size {microbatches.shape[1]} not divisible by "
+                f"data axis {data_axis!r} size {dp}")
 
     def body(params_sh, x):
         # params_sh leaves arrive [1, ...] (stage-sharded); drop the dim.
@@ -85,7 +97,8 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         mask = jnp.where(s == p - 1, 1.0, 0.0).astype(outputs.dtype)
         return jax.lax.psum(outputs * mask, axis)
 
+    mb_spec = P(None, data_axis) if data_axis else P()
     return shard_map(body, mesh=mesh,
                      in_specs=(jax.tree.map(lambda _: P(axis), stage_params),
-                               P()),
-                     out_specs=P())(stage_params, microbatches)
+                               mb_spec),
+                     out_specs=mb_spec)(stage_params, microbatches)
