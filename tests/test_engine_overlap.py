@@ -74,3 +74,16 @@ def test_infer_empty_and_partial_ranges(eight_devices):
     assert len(res.records) == 11
     assert res.records[0][0] == "test_0.JPEG"
     assert res.records[-1][0] == "test_10.JPEG"
+
+
+def test_vit_serves_through_engine(eight_devices):
+    """The registered ViT family serves through the same engine surface as
+    the reference's CNNs (model registry extensibility, SURVEY.md C5)."""
+    eng = InferenceEngine(
+        EngineConfig(batch_size=8, image_size=64, resize_size=64),
+        mesh=local_mesh(), pretrained=False)
+    res = eng.infer("vit_tiny", 0, 15)
+    assert len(res.records) == 16
+    assert res.weights == "random"
+    names = [r[0] for r in res.records]
+    assert names[0] == "test_0.JPEG" and names[-1] == "test_15.JPEG"
