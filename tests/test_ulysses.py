@@ -67,3 +67,20 @@ def test_transformer_with_ulysses_matches_local(eight_devices):
     got = jax.jit(lm_sp.apply)(variables, sharded)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_ulysses_with_flash_local_attention(eight_devices):
+    """Kernel composition: Ulysses all-to-all head re-sharding with the
+    Pallas flash kernel as the within-shard attention (interpret mode on
+    CPU) — the configuration a long-context TPU deployment runs."""
+    import functools
+    from idunno_tpu.ops.flash_attention import flash_attention
+
+    mesh = make_mesh(8, 1, devices=eight_devices)
+    q, k, v = _qkv(3)
+    want = full_attention(q, k, v, causal=True)
+    local = functools.partial(flash_attention, interpret=True,
+                              block_q=16, block_k=16)
+    got = ulysses_attention(q, k, v, mesh, causal=True, local_attn=local)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
