@@ -113,3 +113,71 @@ def test_measured_recovery_after_worker_kill(tmp_path):
     finally:
         for n in nodes.values():
             n.stop()
+
+
+class HangingEngine:
+    """hang=True instances hang on EVERY call (alive host, stuck task —
+    the straggler case, distinct from a crash); hang=False instances do
+    the work."""
+
+    def __init__(self, hang: bool):
+        self.hang = hang
+        self.calls = 0
+
+    def infer(self, name, start, end, dataset_root=None):
+        self.calls += 1
+        if self.hang:
+            time.sleep(3600)
+        return SimpleNamespace(
+            records=[(f"test_{i}.JPEG", f"class_{i % 1000}", 0.9)
+                     for i in range(start, end + 1)],
+            elapsed_s=0.01, weights="random")
+
+
+def test_straggler_redispatch_wall_clock(tmp_path):
+    """A worker that accepts its task but never finishes (no crash, so the
+    failure detector stays quiet) is caught by the straggler monitor and
+    its range re-dispatched — the reference shipped this disabled and with
+    an always-false timer comparison (`mp4_machinelearning.py:822, 1277`)."""
+    cfg = ClusterConfig(hosts=("n0", "n1", "n2"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0",
+                        replication_factor=2, query_batch_size=400,
+                        query_interval_s=0.0, ping_interval_s=0.1,
+                        failure_timeout_s=5.0, straggler_timeout_s=1.0,
+                        metadata_interval_s=0.2,
+                        rate_factor=10)   # pinned: all 3 workers get a chunk
+    net = InProcNetwork()
+    engines = {h: HangingEngine(hang=(h == "n2")) for h in cfg.hosts}
+    nodes = {h: Node(h, cfg, net.transport(h), str(tmp_path / h),
+                     engine=engines[h]) for h in cfg.hosts}
+    try:
+        for n in nodes.values():
+            n.start()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not all(
+                len(n.membership.members.alive_hosts()) == 3
+                for n in nodes.values()):
+            time.sleep(0.02)
+
+        master = nodes["n0"].inference
+        qnum = master.inference("resnet", 0, 299, pace_s=0.0)[0]
+        assert len(master.scheduler.book.in_flight("n2")) >= 1, \
+            "setup: the straggler never received a task"
+        t0 = time.perf_counter()
+        deadline = time.time() + 20.0
+        while time.time() < deadline and not master.query_done("resnet",
+                                                               qnum):
+            time.sleep(0.02)
+        assert master.query_done("resnet", qnum), \
+            "straggler's range was never re-dispatched"
+        elapsed = time.perf_counter() - t0
+        assert engines["n2"].calls >= 1          # it really was dispatched
+        recs = master.results("resnet", qnum)
+        assert {r[0] for r in recs} == {f"test_{i}.JPEG"
+                                        for i in range(300)}
+        # n2 stays RUNNING: stuck, not dead
+        assert nodes["n0"].membership.members.is_alive("n2")
+        assert elapsed < 15.0, elapsed
+    finally:
+        for n in nodes.values():
+            n.stop()
