@@ -149,6 +149,7 @@ def MoETransformerLM(vocab: int = 1024, dim: int = 128, depth: int = 2,
                      attn_fn: AttnFn = full_attention,
                      mesh: Mesh | None = None, axis: str = EXPERT_AXIS,
                      moe_every: int = 1, hidden_ratio: int = 4, k: int = 1,
+                     remat: bool = False,
                      dtype=jnp.float32, param_dtype=jnp.float32
                      ) -> TransformerLM:
     """Causal LM with switch-MoE FFNs — `TransformerLM` with the expert
@@ -160,7 +161,8 @@ def MoETransformerLM(vocab: int = 1024, dim: int = 128, depth: int = 2,
         causal=causal, attn_fn=attn_fn,
         ffn_factory=switch_ffn_factory(n_experts, capacity_factor, mesh,
                                        axis, hidden_ratio, k=k),
-        ffn_every=moe_every, dtype=dtype, param_dtype=param_dtype)
+        ffn_every=moe_every, remat=remat,
+        dtype=dtype, param_dtype=param_dtype)
 
 
 def moe_aux_loss(mutated_collections) -> jnp.ndarray:

@@ -233,6 +233,7 @@ class TransformerLM(nn.Module):
     ffn_every: int = 1
     decode: bool = False
     max_decode_len: int = 0
+    remat: bool = False
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -240,18 +241,22 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens):
         if self.ffn_every < 1:
             raise ValueError(f"ffn_every={self.ffn_every}: must be >= 1")
+        # remat: recompute each block's activations in the backward pass
+        # instead of storing them — activation memory drops from O(depth·T·d)
+        # to O(T·d) at ~1/3 extra FLOPs, the standard long-context trade
+        block_cls = nn.remat(Block) if self.remat else Block
         x = nn.Embed(self.vocab, self.dim, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="embed")(tokens)
         for i in range(self.depth):
             use_ffn = (self.ffn_factory is not None
                        and (self.depth - 1 - i) % self.ffn_every == 0)
-            x = Block(self.dim, self.num_heads, causal=self.causal,
-                      attn_fn=self.attn_fn,
-                      ffn_factory=self.ffn_factory if use_ffn else None,
-                      decode=self.decode,
-                      max_decode_len=self.max_decode_len,
-                      dtype=self.dtype,
-                      param_dtype=self.param_dtype, name=f"block{i}")(x)
+            x = block_cls(self.dim, self.num_heads, causal=self.causal,
+                          attn_fn=self.attn_fn,
+                          ffn_factory=self.ffn_factory if use_ffn else None,
+                          decode=self.decode,
+                          max_decode_len=self.max_decode_len,
+                          dtype=self.dtype,
+                          param_dtype=self.param_dtype, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln_f")(x)
         logits = nn.Dense(self.vocab, dtype=self.dtype,

@@ -73,6 +73,30 @@ def test_lm_fsdp_matches_replicated(eight_devices):
                                atol=5e-3)
 
 
+def test_lm_remat_matches_dense():
+    """remat=True (jax.checkpoint around every block) must not change
+    numerics — same losses and same trained params, less activation
+    memory for long contexts."""
+    kw = dict(vocab=64, dim=32, depth=2, num_heads=4)
+    tx = optax.adam(1e-2)
+    toks = _tokens(13, b=4, t=32)
+    runs = {}
+    for remat in (False, True):
+        model = TransformerLM(**kw, remat=remat)
+        state = create_lm_train_state(model, jax.random.PRNGKey(0), 32, tx)
+        step = jax.jit(make_lm_train_step(model, tx))
+        losses = []
+        for _ in range(4):
+            state, m = step(state, toks)
+            losses.append(float(m["loss"]))
+        runs[remat] = (losses, state.params)
+    np.testing.assert_allclose(runs[False][0], runs[True][0],
+                               rtol=1e-5, atol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4),
+        runs[False][1], runs[True][1])
+
+
 def test_lm_pipeline_matches_dense(eight_devices):
     """VERDICT #4: a REAL multi-layer TransformerLM pipelined over 4 stages
     with distinct per-stage weights trains through the published step and
