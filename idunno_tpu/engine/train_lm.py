@@ -49,10 +49,20 @@ def next_token_loss(logits: jnp.ndarray,
 
 
 def make_lm_train_step(model: nn.Module, tx: optax.GradientTransformation,
-                       aux_coef: float = 0.01):
+                       aux_coef: float = 0.01, accum_steps: int = 1):
     """Pure ``(state, tokens[int32 B,T]) -> (state, metrics)``: next-token
     CE (`next_token_loss`), plus ``aux_coef`` × the sowed MoE balance loss
-    (zero for dense models)."""
+    (zero for dense models).
+
+    ``accum_steps > 1`` = gradient accumulation: the batch is cut into
+    equal chunks scanned sequentially, grads averaged, ONE optimizer update
+    — for dense models identical numerics to the full batch (equal chunk
+    means), peak activation memory divided by ``accum_steps``. For MoE
+    models the aux balance loss is computed per chunk and averaged, which
+    differs (slightly) from the full-batch routing statistics — the
+    standard accumulation trade-off, not exact parity."""
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps={accum_steps}: must be >= 1")
 
     def loss_fn(params, tokens):
         logits, updates = model.apply({"params": params}, tokens,
@@ -61,9 +71,32 @@ def make_lm_train_step(model: nn.Module, tx: optax.GradientTransformation,
         aux = moe_aux_loss(updates)
         return ce + aux_coef * aux, (ce, aux, acc)
 
+    def grads_and_metrics(params, tokens):
+        if accum_steps == 1:
+            (loss, (ce, aux, acc)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, tokens)
+            return grads, loss, ce, aux, acc
+        b = tokens.shape[0]
+        if b % accum_steps:
+            raise ValueError(f"batch {b} not divisible by "
+                             f"accum_steps={accum_steps}")
+        chunks = tokens.reshape(accum_steps, b // accum_steps, -1)
+
+        def body(carry, chunk):
+            g_sum, sums = carry
+            (loss, (ce, aux, acc)), g = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, chunk)
+            g_sum = jax.tree.map(jnp.add, g_sum, g)
+            return (g_sum, sums + jnp.stack([loss, ce, aux, acc])), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (g_sum, sums), _ = jax.lax.scan(body, (zeros, jnp.zeros(4)), chunks)
+        grads = jax.tree.map(lambda g: g / accum_steps, g_sum)
+        loss, ce, aux, acc = (sums / accum_steps)
+        return grads, loss, ce, aux, acc
+
     def train_step(state: TrainState, tokens: jnp.ndarray):
-        (loss, (ce, aux, acc)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params, tokens)
+        grads, loss, ce, aux, acc = grads_and_metrics(state.params, tokens)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(step=state.step + 1, params=new_params,
@@ -77,11 +110,12 @@ def make_lm_train_step(model: nn.Module, tx: optax.GradientTransformation,
 def jit_lm_train_step(model: nn.Module, tx: optax.GradientTransformation,
                       mesh: Mesh, aux_coef: float = 0.01, *,
                       sequence_parallel: bool = False,
-                      axis: str = DATA_AXIS):
+                      axis: str = DATA_AXIS, accum_steps: int = 1):
     """jit the LM step over the mesh. Tokens [B, T] are sharded on the
     batch dim over ``axis`` by default; with ``sequence_parallel=True``
     they are sharded on the SEQUENCE dim instead (``axis`` must then match
-    the ``seq_axis`` of the model's ring/Ulysses ``attn_fn``)."""
-    step = make_lm_train_step(model, tx, aux_coef)
+    the ``seq_axis`` of the model's ring/Ulysses ``attn_fn``).
+    ``accum_steps`` forwards to `make_lm_train_step`."""
+    step = make_lm_train_step(model, tx, aux_coef, accum_steps=accum_steps)
     spec = P(None, axis) if sequence_parallel else P(axis)
     return jax.jit(step, in_shardings=(None, NamedSharding(mesh, spec)))

@@ -73,6 +73,33 @@ def test_lm_fsdp_matches_replicated(eight_devices):
                                atol=5e-3)
 
 
+def test_lm_grad_accumulation_matches_full_batch():
+    """accum_steps=4 must reproduce the full-batch step exactly (equal
+    chunk means): same losses and same trained params."""
+    model = TransformerLM(vocab=64, dim=32, depth=1, num_heads=4)
+    tx = optax.adam(1e-2)
+    toks = _tokens(17, b=8, t=32)
+    runs = {}
+    for accum in (1, 4):
+        state = create_lm_train_state(model, jax.random.PRNGKey(0), 32, tx)
+        step = jax.jit(make_lm_train_step(model, tx, accum_steps=accum))
+        losses = []
+        for _ in range(3):
+            state, m = step(state, toks)
+            losses.append(float(m["loss"]))
+        runs[accum] = (losses, state.params)
+    np.testing.assert_allclose(runs[1][0], runs[4][0], rtol=1e-5, atol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4),
+        runs[1][1], runs[4][1])
+
+    import pytest
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(make_lm_train_step(model, tx, accum_steps=3))(
+            create_lm_train_state(model, jax.random.PRNGKey(0), 32, tx),
+            toks)
+
+
 def test_lm_remat_matches_dense():
     """remat=True (jax.checkpoint around every block) must not change
     numerics — same losses and same trained params, less activation
