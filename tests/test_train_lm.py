@@ -237,3 +237,31 @@ def test_lm_sequence_parallel_training(eight_devices):
         state, m = step(state, toks)
         losses.append(float(m["loss"]))
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_lm_mixed_precision_training():
+    """bf16 compute / f32 params (the MXU recipe): training runs, loss
+    decreases, master params stay f32."""
+    model = TransformerLM(vocab=64, dim=32, depth=1, num_heads=4,
+                          dtype=jnp.bfloat16, param_dtype=jnp.float32)
+    tx = optax.adam(1e-2)
+    state = create_lm_train_state(model, jax.random.PRNGKey(0), 32, tx)
+    assert all(np.asarray(p).dtype == np.float32
+               for p in jax.tree.leaves(state.params))
+
+    # bf16 compute actually happens: the block's output activation is bf16
+    # (would stay green even if the final logits cast hid a broken plumbing)
+    toks0 = _tokens(19, b=4, t=32)
+    _, inter = model.apply({"params": state.params}, toks0,
+                           capture_intermediates=True)
+    block_out = inter["intermediates"]["block0"]["__call__"][0]
+    assert block_out.dtype == jnp.bfloat16, block_out.dtype
+    step = jax.jit(make_lm_train_step(model, tx))
+    toks = _tokens(19, b=4, t=32)
+    losses = []
+    for _ in range(10):
+        state, m = step(state, toks)
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0] * 0.9
+    assert all(np.asarray(p).dtype == np.float32
+               for p in jax.tree.leaves(state.params))
