@@ -31,3 +31,22 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+class TimedFakeEngine:
+    """Shared deterministic fake engine with a real (wall-clock) per-task
+    compute duration — the Node-contract fake for wall-clock scheduling/
+    recovery tests (`infer` signature and result attributes match
+    `idunno_tpu.engine.inference.InferenceEngine`)."""
+
+    def __init__(self, work_s: float):
+        self.work_s = work_s
+
+    def infer(self, name, start, end, dataset_root=None):
+        import time
+        from types import SimpleNamespace
+        time.sleep(self.work_s)
+        return SimpleNamespace(
+            records=[(f"test_{i}.JPEG", f"class_{i % 1000}", 0.9)
+                     for i in range(start, end + 1)],
+            elapsed_s=self.work_s, weights="random")

@@ -24,16 +24,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORK_S = 1.5                      # per-task compute time (controlled)
 
 
-class SlowEngine:
-    """Deterministic fake with a real compute duration, so tasks are
-    genuinely in flight when the worker dies."""
-
-    def infer(self, name, start, end, dataset_root=None):
-        time.sleep(WORK_S)
-        return SimpleNamespace(
-            records=[(f"test_{i}.JPEG", f"class_{i % 1000}", 0.9)
-                     for i in range(start, end + 1)],
-            elapsed_s=WORK_S, weights="random")
+from tests.conftest import TimedFakeEngine
 
 
 def test_measured_recovery_after_worker_kill(tmp_path):
@@ -45,7 +36,7 @@ def test_measured_recovery_after_worker_kill(tmp_path):
                         metadata_interval_s=0.2)
     net = InProcNetwork()
     nodes = {h: Node(h, cfg, net.transport(h), str(tmp_path / h),
-                     engine=SlowEngine()) for h in cfg.hosts}
+                     engine=TimedFakeEngine(WORK_S)) for h in cfg.hosts}
     detect_stamp = {}
 
     def on_change(host, old, new):

@@ -251,13 +251,12 @@ def test_lm_mixed_precision_training():
 
     # bf16 compute actually happens: the block's output activation is bf16
     # (would stay green even if the final logits cast hid a broken plumbing)
-    toks0 = _tokens(19, b=4, t=32)
-    _, inter = model.apply({"params": state.params}, toks0,
+    toks = _tokens(19, b=4, t=32)
+    _, inter = model.apply({"params": state.params}, toks,
                            capture_intermediates=True)
     block_out = inter["intermediates"]["block0"]["__call__"][0]
     assert block_out.dtype == jnp.bfloat16, block_out.dtype
     step = jax.jit(make_lm_train_step(model, tx))
-    toks = _tokens(19, b=4, t=32)
     losses = []
     for _ in range(10):
         state, m = step(state, toks)
