@@ -46,12 +46,20 @@ def init_cache(model: TransformerLM, batch: int, max_len: int) -> Any:
          static_argnames=("model", "prompt_len", "max_new", "temperature"))
 def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
              prompt_len: int, max_new: int, *, temperature: float = 0.0,
-             rng: jax.Array | None = None) -> jnp.ndarray:
+             rng: jax.Array | None = None,
+             prompt_lens: jnp.ndarray | None = None) -> jnp.ndarray:
     """Generate ``max_new`` tokens after ``prompt[:, :prompt_len]``.
 
-    prompt: int32 [B, prompt_len] (static length — pad upstream and pass the
-    true length if needed). Returns int32 [B, prompt_len + max_new].
-    temperature 0 → greedy argmax; > 0 → softmax sampling (needs ``rng``).
+    prompt: int32 [B, prompt_len] (static width). Returns int32
+    [B, prompt_len + max_new]. temperature 0 → greedy argmax; > 0 →
+    softmax sampling (needs ``rng``).
+
+    Ragged batches: pass ``prompt_lens`` (int [B], 1 ≤ len ≤ prompt_len)
+    with right-padded prompts — each row is teacher-forced only through its
+    own true length and generates from there, so its output occupies
+    positions [prompt_lens[r], prompt_len + max_new); every row still gets
+    ≥ max_new generated tokens. One compile serves all length mixes (the
+    lengths are a traced array, not a static argument).
     """
     if prompt.shape[1] != prompt_len:
         raise ValueError(f"prompt is [B, {prompt.shape[1]}] but "
@@ -65,6 +73,8 @@ def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
          jnp.zeros((b, max_new), jnp.int32)], axis=1)       # [B, total]
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    plens = (jnp.full((b,), prompt_len, jnp.int32) if prompt_lens is None
+             else prompt_lens.astype(jnp.int32))
 
     def step(t, carry):
         tokens, cache, rng = carry
@@ -77,9 +87,9 @@ def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
             nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
-        # teacher-force while inside the prompt; append past it
+        # per row: teacher-force while inside its prompt; append past it
         write_at = jnp.minimum(t + 1, total - 1)
-        keep_prompt = t + 1 < prompt_len
+        keep_prompt = (t + 1) < plens                        # [B]
         cur = jax.lax.dynamic_slice(tokens, (0, write_at), (b, 1))[:, 0]
         nxt = jnp.where(keep_prompt, cur, nxt.astype(jnp.int32))
         tokens = jax.lax.dynamic_update_slice(

@@ -75,6 +75,26 @@ def test_moe_lm_generates():
     assert (np.asarray(out) >= 0).all() and (np.asarray(out) < 64).all()
 
 
+def test_ragged_prompt_lengths():
+    """A ragged batch (per-row prompt_lens over a right-padded buffer) must
+    reproduce, row for row, what each prompt generates on its own."""
+    model, params = _model_and_params(key=11)
+    # row 0: true prompt [7, 3]; row 1: true prompt [5, 1, 9, 2]
+    p0 = jnp.asarray([[7, 3]], jnp.int32)
+    p1 = jnp.asarray([[5, 1, 9, 2]], jnp.int32)
+    padded = jnp.asarray([[7, 3, 0, 0], [5, 1, 9, 2]], jnp.int32)
+    out = generate(model, params, padded, prompt_len=4, max_new=3,
+                   prompt_lens=jnp.asarray([2, 4]))
+    assert out.shape == (2, 7)
+
+    # row 0 generated positions 2..6 == solo run with max_new=5
+    solo0 = generate(model, params, p0, prompt_len=2, max_new=5)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(solo0[0]))
+    # row 1 is a full-width prompt == solo run with max_new=3
+    solo1 = generate(model, params, p1, prompt_len=4, max_new=3)
+    np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(solo1[0]))
+
+
 def test_moe_decode_parity_with_default_capacity():
     """Single-token decode steps must match the full forward even at the
     DEFAULT capacity factor (capacity floors at k, so a token's k streams
