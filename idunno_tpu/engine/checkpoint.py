@@ -50,3 +50,28 @@ def restore_version(store: FileStoreService, model: str, template: Any,
     """Load one historical checkpoint version (rollback target)."""
     blob, _ = store.get_bytes(checkpoint_name(model), version=version)
     return flax.serialization.from_bytes(template, blob)
+
+
+# -- full training-state checkpoint/resume ---------------------------------
+#
+# Resuming TRAINING needs more than weights: optimizer moments and the step
+# counter too, or adam restarts cold and the loss curve jumps. The whole
+# TrainState pytree serializes through the same store path, so trainers
+# resume bit-exactly on any node holding a replica.
+
+def train_state_name(job: str) -> str:
+    return f"ckpt/train/{job}"
+
+
+def save_train_state(store: FileStoreService, job: str, state: Any) -> int:
+    """Serialize a full TrainState (step, params, batch_stats, opt_state)
+    into the store; returns the new version."""
+    return save_variables(store, f"train/{job}", state)
+
+
+def restore_train_state(store: FileStoreService, job: str,
+                        template: Any) -> tuple[Any, int]:
+    """Load the latest training state into ``template``'s structure (a
+    freshly-created TrainState with the same model/optimizer); returns
+    (state, version)."""
+    return restore_variables(store, f"train/{job}", template)
