@@ -32,4 +32,36 @@ Package layout (SURVEY.md §7):
     grep        — distributed log grep
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
+
+# Lazy top-level API (PEP 562): importing `idunno_tpu` stays light
+# (control-plane nodes shouldn't pay for flax/model imports); the common
+# surfaces resolve on first use.
+_LAZY_API = {
+    "InferenceEngine": ("idunno_tpu.engine.inference", "InferenceEngine"),
+    "QueryResult": ("idunno_tpu.engine.inference", "QueryResult"),
+    "TransformerLM": ("idunno_tpu.models.transformer", "TransformerLM"),
+    "MoETransformerLM": ("idunno_tpu.models.moe", "MoETransformerLM"),
+    "make_attn_fn": ("idunno_tpu.models.transformer", "make_attn_fn"),
+    "generate": ("idunno_tpu.engine.generate", "generate"),
+    "make_mesh": ("idunno_tpu.parallel.mesh", "make_mesh"),
+    "local_mesh": ("idunno_tpu.parallel.mesh", "local_mesh"),
+    "global_mesh": ("idunno_tpu.parallel.mesh", "global_mesh"),
+    "initialize_distributed": ("idunno_tpu.parallel.mesh",
+                               "initialize_distributed"),
+    "Node": ("idunno_tpu.serve.node", "Node"),
+    "ClusterConfig": ("idunno_tpu.config", "ClusterConfig"),
+    "EngineConfig": ("idunno_tpu.config", "EngineConfig"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY_API:
+        import importlib
+        mod, attr = _LAZY_API[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module 'idunno_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_API))
