@@ -101,6 +101,71 @@ def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
     return tokens
 
 
+# -- LM persistence: a servable (config + params) unit in the store --------
+#
+# The image engine reconstructs its models from the registry by name; LMs
+# carry their hyperparameters with the checkpoint instead, so any node can
+# reconstruct the module and serve `generate` without out-of-band config
+# (dense architectures only — attn_fn/ffn_factory are code, not data).
+# Config and weights live in ONE versioned store object (length-prefixed
+# JSON header + flax bytes), so a save is atomic and any historical version
+# pairs its architecture with its own weights.
+
+_LM_CONFIG_FIELDS = ("vocab", "dim", "depth", "num_heads", "causal",
+                     "ffn_every", "remat")
+
+
+def lm_store_name(name: str) -> str:
+    return f"lm/{name}"
+
+
+def save_lm(store, name: str, model: TransformerLM, params: Any) -> int:
+    """Version a dense TransformerLM (architecture + weights, one atomic
+    object) into the replicated store under ``lm/<name>``; returns the
+    store version."""
+    import json
+    import struct
+
+    import flax.serialization
+
+    if model.ffn_factory is not None:
+        raise ValueError("save_lm stores dense LMs only (ffn_factory is "
+                         "code, not serializable config)")
+    config = {f: getattr(model, f) for f in _LM_CONFIG_FIELDS}
+    config["dtype"] = jnp.dtype(model.dtype).name
+    config["param_dtype"] = jnp.dtype(model.param_dtype).name
+    header = json.dumps(config).encode()
+    host_params = jax.tree.map(jax.device_get, params)
+    blob = (struct.pack(">I", len(header)) + header
+            + flax.serialization.to_bytes(host_params))
+    return store.put_bytes(lm_store_name(name), blob)
+
+
+def load_lm(store, name: str,
+            version: int | None = None) -> tuple[TransformerLM, Any]:
+    """Reconstruct a stored LM on any node (latest or one historical
+    version): returns (model, params) — the version's own architecture is
+    paired with its own weights."""
+    import json
+    import struct
+
+    import flax.serialization
+
+    blob, _ = store.get_bytes(lm_store_name(name), version=version)
+    hlen = struct.unpack(">I", blob[:4])[0]
+    config = json.loads(blob[4:4 + hlen])
+    config["dtype"] = jnp.dtype(config["dtype"])
+    config["param_dtype"] = jnp.dtype(config["param_dtype"])
+    model = TransformerLM(**config)
+    # structure-only template (no init compute, mirrors init_cache)
+    template = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0),
+        jnp.zeros((1, 8), jnp.int32))["params"]
+    template = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), template)
+    params = flax.serialization.from_bytes(template, blob[4 + hlen:])
+    return model, params
+
+
 def stepwise_logits(model: TransformerLM, params: Any,
                     tokens: jnp.ndarray) -> jnp.ndarray:
     """Teacher-forced single-token decode over a full [B, T] sequence,

@@ -33,6 +33,7 @@ SERVICE = "control"
 class ControlService:
     def __init__(self, node: "Node") -> None:
         self.node = node
+        self._lms: dict = {}          # name -> (model, params), loaded once
         node.transport.serve(SERVICE, self._handle)
 
     def _handle(self, service: str, msg: Message) -> Message:
@@ -111,4 +112,34 @@ class ControlService:
             return {"stats": out}
         if verb == "grep":
             return {"matches": node.grep.query(p["pattern"])}
+        if verb == "generate":
+            # serve a store-persisted LM: load once per node (pass
+            # reload=true after re-saving a model to refresh the cache),
+            # KV-cached decode on every call (engine/generate.py)
+            import jax
+            import jax.numpy as jnp
+
+            from idunno_tpu.engine.generate import generate, load_lm
+
+            name = p["name"]
+            if name not in self._lms or p.get("reload"):
+                self._lms[name] = load_lm(node.store, name)
+            model, params = self._lms[name]
+            prompt = jnp.asarray(p["prompt"], jnp.int32)
+            temperature = float(p.get("temperature", 0.0))
+            kw = {}
+            if p.get("prompt_lens") is not None:
+                kw["prompt_lens"] = jnp.asarray(p["prompt_lens"])
+            if p.get("seed") is not None:
+                kw["rng"] = jax.random.PRNGKey(int(p["seed"]))
+            elif temperature > 0.0:
+                # RPC callers expect varied samples; never fall through to
+                # the library's deterministic default key
+                import secrets
+                kw["rng"] = jax.random.PRNGKey(secrets.randbits(63))
+            out = generate(model, params, prompt,
+                           prompt_len=prompt.shape[1],
+                           max_new=int(p["max_new"]),
+                           temperature=temperature, **kw)
+            return {"tokens": [[int(t) for t in row] for row in out]}
         raise ValueError(f"unknown control verb {verb!r}")

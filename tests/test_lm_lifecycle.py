@@ -44,6 +44,82 @@ def stores(tmp_path):
     return stores
 
 
+def test_lm_served_through_cluster_control(stores, tmp_path):
+    """The full LM serving story: train → save_lm into the store → a
+    DIFFERENT node serves `generate` over the control RPC, matching a
+    local decode from the same weights."""
+    from idunno_tpu.comm.message import Message
+    from idunno_tpu.engine.generate import load_lm, save_lm
+    from idunno_tpu.serve.control import ControlService
+    from idunno_tpu.utils.types import MessageType
+
+    model = TransformerLM(vocab=32, dim=32, depth=2, num_heads=4)
+    tx = optax.adam(1e-2)
+    state = create_lm_train_state(model, jax.random.PRNGKey(0), 16, tx)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 32)
+    step = jax.jit(make_lm_train_step(model, tx))
+    for _ in range(5):
+        state, _ = step(state, toks)
+    save_lm(stores["n0"], "tiny", model, state.params)
+
+    # reconstruct on another node: architecture + weights round-trip
+    model2, params2 = load_lm(stores["n2"], "tiny")
+    assert model2 == model
+    prompt = toks[:2, :4]
+    want = generate(model, state.params, prompt, prompt_len=4, max_new=5)
+
+    # serve over the control RPC from a node wired to n2's store
+    node = type("NodeStub", (), {})()
+    node.host, node.store = "n2", stores["n2"]
+    node.transport = stores["n2"].transport
+    ctl = ControlService(node)
+    out = ctl._handle("control", Message(
+        MessageType.INFERENCE, "client",
+        {"verb": "generate", "name": "tiny",
+         "prompt": [[int(t) for t in row] for row in prompt],
+         "max_new": 5}))
+    assert out.type is MessageType.ACK, out.payload
+    np.testing.assert_array_equal(np.asarray(out.payload["tokens"]),
+                                  np.asarray(want))
+    assert "tiny" in ctl._lms                      # cached for later calls
+
+    # re-save with a DIFFERENT architecture: versions pair config+weights
+    # atomically, the cache serves old weights until reload=true
+    model_v2 = TransformerLM(vocab=32, dim=16, depth=1, num_heads=2,
+                             dtype=jnp.bfloat16)
+    params_v2 = model_v2.init(jax.random.PRNGKey(3),
+                              jnp.zeros((1, 8), jnp.int32))["params"]
+    save_lm(stores["n0"], "tiny", model_v2, params_v2)
+    out_stale = ctl._handle("control", Message(
+        MessageType.INFERENCE, "client",
+        {"verb": "generate", "name": "tiny",
+         "prompt": [[1, 2, 3, 4]], "max_new": 2}))
+    assert out_stale.type is MessageType.ACK       # cache: old model still
+    out_new = ctl._handle("control", Message(
+        MessageType.INFERENCE, "client",
+        {"verb": "generate", "name": "tiny", "reload": True,
+         "prompt": [[1, 2, 3, 4]], "max_new": 2}))
+    assert out_new.type is MessageType.ACK
+    reloaded_model, _ = ctl._lms["tiny"]
+    assert reloaded_model.dim == 16                # new architecture served
+    assert reloaded_model.dtype == jnp.bfloat16    # dtype round-trips
+
+    # historical version 1 still pairs the ORIGINAL architecture+weights
+    old_model, old_params = load_lm(stores["n1"], "tiny", version=1)
+    assert old_model.dim == 32
+    np.testing.assert_array_equal(
+        np.asarray(generate(old_model, old_params, prompt, prompt_len=4,
+                            max_new=5)),
+        np.asarray(want))
+
+    # dense-only guard
+    from idunno_tpu.models.moe import MoETransformerLM
+    moe = MoETransformerLM(vocab=32, dim=16, depth=1, num_heads=2,
+                           n_experts=2)
+    with pytest.raises(ValueError, match="dense"):
+        save_lm(stores["n0"], "moe", moe, state.params)
+
+
 def test_training_resume_is_exact(stores):
     """Full TrainState checkpoint/resume: train 5 steps, checkpoint, train
     5 more — a resume from the checkpoint on ANOTHER node must land on
