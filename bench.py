@@ -245,13 +245,19 @@ def run_bench(devices) -> None:
              sweep=sweep, n_images=n_images)
         return
 
-    # end-to-end including host→device streaming of raw uint8 images
+    # end-to-end on the WORKER path: InferenceEngine.infer — prefetch
+    # pipeline over MULTIPLE device-batch chunks so host decode (synthetic)
+    # genuinely overlaps dispatch, H2D per chunk (tunnel-limited here; on a
+    # real host the chips sit next to the CPUs). This is exactly what a
+    # cluster worker runs per task.
     bs = best["batch_size"]
+    n_e2e = 4 * bs
     e2e_engine = InferenceEngine(EngineConfig(batch_size=bs), mesh=mesh,
                                  pretrained=False)
     t0 = time.perf_counter()
-    e2e_engine.infer_batch("resnet", images[:bs])
+    e2e_res = e2e_engine.infer("resnet", 0, n_e2e - 1)
     e2e_s = time.perf_counter() - t0
+    assert len(e2e_res.records) == n_e2e
 
     # Pallas preprocess must not have silently fallen back on TPU
     # (round-1 VERDICT weak #2: engine auto-fallback hides broken kernels).
@@ -271,7 +277,7 @@ def run_bench(devices) -> None:
          n_images=n_images, iters=iters,
          h2d_transfer_s=round(transfer_s, 2),
          p50_query_latency_s_400imgs=round(400 / ips, 4),
-         e2e_streaming_images_per_s=round(bs / e2e_s, 1),
+         e2e_worker_path_images_per_s=round(n_e2e / e2e_s, 1),
          pallas_preprocess=pallas,
          baseline_images_per_s=round(REFERENCE_IMAGES_PER_S, 1),
          wall_s=round(time.perf_counter() - t_start, 1))
