@@ -62,3 +62,35 @@ def test_unreachable_raises(pair):
 def test_call_without_handler_returns_none(pair):
     ta, tb = pair
     assert ta.call("h1", "nosuch", Message(MessageType.GET, "h0")) is None
+
+
+def test_concurrent_oneshot_calls(pair):
+    """Thread-per-connection server survives a burst of parallel clients
+    (oneshot_call — the listener-free client used by ops tooling)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from idunno_tpu.comm.net import oneshot_call
+
+    ta, tb = pair
+    seen = []
+    lock = threading.Lock()
+
+    def handler(service, msg):
+        with lock:
+            seen.append(msg.payload["i"])
+        return Message(MessageType.ACK, "h0", {"echo": msg.payload["i"]})
+
+    ta.serve("burst", handler)
+    ip, tcp_port, _ = ta._addr_of("h0")
+
+    def call(i):
+        out = oneshot_call(ip, tcp_port, "burst",
+                           Message(MessageType.PING, "client", {"i": i}),
+                           timeout=10.0)
+        assert out is not None and out.payload["echo"] == i
+        return i
+
+    with ThreadPoolExecutor(max_workers=16) as pool:
+        results = sorted(pool.map(call, range(40)))
+    assert results == list(range(40))
+    assert sorted(seen) == list(range(40))
