@@ -12,7 +12,7 @@ from collections.abc import Callable
 import flax.linen as nn
 
 from idunno_tpu.models.alexnet import AlexNet
-from idunno_tpu.models.resnet import ResNet, resnet18, resnet34
+from idunno_tpu.models.resnet import ResNet, resnet18, resnet34, resnet50
 from idunno_tpu.models.vit import ViT, vit_s16, vit_tiny
 
 _REGISTRY: dict[str, Callable[..., nn.Module]] = {
@@ -20,6 +20,7 @@ _REGISTRY: dict[str, Callable[..., nn.Module]] = {
     "resnet": resnet18,      # the reference's "resnet" means ResNet-18
     "resnet18": resnet18,
     "resnet34": resnet34,
+    "resnet50": resnet50,
     "vit": vit_s16,
     "vit_tiny": vit_tiny,
 }

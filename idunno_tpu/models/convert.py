@@ -43,9 +43,13 @@ def _np(t: Any) -> np.ndarray:
                       dtype=np.float32)
 
 
-def convert_resnet18(state_dict: dict[str, Any]) -> dict:
-    """torchvision ``resnet18`` state_dict → our ResNet variables
-    ({'params': ..., 'batch_stats': ...})."""
+def convert_resnet(state_dict: dict[str, Any],
+                   stage_sizes: tuple = (2, 2, 2, 2),
+                   convs_per_block: int = 2) -> dict:
+    """torchvision ResNet state_dict → our ResNet variables
+    ({'params': ..., 'batch_stats': ...}). ``convs_per_block`` is 2 for
+    BasicBlock (18/34) and 3 for Bottleneck (50/101/152) — the per-block
+    conv/bn key pattern (convN/bnN, downsample.0/1) is identical."""
     sd = {k: _np(v) for k, v in state_dict.items()}
     params: dict[str, Any] = {}
     stats: dict[str, Any] = {}
@@ -56,41 +60,39 @@ def convert_resnet18(state_dict: dict[str, Any]) -> dict:
             node = node.setdefault(p, {})
         node[path[-1]] = leaf
 
-    def bn(flax_name, torch_prefix):
-        put(params, (flax_name, "scale"), sd[f"{torch_prefix}.weight"])
-        put(params, (flax_name, "bias"), sd[f"{torch_prefix}.bias"])
-        put(stats, (flax_name, "mean"), sd[f"{torch_prefix}.running_mean"])
-        put(stats, (flax_name, "var"), sd[f"{torch_prefix}.running_var"])
+    def bn(tree_path, torch_prefix):
+        put(params, (*tree_path, "scale"), sd[f"{torch_prefix}.weight"])
+        put(params, (*tree_path, "bias"), sd[f"{torch_prefix}.bias"])
+        put(stats, (*tree_path, "mean"), sd[f"{torch_prefix}.running_mean"])
+        put(stats, (*tree_path, "var"), sd[f"{torch_prefix}.running_var"])
 
     put(params, ("stem_conv", "kernel"), _t_conv(sd["conv1.weight"]))
-    bn("stem_norm", "bn1")
-    for stage in range(4):
-        for block in range(2):
+    bn(("stem_norm",), "bn1")
+    for stage, n_blocks in enumerate(stage_sizes):
+        for block in range(n_blocks):
             tp = f"layer{stage + 1}.{block}"
             fb = f"stage{stage}_block{block}"
-            put(params, (fb, "Conv_0", "kernel"), _t_conv(sd[f"{tp}.conv1.weight"]))
-            bn_tree_name = (fb, "BatchNorm_0")
-            put(params, (*bn_tree_name, "scale"), sd[f"{tp}.bn1.weight"])
-            put(params, (*bn_tree_name, "bias"), sd[f"{tp}.bn1.bias"])
-            put(stats, (*bn_tree_name, "mean"), sd[f"{tp}.bn1.running_mean"])
-            put(stats, (*bn_tree_name, "var"), sd[f"{tp}.bn1.running_var"])
-            put(params, (fb, "Conv_1", "kernel"), _t_conv(sd[f"{tp}.conv2.weight"]))
-            bn2 = (fb, "BatchNorm_1")
-            put(params, (*bn2, "scale"), sd[f"{tp}.bn2.weight"])
-            put(params, (*bn2, "bias"), sd[f"{tp}.bn2.bias"])
-            put(stats, (*bn2, "mean"), sd[f"{tp}.bn2.running_mean"])
-            put(stats, (*bn2, "var"), sd[f"{tp}.bn2.running_var"])
+            for c in range(convs_per_block):
+                put(params, (fb, f"Conv_{c}", "kernel"),
+                    _t_conv(sd[f"{tp}.conv{c + 1}.weight"]))
+                bn((fb, f"BatchNorm_{c}"), f"{tp}.bn{c + 1}")
             if f"{tp}.downsample.0.weight" in sd:
                 put(params, (fb, "downsample_conv", "kernel"),
                     _t_conv(sd[f"{tp}.downsample.0.weight"]))
-                ds = (fb, "downsample_norm")
-                put(params, (*ds, "scale"), sd[f"{tp}.downsample.1.weight"])
-                put(params, (*ds, "bias"), sd[f"{tp}.downsample.1.bias"])
-                put(stats, (*ds, "mean"), sd[f"{tp}.downsample.1.running_mean"])
-                put(stats, (*ds, "var"), sd[f"{tp}.downsample.1.running_var"])
+                bn((fb, "downsample_norm"), f"{tp}.downsample.1")
     put(params, ("fc", "kernel"), _t_dense(sd["fc.weight"]))
     put(params, ("fc", "bias"), sd["fc.bias"])
     return {"params": params, "batch_stats": stats}
+
+
+def convert_resnet18(state_dict: dict[str, Any]) -> dict:
+    """torchvision ``resnet18`` state_dict → our ResNet-18 variables."""
+    return convert_resnet(state_dict, (2, 2, 2, 2), convs_per_block=2)
+
+
+def convert_resnet50(state_dict: dict[str, Any]) -> dict:
+    """torchvision ``resnet50`` state_dict → our ResNet-50 variables."""
+    return convert_resnet(state_dict, (3, 4, 6, 3), convs_per_block=3)
 
 
 def convert_alexnet(state_dict: dict[str, Any]) -> dict:
@@ -141,6 +143,11 @@ def try_load_torchvision(model_name: str) -> dict | None:
         weights, convert = tvm.AlexNet_Weights.IMAGENET1K_V1, convert_alexnet
     elif model_name in ("resnet", "resnet18"):
         weights, convert = tvm.ResNet18_Weights.IMAGENET1K_V1, convert_resnet18
+    elif model_name == "resnet50":
+        # V1 on purpose: the serving preprocess is the reference's
+        # Resize(256)/CenterCrop(224) recipe, which matches V1 weights
+        # (V2 checkpoints expect a 232-resize and would lose accuracy)
+        weights, convert = tvm.ResNet50_Weights.IMAGENET1K_V1, convert_resnet50
     else:
         return None
     path = _cached_checkpoint(weights.url)

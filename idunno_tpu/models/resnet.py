@@ -1,14 +1,16 @@
-"""ResNet-18 as a Flax module, TPU-first.
+"""ResNet family as Flax modules, TPU-first.
 
 Replaces the reference's per-task ``torch.hub.load('pytorch/vision', 'resnet18')``
-(`alexnet_resnet.py:21-22`) with a module whose parameters are initialised (or
+(`alexnet_resnet.py:21-22`) with modules whose parameters are initialised (or
 converted from torchvision, see `models/convert.py`) exactly once and stay
 resident in HBM. Layout is NHWC (XLA's preferred TPU conv layout), compute in
 bfloat16 so convolutions tile onto the MXU, params in float32.
 
-Architecture matches torchvision ``resnet18``: stem conv7x7/2 + maxpool, four
-stages of two BasicBlocks with (64, 128, 256, 512) filters, stride-2
-projection downsample at stage entry, global average pool, 1000-way FC.
+Architectures match torchvision: stem conv7x7/2 + maxpool, four stages of
+BasicBlocks (resnet18: [2,2,2,2], resnet34: [3,4,6,3]) or Bottlenecks
+(resnet50: [3,4,6,3], 4× expansion, stride on the 3x3 — the v1.5 layout),
+stride-2 projection downsample at stage entry, global average pool,
+1000-way FC.
 """
 from __future__ import annotations
 
@@ -47,10 +49,46 @@ class BasicBlock(nn.Module):
         return nn.relu(residual + y)
 
 
+class Bottleneck(nn.Module):
+    """1x1 reduce → 3x3 (strided, torchvision v1.5 placement) → 1x1 expand
+    (4×), residual with projection on shape change (torchvision
+    Bottleneck)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        out_ch = self.filters * self.expansion
+        y = self.conv(self.filters, (1, 1), padding="VALID")(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3),
+                      strides=(self.strides, self.strides),
+                      padding=((1, 1), (1, 1)))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(out_ch, (1, 1), padding="VALID")(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(out_ch, (1, 1),
+                                 strides=(self.strides, self.strides),
+                                 padding="VALID",
+                                 name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_norm")(residual)
+        return nn.relu(residual + y)
+
+
 class ResNet(nn.Module):
-    """Generic BasicBlock ResNet (18 = [2,2,2,2], 34 = [3,4,6,3])."""
+    """Generic ResNet: BasicBlock (18 = [2,2,2,2], 34 = [3,4,6,3]) or
+    Bottleneck (50 = [3,4,6,3] with ``block_cls=Bottleneck``)."""
 
     stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    block_cls: ModuleDef = BasicBlock
     num_classes: int = 1000
     num_filters: int = 64
     dtype: jnp.dtype = jnp.bfloat16
@@ -73,9 +111,9 @@ class ResNet(nn.Module):
         for stage, n_blocks in enumerate(self.stage_sizes):
             for block in range(n_blocks):
                 strides = 2 if stage > 0 and block == 0 else 1
-                x = BasicBlock(self.num_filters * 2 ** stage, strides,
-                               conv=conv, norm=norm,
-                               name=f"stage{stage}_block{block}")(x)
+                x = self.block_cls(self.num_filters * 2 ** stage, strides,
+                                   conv=conv, norm=norm,
+                                   name=f"stage{stage}_block{block}")(x)
         x = jnp.mean(x, axis=(1, 2))            # global average pool
         x = nn.Dense(self.num_classes, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="fc")(x)
@@ -88,3 +126,7 @@ def resnet18(**kwargs) -> ResNet:
 
 def resnet34(**kwargs) -> ResNet:
     return ResNet(stage_sizes=(3, 4, 6, 3), **kwargs)
+
+
+def resnet50(**kwargs) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck, **kwargs)

@@ -146,3 +146,79 @@ def test_resnet18_bn_running_stats_used():
     ours = _flax_forward("resnet18", variables, x)
     theirs = _torch_forward(tmodel, np.transpose(x, (0, 3, 1, 2)).copy())
     np.testing.assert_allclose(ours, theirs, atol=1e-4, rtol=1e-4)
+
+
+class _Bottleneck(tnn.Module):
+    """torchvision Bottleneck with identical parameter names."""
+
+    def __init__(self, cin, planes, stride):
+        super().__init__()
+        cout = planes * 4
+        self.conv1 = tnn.Conv2d(cin, planes, 1, 1, 0, bias=False)
+        self.bn1 = tnn.BatchNorm2d(planes)
+        self.conv2 = tnn.Conv2d(planes, planes, 3, stride, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(planes)
+        self.conv3 = tnn.Conv2d(planes, cout, 1, 1, 0, bias=False)
+        self.bn3 = tnn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = F.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        return F.relu(out + idn)
+
+
+class _TorchResNet50(tnn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(64)
+        cin = 64
+        for i, (planes, n_blocks) in enumerate(
+                zip((64, 128, 256, 512), (3, 4, 6, 3))):
+            blocks = []
+            for b in range(n_blocks):
+                stride = 2 if i > 0 and b == 0 else 1
+                blocks.append(_Bottleneck(cin, planes, stride))
+                cin = planes * 4
+            setattr(self, f"layer{i + 1}", tnn.Sequential(*blocks))
+        self.fc = tnn.Linear(2048, 1000)
+
+    def forward(self, x):
+        x = F.relu(self.bn1(self.conv1(x)))
+        x = F.max_pool2d(x, 3, 2, 1)
+        for i in range(4):
+            x = getattr(self, f"layer{i + 1}")(x)
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
+def test_resnet50_conversion_matches_torch_forward():
+    from idunno_tpu.models.convert import convert_resnet50
+
+    torch.manual_seed(4)
+    tmodel = _TorchResNet50().eval()
+    # move running stats off init defaults so conversion must map them
+    with torch.no_grad():
+        tmodel(torch.randn(2, 3, 96, 96))
+        tmodel.train()
+        tmodel(torch.randn(2, 3, 96, 96))
+        tmodel.eval()
+
+    variables = convert_resnet50(tmodel.state_dict())
+    fmodel = create_model("resnet50", dtype=jnp.float32,
+                          param_dtype=jnp.float32)
+
+    x = np.random.default_rng(5).normal(
+        size=(2, 96, 96, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = tmodel(torch.from_numpy(
+            np.transpose(x, (0, 3, 1, 2)))).numpy()
+    got = np.asarray(fmodel.apply(variables, jnp.asarray(x), train=False))
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=2e-3)

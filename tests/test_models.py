@@ -127,3 +127,24 @@ def test_train_step_learns_and_varies_dropout():
     delta = jax.tree.leaves(jax.tree.map(
         lambda a, b: float(jnp.abs(a - b).sum()), state.params, state2.params))
     assert sum(delta) > 0
+
+
+def test_resnet50_shapes_and_param_count():
+    """Bottleneck ResNet-50: torchvision-matching architecture (25.557M
+    params) and logits shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from idunno_tpu.models import create_model
+
+    model = create_model("resnet50", dtype=jnp.float32,
+                         param_dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 64, 64, 3), jnp.float32),
+                           train=False)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(variables["params"]))
+    assert n_params == 25_557_032          # torchvision resnet50
+    logits = model.apply(variables, jnp.zeros((2, 64, 64, 3), jnp.float32),
+                         train=False)
+    assert logits.shape == (2, 1000)
