@@ -29,13 +29,23 @@ import threading
 import time
 
 REFERENCE_IMAGES_PER_S = 400 / 9.0   # ≈44.4, whole reference cluster
-METRIC = "resnet18_imagenet_inference_throughput"
+# BENCH_MODEL selects the measured network: resnet18 (headline, matches the
+# reference's "resnet") or resnet50 (bottleneck — ~4x the FLOPs/image, the
+# MXU-utilisation probe).
+BENCH_MODEL = os.environ.get("BENCH_MODEL", "resnet18")
+if BENCH_MODEL not in ("resnet18", "resnet50"):
+    # other registry models would get the wrong analytic FLOPs → wrong MFU
+    raise SystemExit(f"BENCH_MODEL={BENCH_MODEL!r}: want resnet18|resnet50")
+METRIC = f"{BENCH_MODEL}_imagenet_inference_throughput"
 
 # The TPU sits behind a tunnel that is intermittently down; a successful TPU
 # measurement is cached here so a later run on a dead tunnel can still report
 # the last real number in its diagnostics instead of only "unavailable".
-_LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "BENCH_LAST_GOOD.json")
+# (keyed by model so a resnet50 probe never overwrites the headline record)
+_LAST_GOOD = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "BENCH_LAST_GOOD.json" if BENCH_MODEL == "resnet18"
+    else f"BENCH_LAST_GOOD_{BENCH_MODEL}.json")
 
 # Peak dense bf16 FLOP/s per chip, keyed by substrings of device_kind.
 # (Public figures: v2 45T, v3 123T, v4 275T, v5e 197T, v5p 459T, v6e 918T.)
@@ -46,9 +56,11 @@ _PEAK_BF16 = [
 ]
 
 
-def resnet18_forward_flops(image_size: int = 224) -> float:
+def resnet_forward_flops(image_size: int = 224, *,
+                         bottleneck: bool = False) -> float:
     """Analytic forward FLOPs/image for torchvision-shape ResNet-18
-    (1 MAC = 2 FLOPs; convs + downsamples + fc; elementwise ignored)."""
+    (default) or ResNet-50 (``bottleneck=True``); 1 MAC = 2 FLOPs; convs +
+    downsamples + fc; elementwise ignored."""
     def conv(h, w, cin, cout, k, stride):
         oh, ow = h // stride, w // stride
         return 2.0 * oh * ow * cout * k * k * cin, oh, ow
@@ -58,17 +70,28 @@ def resnet18_forward_flops(image_size: int = 224) -> float:
     total += f
     h, w = h // 2, w // 2                      # maxpool /2
     cin = 64
-    for stage, cout in enumerate((64, 128, 256, 512)):
-        for block in range(2):
+    stage_sizes = (3, 4, 6, 3) if bottleneck else (2, 2, 2, 2)
+    for stage, planes in enumerate((64, 128, 256, 512)):
+        for block in range(stage_sizes[stage]):
             stride = 2 if stage > 0 and block == 0 else 1
-            f, h, w = conv(h, w, cin, cout, 3, stride)
-            total += f
-            f, _, _ = conv(h, w, cout, cout, 3, 1)
-            total += f
+            if bottleneck:
+                cout = planes * 4
+                f, _, _ = conv(h, w, cin, planes, 1, 1)        # 1x1 reduce
+                total += f
+                f, h, w = conv(h, w, planes, planes, 3, stride)
+                total += f
+                f, _, _ = conv(h, w, planes, cout, 1, 1)       # 1x1 expand
+                total += f
+            else:
+                cout = planes
+                f, h, w = conv(h, w, cin, cout, 3, stride)
+                total += f
+                f, _, _ = conv(h, w, cout, cout, 3, 1)
+                total += f
             if stride != 1 or cin != cout:     # projection downsample
                 total += 2.0 * h * w * cout * cin
             cin = cout
-    total += 2.0 * 512 * 1000                  # fc
+    total += 2.0 * cin * 1000                  # fc
     return total
 
 
@@ -196,7 +219,8 @@ def run_bench(devices) -> None:
         arr = flat[:k * bs].reshape(k, bs, 256, 256, 3)
         return jax.device_put(arr, NamedSharding(mesh, P(None, DATA_AXIS))), k
 
-    flops_img = resnet18_forward_flops(224)
+    flops_img = resnet_forward_flops(
+        224, bottleneck=(BENCH_MODEL == "resnet50"))
     peak = None
     if platform == "tpu":
         kind = device_kind.lower().replace(" ", "")
@@ -222,12 +246,12 @@ def run_bench(devices) -> None:
                                  pretrained=False)
         staged, k = staged_for(bs)
         t0 = time.perf_counter()
-        idx, prob = engine.infer_staged("resnet", staged, k * bs)  # compile
+        idx, prob = engine.infer_staged(BENCH_MODEL, staged, k * bs)  # compile
         compile_s = time.perf_counter() - t0
         times = []
         for _ in range(iters):
             t0 = time.perf_counter()
-            idx, prob = engine.infer_staged("resnet", staged, k * bs)
+            idx, prob = engine.infer_staged(BENCH_MODEL, staged, k * bs)
             times.append(time.perf_counter() - t0)   # infer_staged returns
         per_run = float(np.median(times))            # np arrays: D2H synced
         ips = (k * bs) / per_run
@@ -255,7 +279,7 @@ def run_bench(devices) -> None:
     e2e_engine = InferenceEngine(EngineConfig(batch_size=bs), mesh=mesh,
                                  pretrained=False)
     t0 = time.perf_counter()
-    e2e_res = e2e_engine.infer("resnet", 0, n_e2e - 1)
+    e2e_res = e2e_engine.infer(BENCH_MODEL, 0, n_e2e - 1)
     e2e_s = time.perf_counter() - t0
     assert len(e2e_res.records) == n_e2e
 
