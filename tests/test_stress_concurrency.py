@@ -1,0 +1,71 @@
+"""Concurrency stress on the threaded runtime: parallel client threads,
+many queries, a worker killed and revived mid-flow. Asserts no lost or
+duplicated results under thread churn — the race-discipline check the
+reference never had (its locks were partly unused, SURVEY.md §5).
+"""
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from idunno_tpu.comm.inproc import InProcNetwork
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.serve.node import Node
+
+from tests.conftest import TimedFakeEngine
+
+
+def test_parallel_clients_with_worker_churn(tmp_path):
+    cfg = ClusterConfig(hosts=("n0", "n1", "n2", "n3"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0",
+                        replication_factor=2, query_batch_size=100,
+                        query_interval_s=0.0, ping_interval_s=0.05,
+                        failure_timeout_s=0.6, straggler_timeout_s=4.0,
+                        metadata_interval_s=0.1, rate_factor=10)
+    net = InProcNetwork()
+    nodes = {h: Node(h, cfg, net.transport(h), str(tmp_path / h),
+                     engine=TimedFakeEngine(0.02)) for h in cfg.hosts}
+    try:
+        for n in nodes.values():
+            n.start()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not all(
+                len(n.membership.members.alive_hosts()) == 4
+                for n in nodes.values()):
+            time.sleep(0.02)
+
+        ranges = [(i * 100, i * 100 + 99) for i in range(12)]
+
+        def submit(i):
+            # clients spread across nodes, all funneling to the master
+            node = nodes[cfg.hosts[i % 4]]
+            s, e = ranges[i]
+            return ("resnet" if i % 2 else "alexnet",
+                    node.inference.inference(
+                        "resnet" if i % 2 else "alexnet", s, e,
+                        pace_s=0.0)[0], s, e)
+
+        with ThreadPoolExecutor(max_workers=6) as pool:
+            futs = [pool.submit(submit, i) for i in range(12)]
+            time.sleep(0.15)
+            net.kill("n3")                       # crash mid-flow
+            time.sleep(1.2)                      # detected, work reassigned
+            net.revive("n3")                     # comes back (stale member)
+            submitted = [f.result() for f in futs]
+
+        master = nodes["n0"].inference
+        deadline = time.time() + 30.0
+        while time.time() < deadline and not all(
+                master.query_done(m, q) for m, q, _, _ in submitted):
+            time.sleep(0.05)
+
+        for model, qnum, s, e in submitted:
+            assert master.query_done(model, qnum), (model, qnum)
+            recs = master.results(model, qnum)
+            names = [r[0] for r in recs]
+            # exactly once: no losses, no duplicates
+            assert set(names) == {f"test_{i}.JPEG"
+                                  for i in range(s, e + 1)}, (model, qnum)
+            assert len(names) == len(set(names)), \
+                f"duplicate results in {model} q{qnum}"
+    finally:
+        for n in nodes.values():
+            n.stop()
