@@ -46,6 +46,7 @@ class MembershipService:
         self.clock = clock
         self.members = MembershipList()
         self._callbacks: list[ChangeCallback] = []
+        self._left = False           # voluntary leave: never auto-refute
         transport.serve(SERVICE, self._handle)
 
     # -- wiring -----------------------------------------------------------
@@ -79,6 +80,7 @@ class MembershipService:
         """Introduce self. The introducer (or any alive seed) replies with
         the merged full list."""
         now = self.clock()
+        self._left = False
         self.members.set(self.host, MemberStatus.RUNNING, now)
         self.members.touch(self.host, now)
         if self.host == self.config.introducer:
@@ -102,6 +104,7 @@ class MembershipService:
         """Voluntary leave: broadcast a LEAVE-stamped list (distinct from a
         crash, which is only ever *detected*)."""
         now = self.clock()
+        self._left = True
         self.members.set(self.host, MemberStatus.LEAVE, now)
         msg = Message(MessageType.LEAVE, self.host,
                       {"members": self.members.to_wire()})
@@ -131,6 +134,29 @@ class MembershipService:
         """
         now = self.clock()
         timeout = self.config.failure_timeout_s
+        # SWIM-style refutation: if someone marked US dead (false suspicion
+        # across a healed partition or a long GC pause) while we are in fact
+        # alive, overwrite with a RUNNING stamp strictly newer than the
+        # verdict's — max(now, verdict_ts + ε) wins the merge on every peer
+        # even if our clock lags the issuer's (the ts domain doubles as the
+        # incarnation number). Never after a voluntary leave.
+        #
+        # Convergence note: a healed node that was an isolated *coordinator*
+        # may still carry LEAVE verdicts it issued for unreachable peers;
+        # those propagate for one ping wave and each live peer refutes its
+        # own entry on its next monitor tick, so views converge within
+        # ~2 ping intervals (transient reassignment callbacks may fire —
+        # exactly-once results hold regardless, see
+        # tests/test_stress_concurrency.py). Genuinely dead peers stay dead.
+        me = self.members.get(self.host)
+        if me is not None and not me.status.alive and not self._left:
+            refute_ts = max(now, me.ts + 1e-3)
+            self.members.set(self.host, MemberStatus.RUNNING, refute_ts)
+            # our own silence clocks are stale after an isolation — restart
+            # them so we don't instantly re-suspect peers we couldn't hear
+            for e in self.members.entries():
+                self.members.touch(e.host, now)
+            self._fire([(self.host, me.status, MemberStatus.RUNNING)])
         if self.is_acting_master:
             for e in self.members.entries():
                 if e.host == self.host or not e.status.alive:

@@ -137,3 +137,109 @@ def test_non_master_does_not_ping(cluster):
     t.datagram = lambda *a, **k: sent.append(a) or orig(*a, **k)
     services["n2"].ping_once()
     assert sent == []
+
+
+def test_false_suspicion_refuted_after_partition_heals(cluster):
+    """SWIM-style rejoin: a node marked LEAVE by the failure detector while
+    merely partitioned refutes the suspicion once healed — it returns to
+    RUNNING in every view. A voluntary leave is never refuted."""
+    cfg, net, clock, services = cluster
+    pump(services, clock)
+
+    for other in cfg.hosts:
+        if other != "n3":
+            net.partition("n3", other)
+    clock.advance(cfg.failure_timeout_s + 0.5)
+    services["n0"].monitor_once()
+    pump(services, clock)
+    assert not services["n0"].members.is_alive("n3")
+
+    for other in cfg.hosts:
+        if other != "n3":
+            net.heal("n3", other)
+    # n3 hears the LEAVE verdict about itself on the next ping wave...
+    pump(services, clock, waves=1)
+    # ...and refutes it on its own monitor step
+    services["n3"].monitor_once()
+    assert services["n3"].members.is_alive("n3")
+    pump(services, clock, waves=2)
+    for h in cfg.hosts:
+        assert services[h].members.is_alive("n3"), h
+
+    # voluntary leave stays left
+    services["n2"].leave()
+    pump(services, clock, waves=1)
+    services["n2"].monitor_once()
+    assert not services["n2"].members.is_alive("n2")
+    pump(services, clock, waves=2)
+    assert not services["n0"].members.is_alive("n2")
+
+
+def test_refutation_wins_under_clock_skew():
+    """The refutation stamp is max(now, verdict_ts + eps), so a node whose
+    clock LAGS the master's still wins the merge on every peer."""
+    cfg = ClusterConfig(hosts=("n0", "n1", "n2"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0")
+    net = InProcNetwork()
+    clocks = {"n0": FakeClock(1010.0),        # master runs 10 s ahead
+              "n1": FakeClock(1000.0), "n2": FakeClock(1000.0)}
+    services = {h: MembershipService(h, cfg, net.transport(h),
+                                     clock=clocks[h]) for h in cfg.hosts}
+    for h in cfg.hosts:
+        services[h].join()
+        for c in clocks.values():
+            c.advance(0.01)
+
+    def wave():
+        for s in services.values():
+            s.ping_once()
+        for c in clocks.values():
+            c.advance(0.3)
+
+    wave()
+    for other in ("n0", "n1"):
+        net.partition("n2", other)
+    for c in clocks.values():
+        c.advance(cfg.failure_timeout_s + 0.5)
+    services["n0"].monitor_once()             # LEAVE stamped at ~1012+
+    wave()
+    assert not services["n0"].members.is_alive("n2")
+
+    for other in ("n0", "n1"):
+        net.heal("n2", other)
+    wave()                                     # n2 hears the verdict
+    assert not services["n2"].members.is_alive("n2")
+    services["n2"].monitor_once()              # refutes at verdict_ts + eps
+    assert services["n2"].members.is_alive("n2")
+    wave()
+    wave()
+    for h in cfg.hosts:
+        assert services[h].members.is_alive("n2"), \
+            f"{h} still believes the stale verdict (clock skew)"
+
+
+def test_isolated_coordinator_converges_after_heal(cluster):
+    """An isolated coordinator marks everyone LEAVE; the standby marks the
+    coordinator LEAVE. After the heal, refutations converge every view back
+    to all-RUNNING within a few ping/monitor rounds."""
+    cfg, net, clock, services = cluster
+    pump(services, clock)
+    for other in cfg.hosts:
+        if other != "n0":
+            net.partition("n0", other)
+    clock.advance(cfg.failure_timeout_s + 0.5)
+    services["n0"].monitor_once()              # n0: everyone else LEAVE
+    services["n1"].monitor_once()              # standby: coordinator LEAVE
+    assert services["n0"].members.alive_hosts() == ["n0"]
+    assert not services["n1"].members.is_alive("n0")
+
+    for other in cfg.hosts:
+        if other != "n0":
+            net.heal("n0", other)
+    for _ in range(4):
+        pump(services, clock, waves=1)
+        for s in services.values():
+            s.monitor_once()
+    for h in cfg.hosts:
+        assert sorted(services[h].members.alive_hosts()) == \
+            sorted(cfg.hosts), f"{h} view did not converge"
