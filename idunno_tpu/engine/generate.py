@@ -101,6 +101,85 @@ def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
     return tokens
 
 
+@partial(jax.jit,
+         static_argnames=("model", "prompt_len", "max_new", "beam_width"))
+def beam_search(model: TransformerLM, params: Any, prompt: jnp.ndarray,
+                prompt_len: int, max_new: int, *,
+                beam_width: int = 4) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Beam-search decoding with the same KV cache as `generate`.
+
+    prompt int32 [B, prompt_len] → (sequences int32 [B, prompt_len +
+    max_new], total log-prob [B]) for the best beam. One jitted program:
+    the prompt prefills the cache at batch B (paid once, not per beam),
+    the cache is then replicated to B·W rows, and each generated position
+    keeps the top W of the W·V continuations, re-gathering the KV caches
+    to follow their parent beams. (No EOS handling: all beams have length
+    max_new, so scores are directly comparable log-probs.)
+    """
+    if prompt.shape[1] != prompt_len:
+        raise ValueError(f"prompt is [B, {prompt.shape[1]}] but "
+                         f"prompt_len={prompt_len}; slice/pad upstream")
+    b = prompt.shape[0]
+    w = beam_width
+    total = prompt_len + max_new
+    neg_inf = jnp.asarray(-1e30, jnp.float32)
+    dec = decode_model(model, total)
+
+    # -- prefill at batch B: feed prompt tokens 0..prompt_len-2 ----------
+    cache_b = init_cache(model, b, total)
+
+    def prefill(t, cache):
+        tok = jax.lax.dynamic_slice(prompt.astype(jnp.int32), (0, t),
+                                    (b, 1))
+        _, mutated = dec.apply({"params": params, "cache": cache}, tok,
+                               mutable=["cache"])
+        return mutated["cache"]
+
+    cache_b = jax.lax.fori_loop(0, prompt_len - 1, prefill, cache_b)
+
+    # -- replicate to B*W beams (row-major [b0w0..b0wW-1, b1w0, ...]) ----
+    cache = jax.tree.map(
+        lambda a: (jnp.repeat(a, w, axis=0)
+                   if a.ndim > 0 and a.shape[0] == b else a), cache_b)
+    tokens = jnp.repeat(jnp.concatenate(
+        [prompt.astype(jnp.int32), jnp.zeros((b, max_new), jnp.int32)],
+        axis=1), w, axis=0)                            # [B*W, total]
+    # only beam 0 is live before the first expansion (identical beams
+    # would multiply-count the same continuation)
+    scores = jnp.tile(jnp.where(jnp.arange(w) == 0, 0.0, neg_inf), b)
+
+    def gather_beams(tree, parent):                    # parent [B, W]
+        flat = (jnp.arange(b)[:, None] * w + parent).reshape(-1)
+        return jax.tree.map(
+            lambda a: a[flat] if a.ndim > 0 and a.shape[0] == b * w else a,
+            tree)
+
+    def step(t, carry):
+        tokens, cache, scores = carry
+        tok = jax.lax.dynamic_slice(tokens, (0, t), (b * w, 1))
+        logits, mutated = dec.apply({"params": params, "cache": cache},
+                                    tok, mutable=["cache"])
+        cache = mutated["cache"]
+        logp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32), -1)
+        vocab = logp.shape[-1]
+        cand = (scores[:, None] + logp).reshape(b, w * vocab)
+        new_scores, flat_idx = jax.lax.top_k(cand, w)          # [B, W]
+        parent = flat_idx // vocab                     # beam each came from
+        nxt = (flat_idx % vocab).astype(jnp.int32)
+        tokens = gather_beams(tokens, parent)
+        cache = gather_beams(cache, parent)
+        tokens = jax.lax.dynamic_update_slice(
+            tokens, nxt.reshape(-1, 1), (0, t + 1))
+        return tokens, cache, new_scores.reshape(-1)
+
+    tokens, _, scores = jax.lax.fori_loop(prompt_len - 1, total - 1, step,
+                                          (tokens, cache, scores))
+    scores = scores.reshape(b, w)
+    best = jnp.argmax(scores, axis=1)                  # [B]
+    seqs = tokens.reshape(b, w, total)[jnp.arange(b), best]
+    return seqs, scores[jnp.arange(b), best]
+
+
 # -- LM persistence: a servable (config + params) unit in the store --------
 #
 # The image engine reconstructs its models from the registry by name; LMs
