@@ -43,16 +43,20 @@ def init_cache(model: TransformerLM, batch: int, max_len: int) -> Any:
 
 
 @partial(jax.jit,
-         static_argnames=("model", "prompt_len", "max_new", "temperature"))
+         static_argnames=("model", "prompt_len", "max_new", "temperature",
+                          "top_p"))
 def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
              prompt_len: int, max_new: int, *, temperature: float = 0.0,
+             top_p: float = 1.0,
              rng: jax.Array | None = None,
              prompt_lens: jnp.ndarray | None = None) -> jnp.ndarray:
     """Generate ``max_new`` tokens after ``prompt[:, :prompt_len]``.
 
     prompt: int32 [B, prompt_len] (static width). Returns int32
     [B, prompt_len + max_new]. temperature 0 → greedy argmax; > 0 →
-    softmax sampling (needs ``rng``).
+    softmax sampling (needs ``rng``); ``top_p`` < 1 restricts sampling to
+    the nucleus — the smallest probability mass ≥ top_p (applied after
+    temperature).
 
     Ragged batches: pass ``prompt_lens`` (int [B], 1 ≤ len ≤ prompt_len)
     with right-padded prompts — each row is teacher-forced only through its
@@ -83,8 +87,24 @@ def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
                                     tok, mutable=["cache"])
         logits = logits[:, 0]                                # [B, vocab]
         if temperature > 0.0:
+            scaled = logits / temperature
+            if top_p < 1.0:
+                # nucleus: mask everything outside the smallest prefix of
+                # the sorted distribution whose mass reaches top_p
+                probs = jax.nn.softmax(scaled, axis=-1)
+                sorted_p = jnp.sort(probs, axis=-1)[:, ::-1]
+                cum = jnp.cumsum(sorted_p, axis=-1)
+                # per row: the prob of the LAST token inside the nucleus;
+                # clamp the target to the achievable total mass so float32
+                # cumsum shortfall near 1.0 can't collapse the nucleus to
+                # the argmax token (argmax of all-False is 0)
+                target = jnp.minimum(top_p, cum[:, -1:])
+                k_idx = jnp.argmax(cum >= target, axis=-1)
+                cutoff = jnp.take_along_axis(sorted_p, k_idx[:, None],
+                                             axis=-1)
+                scaled = jnp.where(probs >= cutoff, scaled, -jnp.inf)
             rng, sub = jax.random.split(rng)
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            nxt = jax.random.categorical(sub, scaled, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
         # per row: teacher-force while inside its prompt; append past it

@@ -140,6 +140,7 @@ class ControlService:
             out = generate(model, params, prompt,
                            prompt_len=prompt.shape[1],
                            max_new=int(p["max_new"]),
-                           temperature=temperature, **kw)
+                           temperature=temperature,
+                           top_p=float(p.get("top_p", 1.0)), **kw)
             return {"tokens": [[int(t) for t in row] for row in out]}
         raise ValueError(f"unknown control verb {verb!r}")
