@@ -44,9 +44,10 @@ class QueryResult:
     """One executed (sub)query — the reference's return contract
     (`alexnet_resnet.py:92`) plus throughput accounting.
 
-    ``weights`` is the provenance marker ("pretrained" | "random"): random
-    init must never masquerade as real classifications (round-1 VERDICT
-    weak #6 — silent random-weight serving)."""
+    ``weights`` is the provenance marker ("pretrained" | "store" |
+    "random"): random init must never masquerade as real classifications
+    (round-1 VERDICT weak #6 — silent random-weight serving); "store" =
+    cluster-published weights fetched from the replicated file store."""
 
     model: str
     records: list[tuple[str, str, float]]   # (image_name, category, prob)
@@ -64,7 +65,7 @@ class _LoadedModel:
     variables: Any          # on-device, replicated
     predict: Any            # jitted (variables, u8 batch) -> (idx, prob)
     predict_many: Any       # jitted (variables, u8 [K,B,...]) -> ([K,B], [K,B])
-    provenance: str = "random"   # "pretrained" | "random"
+    provenance: str = "random"   # "pretrained" | "store" | "random"
 
 
 class InferenceEngine:
@@ -77,13 +78,18 @@ class InferenceEngine:
     """
 
     def __init__(self, config: EngineConfig | None = None, mesh=None,
-                 seed: int = 0, pretrained: bool = True):
+                 seed: int = 0, pretrained: bool = True, store=None):
         import threading
 
         self.config = config or EngineConfig()
         self.mesh = mesh if mesh is not None else local_mesh()
         self.seed = seed
         self.pretrained = pretrained
+        # optional replicated file store: weights published there (by any
+        # node) take precedence over the local torchvision cache, so every
+        # node in a cluster serves IDENTICAL weights — the reference's
+        # SDFS-dataset-distribution story applied to model weights
+        self.store = store
         self._models: dict[str, _LoadedModel] = {}
         self._load_lock = threading.Lock()
         self._pallas_ok: bool | None = None   # resolved on first load
@@ -108,7 +114,11 @@ class InferenceEngine:
                               dtype=jnp.dtype(self.config.compute_dtype),
                               param_dtype=jnp.dtype(self.config.param_dtype))
         variables, provenance = None, "random"
-        if self.pretrained:
+        if self.pretrained and self.store is not None:
+            variables = self._try_load_from_store(name, module)
+            if variables is not None:
+                provenance = "store"
+        if variables is None and self.pretrained:
             from idunno_tpu.models.convert import try_load_torchvision
             variables = try_load_torchvision(name)
             if variables is not None:
@@ -131,9 +141,78 @@ class InferenceEngine:
             predict=predict, predict_many=predict_many,
             provenance=provenance)
 
+    def _try_load_from_store(self, name: str, module) -> Any | None:
+        """Fetch cluster-published weights (``ckpt/<name>``) from the
+        replicated store; None when absent (fall through to the local
+        torchvision cache or random init). Reads a LOCAL replica when this
+        node holds one (instant, no network); otherwise fetches from the
+        master, which can block up to the transport timeout if the
+        coordinator is unreachable — that failure is logged at WARNING
+        because it can leave this node serving different weights than the
+        rest of the cluster."""
+        import logging
+
+        import flax.serialization
+
+        from idunno_tpu.engine.checkpoint import checkpoint_name
+
+        log = logging.getLogger("idunno.engine")
+        cname = checkpoint_name(name)
+        blob = None
+        local = self.store.local_files().get(cname)
+        if local:
+            blob = self.store.local.read(cname, max(local))
+        if blob is None:
+            try:
+                blob, _ = self.store.get_bytes(cname)
+            except Exception as e:  # noqa: BLE001 - split absent vs broken
+                msg = str(e).lower()
+                if "not found" in msg or "not exist" in msg:
+                    log.debug("no store-published weights for %s", name)
+                else:
+                    log.warning(
+                        "store fetch for %s weights failed (%s); this node "
+                        "may serve different weights than the cluster",
+                        name, e)
+                return None
+        try:
+            # structure-only template; host numpy zeros (no device alloc)
+            import numpy as _np
+            template = jax.eval_shape(
+                lambda r, x: module.init(r, x, train=False),
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, self.config.image_size,
+                           self.config.image_size, 3), jnp.float32))
+            template = jax.tree.map(
+                lambda s: _np.zeros(s.shape, s.dtype), template)
+            return flax.serialization.from_bytes(template, blob)
+        except Exception as e:  # noqa: BLE001 - corrupt/mismatched blob
+            log.warning("store-published weights for %s unusable (%s)",
+                        name, e)
+            return None
+
+    def publish_weights(self, name: str, *, allow_random: bool = False) -> int:
+        """Version this node's loaded weights for ``name`` into the store,
+        so every other node serves the same parameters; returns the store
+        version. Refuses random-init weights (they would masquerade
+        cluster-wide under provenance "store") unless ``allow_random``."""
+        from idunno_tpu.engine.checkpoint import save_variables
+
+        if self.store is None:
+            raise ValueError("engine has no store attached")
+        self.load(name)
+        m = self._models[name]
+        if m.provenance == "random" and not allow_random:
+            raise ValueError(
+                f"refusing to publish RANDOM weights for {name!r}; load a "
+                "pretrained/trained checkpoint first or pass "
+                "allow_random=True (test/demo clusters only)")
+        return save_variables(self.store, name, m.variables)
+
     def weights_provenance(self, name: str) -> str:
-        """"pretrained" | "random" for an already-loaded model; "unknown"
-        if not loaded (never triggers a load just to read a string)."""
+        """"pretrained" | "store" | "random" for an already-loaded model;
+        "unknown" if not loaded (never triggers a load just to read a
+        string)."""
         m = self._models.get(name)
         return m.provenance if m else "unknown"
 

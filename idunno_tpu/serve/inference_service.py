@@ -81,8 +81,9 @@ class InferenceService:
         # coordinator state
         self._qnum: dict[str, int] = {}          # per-model counter (`:965-966`)
         self._results: dict[tuple[str, int], list[tuple[str, str, float]]] = {}
-        # per-model weight-provenance markers seen in RESULTs ("pretrained" /
-        # "random") — random init must never pass as real classifications
+        # per-model weight-provenance markers seen in RESULTs ("pretrained"
+        # / "store" / "random") — random init must never pass as real
+        # classifications
         self._weights_seen: dict[str, set[str]] = {}
         self._results_lock = threading.RLock()
 
@@ -173,7 +174,7 @@ class InferenceService:
 
     def weights_provenance(self) -> dict[str, str]:
         """Per-model weight provenance aggregated over RESULTs:
-        "pretrained" | "random" | "unknown", or "mixed(...)" if workers
+        "pretrained" | "store" | "random" | "unknown", or "mixed(...)" if workers
         disagree (e.g. one node has the checkpoint cached, another not)."""
         with self._results_lock:
             out = {}

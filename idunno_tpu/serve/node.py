@@ -41,7 +41,8 @@ class Node:
         if engine is None:
             # deferred import: pure-control-plane nodes shouldn't pay for jax
             from idunno_tpu.engine.inference import InferenceEngine
-            engine = InferenceEngine(engine_config or EngineConfig())
+            engine = InferenceEngine(engine_config or EngineConfig(),
+                                     store=self.store)
         self.engine = engine
         self.metrics = MetricsTracker()
         self.inference = InferenceService(host, config, transport,

@@ -93,3 +93,57 @@ def test_vit_serves_through_engine(eight_devices):
     assert res.weights == "random"
     names = [r[0] for r in res.records]
     assert names[0] == "test_0.JPEG" and names[-1] == "test_15.JPEG"
+
+
+def test_weights_distribute_through_store(eight_devices, tmp_path):
+    """Cluster weight distribution: one node publishes its weights into the
+    replicated store; every other node's engine loads THE SAME parameters
+    from there (provenance 'store'), so the cluster classifies uniformly."""
+    from idunno_tpu.comm.inproc import InProcNetwork
+    from idunno_tpu.config import ClusterConfig
+    from idunno_tpu.membership.service import MembershipService
+    from idunno_tpu.store.sdfs import FileStoreService
+    from tests.test_membership import FakeClock, pump
+
+    cfg = ClusterConfig(hosts=("n0", "n1"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0",
+                        replication_factor=2)
+    net, clock = InProcNetwork(), FakeClock()
+    members, stores = {}, {}
+    for h in cfg.hosts:
+        t = net.transport(h)
+        members[h] = MembershipService(h, cfg, t, clock=clock)
+        stores[h] = FileStoreService(h, cfg, t, members[h],
+                                     str(tmp_path / h))
+    for h in cfg.hosts:
+        members[h].join()
+        clock.advance(0.01)
+    pump(members, clock)
+
+    ecfg = EngineConfig(batch_size=8, image_size=64, resize_size=64)
+    publisher = InferenceEngine(ecfg, mesh=local_mesh(), seed=0,
+                                pretrained=False, store=stores["n0"])
+    import pytest
+    with pytest.raises(ValueError, match="RANDOM"):
+        publisher.publish_weights("alexnet")    # guard: no silent garbage
+    version = publisher.publish_weights("alexnet", allow_random=True)
+    assert version == 1
+
+    # a DIFFERENT node, different seed: must serve the published weights
+    consumer = InferenceEngine(ecfg, mesh=local_mesh(), seed=999,
+                               pretrained=True, store=stores["n1"])
+    consumer.load("alexnet")
+    assert consumer.weights_provenance("alexnet") == "store"
+
+    images = np.random.default_rng(0).integers(
+        0, 256, size=(8, 64, 64, 3), dtype=np.uint8)
+    idx_a, prob_a = publisher.infer_batch("alexnet", images)
+    idx_b, prob_b = consumer.infer_batch("alexnet", images)
+    np.testing.assert_array_equal(idx_a, idx_b)
+    np.testing.assert_allclose(prob_a, prob_b, atol=1e-5, rtol=1e-5)
+
+    # without a store and no local torch cache, a different seed diverges
+    loner = InferenceEngine(ecfg, mesh=local_mesh(), seed=999,
+                            pretrained=False)
+    loner.load("alexnet")
+    assert loner.weights_provenance("alexnet") == "random"
