@@ -194,6 +194,10 @@ def run_bench(devices) -> None:
     iters = int(os.environ.get("BENCH_ITERS", "3"))
     sweep = [int(s) for s in
              os.environ.get("BENCH_SWEEP", "256,1024").split(",")]
+    # weight residency knobs: param_dtype bfloat16 halves weight HBM traffic
+    # vs float32; quantize=int8 quarters it (ops/quantize.py)
+    param_dtype = os.environ.get("BENCH_PARAM_DTYPE", "float32")
+    quantize = os.environ.get("BENCH_QUANTIZE", "none")
     platform = devices[0].platform
     device_kind = getattr(devices[0], "device_kind", platform)
 
@@ -242,8 +246,10 @@ def run_bench(devices) -> None:
         if best is not None and elapsed > budget_s * 0.75:
             sweep_out.append({"batch_size": bs, "skipped": "time budget"})
             continue
-        engine = InferenceEngine(EngineConfig(batch_size=bs), mesh=mesh,
-                                 pretrained=False)
+        engine = InferenceEngine(
+            EngineConfig(batch_size=bs, param_dtype=param_dtype,
+                         quantize=quantize),
+            mesh=mesh, pretrained=False)
         staged, k = staged_for(bs)
         t0 = time.perf_counter()
         idx, prob = engine.infer_staged(BENCH_MODEL, staged, k * bs)  # compile
@@ -276,8 +282,10 @@ def run_bench(devices) -> None:
     # cluster worker runs per task.
     bs = best["batch_size"]
     n_e2e = 4 * bs
-    e2e_engine = InferenceEngine(EngineConfig(batch_size=bs), mesh=mesh,
-                                 pretrained=False)
+    e2e_engine = InferenceEngine(
+        EngineConfig(batch_size=bs, param_dtype=param_dtype,
+                     quantize=quantize),
+        mesh=mesh, pretrained=False)
     t0 = time.perf_counter()
     e2e_res = e2e_engine.infer(BENCH_MODEL, 0, n_e2e - 1)
     e2e_s = time.perf_counter() - t0
@@ -303,6 +311,7 @@ def run_bench(devices) -> None:
          flops_per_image=round(flops_img / 1e9, 3),
          best_batch_size=best["batch_size"], sweep=sweep_out,
          n_images=n_images, iters=iters,
+         param_dtype=param_dtype, quantize=quantize,
          h2d_transfer_s=round(transfer_s, 2),
          p50_query_latency_s_400imgs=round(400 / ips, 4),
          e2e_worker_path_images_per_s=round(n_e2e / e2e_s, 1),

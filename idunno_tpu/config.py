@@ -126,6 +126,10 @@ class EngineConfig:
     # uint8→normalized preprocess: "auto" = Pallas kernel on TPU, XLA
     # elsewhere; "pallas" / "xla" force one path.
     preprocess: str = "auto"
+    # "none" | "int8": weight-only symmetric per-channel quantization of the
+    # resident model weights (ops/quantize.py) — halves/quarters weight HBM;
+    # dequant happens inside the jitted forward
+    quantize: str = "none"
     # models to load + compile in the background at node start, so the first
     # query doesn't pay the (remote) compile — the reference instead paid a
     # model download+load on EVERY task (`alexnet_resnet.py:17-22`) and its

@@ -134,6 +134,12 @@ class InferenceEngine:
             dummy = jnp.zeros((1, self.config.image_size,
                                self.config.image_size, 3), jnp.float32)
             variables = module.init(rng, dummy, train=False)
+        if self.config.quantize == "int8":
+            from idunno_tpu.ops.quantize import quantize_tree
+            variables = quantize_tree(variables)
+        elif self.config.quantize != "none":
+            raise ValueError(f"EngineConfig.quantize="
+                             f"{self.config.quantize!r}: want none|int8")
         variables = jax.device_put(variables, replicated_sharding(self.mesh))
         predict, predict_many = self._build_predict(module)
         self._models[name] = _LoadedModel(
@@ -144,37 +150,85 @@ class InferenceEngine:
     def _try_load_from_store(self, name: str, module) -> Any | None:
         """Fetch cluster-published weights (``ckpt/<name>``) from the
         replicated store; None when absent (fall through to the local
-        torchvision cache or random init). Reads a LOCAL replica when this
-        node holds one (instant, no network); otherwise fetches from the
-        master, which can block up to the transport timeout if the
-        coordinator is unreachable — that failure is logged at WARNING
-        because it can leave this node serving different weights than the
-        rest of the cluster."""
-        import logging
+        torchvision cache or random init).
 
-        import flax.serialization
+        A LOCAL replica is served only when a ``stat`` to the master shows
+        it holds the LATEST version — re-replication after membership churn
+        can leave this node with a stale copy, and serving it would break
+        the identical-weights-cluster-wide invariant. When the master is
+        unreachable the freshest local copy is served best-effort (closer
+        to the cluster's weights than falling back to torchvision/random);
+        a local copy that is stale, unreadable, or fails shape validation
+        falls through to a master fetch. Both warnings below flag the same
+        hazard: this node may serve different weights than the cluster."""
+        import logging
 
         from idunno_tpu.engine.checkpoint import checkpoint_name
 
         log = logging.getLogger("idunno.engine")
         cname = checkpoint_name(name)
-        blob = None
         local = self.store.local_files().get(cname)
-        if local:
-            blob = self.store.local.read(cname, max(local))
-        if blob is None:
-            try:
-                blob, _ = self.store.get_bytes(cname)
-            except Exception as e:  # noqa: BLE001 - split absent vs broken
-                msg = str(e).lower()
-                if "not found" in msg or "not exist" in msg:
-                    log.debug("no store-published weights for %s", name)
-                else:
-                    log.warning(
-                        "store fetch for %s weights failed (%s); this node "
-                        "may serve different weights than the cluster",
-                        name, e)
+        latest = None
+        stat_failed = False
+        try:
+            latest, _holders = self.store.stat(cname)
+        except Exception as e:  # noqa: BLE001 - split absent vs unreachable
+            msg = str(e).lower()
+            if "not found" in msg or "not exist" in msg:
+                log.debug("no store-published weights for %s", name)
                 return None
+            stat_failed = True
+            if not local:
+                # master unreachable AND nothing local: get_bytes would only
+                # block a second transport timeout against the same dead
+                # master — fall back now
+                log.warning(
+                    "store stat for %s weights failed (%s); no local "
+                    "replica to serve — falling back", name, e)
+                return None
+            log.warning(
+                "store stat for %s weights failed (%s); serving the local "
+                "replica without knowing whether it is current", name, e)
+        use_version = None
+        if local and (latest is None or latest in local):
+            use_version = latest if latest is not None else max(local)
+        if use_version is not None:
+            blob = self.store.local.read(cname, use_version)
+            if blob is not None:
+                variables = self._decode_variables(name, module, blob, log)
+                if variables is not None:
+                    return variables
+            # unreadable/corrupt/mismatched local replica: other holders
+            # may have a healthy copy — fall through to the master fetch
+        if stat_failed:
+            # the master is already known unreachable; a fetch would only
+            # block further transport timeouts against the same dead hosts
+            log.warning("local replica for %s unusable and the master is "
+                        "unreachable — falling back", name)
+            return None
+        try:
+            blob, _ = self.store.get_bytes(cname)
+        except Exception as e:  # noqa: BLE001 - split absent vs broken
+            msg = str(e).lower()
+            if "not found" in msg or "not exist" in msg:
+                log.debug("no store-published weights for %s", name)
+            else:
+                log.warning(
+                    "store fetch for %s weights failed (%s); this node "
+                    "may serve different weights than the cluster",
+                    name, e)
+            return None
+        return self._decode_variables(name, module, blob, log)
+
+    def _decode_variables(self, name: str, module, blob: bytes,
+                          log) -> Any | None:
+        """Deserialize + SHAPE-validate a weights blob against the module.
+        `flax.serialization.from_bytes` checks dict structure but not leaf
+        shapes, so a blob published under a different architecture/config
+        would otherwise load 'successfully' and crash later inside the
+        jitted predict — mid-query, with no fallback."""
+        import flax.serialization
+
         try:
             # structure-only template; host numpy zeros (no device alloc)
             import numpy as _np
@@ -185,7 +239,21 @@ class InferenceEngine:
                            self.config.image_size, 3), jnp.float32))
             template = jax.tree.map(
                 lambda s: _np.zeros(s.shape, s.dtype), template)
-            return flax.serialization.from_bytes(template, blob)
+            variables = flax.serialization.from_bytes(template, blob)
+            mismatches = []
+
+            def check(path, t, v):
+                if tuple(t.shape) != tuple(_np.shape(v)):
+                    mismatches.append(
+                        f"{jax.tree_util.keystr(path)}: "
+                        f"{tuple(_np.shape(v))} != {tuple(t.shape)}")
+                return v
+
+            jax.tree_util.tree_map_with_path(check, template, variables)
+            if mismatches:
+                raise ValueError("shape mismatch vs this engine's config: "
+                                 + "; ".join(mismatches[:3]))
+            return variables
         except Exception as e:  # noqa: BLE001 - corrupt/mismatched blob
             log.warning("store-published weights for %s unusable (%s)",
                         name, e)
@@ -207,7 +275,15 @@ class InferenceEngine:
                 f"refusing to publish RANDOM weights for {name!r}; load a "
                 "pretrained/trained checkpoint first or pass "
                 "allow_random=True (test/demo clusters only)")
-        return save_variables(self.store, name, m.variables)
+        variables = m.variables
+        if self.config.quantize == "int8":
+            # published checkpoints are always full precision (consumers
+            # choose their own quantization; a QTensor tree would not match
+            # their deserialization template)
+            from idunno_tpu.ops.quantize import dequantize_tree
+            variables = dequantize_tree(
+                variables, dtype=jnp.dtype(self.config.param_dtype))
+        return save_variables(self.store, name, variables)
 
     def weights_provenance(self, name: str) -> str:
         """"pretrained" | "store" | "random" for an already-loaded model;
@@ -271,6 +347,11 @@ class InferenceEngine:
                 return preprocess_batch(u8, crop=self.config.image_size)
 
         def fwd(variables, images_u8):
+            if self.config.quantize == "int8":
+                # int8 stays HBM-resident; the cast fuses into consumers
+                from idunno_tpu.ops.quantize import dequantize_tree
+                variables = dequantize_tree(
+                    variables, dtype=jnp.dtype(self.config.param_dtype))
             x = preprocess(images_u8)
             logits = module.apply(variables, x, train=False)
             return top1_from_logits(logits)

@@ -73,17 +73,21 @@ def make_attn_fn(kind: str = "auto", *, mesh=None, axis: str = "data",
 
 def rope(x: jnp.ndarray, *, base: float = 10000.0,
          positions: jnp.ndarray | None = None) -> jnp.ndarray:
-    """Rotary embedding over [B, T, H, D]; ``positions`` [T] overrides the
-    default global positions 0..T-1 (decode steps pass their absolute
-    position so cached keys and the new query rotate consistently)."""
+    """Rotary embedding over [B, T, H, D]; ``positions`` overrides the
+    default global positions 0..T-1 — shape [T] (shared across the batch;
+    decode steps pass their absolute position so cached keys and the new
+    query rotate consistently) or [B, T] (per-row positions, the
+    continuous-batching decode where every row sits at its own depth)."""
     b, t, h, d = x.shape
     half = d // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     if positions is None:
         positions = jnp.arange(t, dtype=jnp.float32)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :]      # [1, T, 1, half]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., half]
+    if angles.ndim == 2:                         # [T, half] → [1, T, 1, half]
+        angles = angles[None]
+    cos = jnp.cos(angles)[:, :, None, :]         # [1|B, T, 1, half]
+    sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
@@ -102,6 +106,7 @@ class MultiHeadAttention(nn.Module):
     use_rope: bool = True
     decode: bool = False
     max_decode_len: int = 0
+    decode_per_row: bool = False
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -125,8 +130,15 @@ class MultiHeadAttention(nn.Module):
                                name="out")(out)
 
     def _decode_step(self, q, k, v):
-        """One token in, one token out: write this step's K/V at the cache
-        cursor, attend the query over every cached position ≤ cursor.
+        """Autoregressive serving against the KV cache — three shapes:
+
+        scalar cursor, t=1: one token in, one out (``engine.generate``);
+        scalar cursor, t>1: CHUNKED prefill — the whole prompt in one apply,
+          K/V written at cursor..cursor+t-1, causal within the chunk;
+        per-row cursors (``decode_per_row``), t=1: continuous batching —
+          every batch row sits at its own depth, cursors are int32 [B] and
+          OWNED BY THE CALLER (read, never advanced here; the serving loop
+          advances only its live rows — `engine.serve_lm.DecodeServer`).
 
         Uses its own cached softmax-attention kernel — any correct causal
         ``attn_fn`` (full/ring/flash) is numerically equivalent, so the
@@ -139,34 +151,57 @@ class MultiHeadAttention(nn.Module):
                              "(autoregressive serving of a bidirectional "
                              "model would silently change its semantics)")
         b, t, h, d = q.shape
-        if t != 1:
-            raise ValueError(f"decode step takes one token, got {t}")
+        if self.decode_per_row and t != 1:
+            raise ValueError(f"per-row decode takes one token, got {t}")
         ck = self.variable("cache", "cached_k", jnp.zeros,
                            (b, self.max_decode_len, h, d), k.dtype)
         cv = self.variable("cache", "cached_v", jnp.zeros,
                            (b, self.max_decode_len, h, d), v.dtype)
-        cur = self.variable("cache", "cursor",
-                            lambda: jnp.zeros((), jnp.int32))
-        i = cur.value
-        if self.use_rope:
-            pos = i[None].astype(jnp.float32)
-            q = rope(q, positions=pos)
-            k = rope(k, positions=pos)
-        # overflow guard: past max_decode_len the write would clamp onto the
-        # last slot and the mask would unmask everything — keep the cache
-        # intact and poison the scores to NaN so misuse is loud, not silent
-        overflow = i >= self.max_decode_len
-        new_k = jax.lax.dynamic_update_slice(ck.value, k, (0, i, 0, 0))
-        new_v = jax.lax.dynamic_update_slice(cv.value, v, (0, i, 0, 0))
-        new_k = jnp.where(overflow, ck.value, new_k)
-        new_v = jnp.where(overflow, cv.value, new_v)
-        if not self.is_initializing():     # init must return a CLEAN cache
-            ck.value, cv.value, cur.value = new_k, new_v, i + 1
+        if self.decode_per_row:
+            cur = self.variable("cache", "cursors",
+                                lambda: jnp.zeros((b,), jnp.int32))
+            i = cur.value                                  # [B]
+            pos = i[:, None].astype(jnp.float32)           # [B, 1]
+            # overflow guard: keep the cache intact and poison the scores
+            # to NaN so misuse is loud, not silent
+            overflow = i >= self.max_decode_len            # [B]
+            if self.use_rope:
+                q, k = rope(q, positions=pos), rope(k, positions=pos)
+            slot = jnp.clip(i, 0, self.max_decode_len - 1)
+            rows = jnp.arange(b)
+            new_k = ck.value.at[rows, slot].set(k[:, 0])
+            new_v = cv.value.at[rows, slot].set(v[:, 0])
+            ovr = overflow[:, None, None, None]
+            new_k = jnp.where(ovr, ck.value, new_k)
+            new_v = jnp.where(ovr, cv.value, new_v)
+            if not self.is_initializing():  # init returns a CLEAN cache;
+                ck.value, cv.value = new_k, new_v   # cursors: caller-owned
+            # [B, 1, T] → broadcast over heads
+            mask = (jnp.arange(self.max_decode_len)[None, :]
+                    <= i[:, None])[:, None, None, :]
+            poison = overflow[:, None, None, None]
+        else:
+            cur = self.variable("cache", "cursor",
+                                lambda: jnp.zeros((), jnp.int32))
+            i = cur.value
+            pos = (i + jnp.arange(t)).astype(jnp.float32)  # [T]
+            overflow = i + t > self.max_decode_len
+            if self.use_rope:
+                q, k = rope(q, positions=pos), rope(k, positions=pos)
+            new_k = jax.lax.dynamic_update_slice(ck.value, k, (0, i, 0, 0))
+            new_v = jax.lax.dynamic_update_slice(cv.value, v, (0, i, 0, 0))
+            new_k = jnp.where(overflow, ck.value, new_k)
+            new_v = jnp.where(overflow, cv.value, new_v)
+            if not self.is_initializing():  # init must return a CLEAN cache
+                ck.value, cv.value, cur.value = new_k, new_v, i + t
+            # [q, T]: chunk position j attends cache slots ≤ i + j
+            mask = (jnp.arange(self.max_decode_len)[None, :]
+                    <= (i + jnp.arange(t))[:, None])[None, None, :, :]
+            poison = overflow
         scores = jnp.einsum("bqhd,bthd->bhqt", q.astype(jnp.float32),
                             new_k.astype(jnp.float32)) / (d ** 0.5)
-        scores = jnp.where(overflow, jnp.nan, scores)
-        mask = jnp.arange(self.max_decode_len) <= i       # [T]
-        scores = jnp.where(mask[None, None, None, :], scores, -jnp.inf)
+        scores = jnp.where(poison, jnp.nan, scores)
+        scores = jnp.where(mask, scores, -jnp.inf)
         weights = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bhqt,bthd->bqhd", weights,
                          new_v.astype(jnp.float32)).astype(self.dtype)
@@ -190,6 +225,7 @@ class Block(nn.Module):
     use_rope: bool = True
     decode: bool = False
     max_decode_len: int = 0
+    decode_per_row: bool = False
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -201,6 +237,7 @@ class Block(nn.Module):
             self.dim, self.num_heads, causal=self.causal,
             attn_fn=self.attn_fn, use_rope=self.use_rope,
             decode=self.decode, max_decode_len=self.max_decode_len,
+            decode_per_row=self.decode_per_row,
             dtype=self.dtype,
             param_dtype=self.param_dtype, name="attn")(ln(name="ln1")(x))
         h_in = ln(name="ln2")(x)
@@ -233,6 +270,7 @@ class TransformerLM(nn.Module):
     ffn_every: int = 1
     decode: bool = False
     max_decode_len: int = 0
+    decode_per_row: bool = False
     remat: bool = False
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
@@ -255,6 +293,7 @@ class TransformerLM(nn.Module):
                           ffn_factory=self.ffn_factory if use_ffn else None,
                           decode=self.decode,
                           max_decode_len=self.max_decode_len,
+                          decode_per_row=self.decode_per_row,
                           dtype=self.dtype,
                           param_dtype=self.param_dtype, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,

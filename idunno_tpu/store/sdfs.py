@@ -243,6 +243,15 @@ class FileStoreService:
                                         {"name": sdfs_name}))
         return list(out.payload["hosts"])
 
+    def stat(self, sdfs_name: str) -> tuple[int, list[str]]:
+        """(latest version, holder hosts) — metadata only, no blob transfer.
+        Lets readers with a local replica decide whether it is CURRENT
+        before serving it (a stale local copy must not masquerade as the
+        latest). Raises StoreError when the file does not exist."""
+        out = self._master_call(Message(MessageType.STAT, self.host,
+                                        {"name": sdfs_name}))
+        return int(out.payload["version"]), list(out.payload["hosts"])
+
     def local_files(self) -> dict[str, list[int]]:
         """`store` verb: everything this host holds (`:1096-1098`)."""
         return self.local.files()
@@ -296,6 +305,13 @@ class FileStoreService:
             with self._meta_lock:
                 hosts = sorted(self._locations.get(name, set()))
             return Message(MessageType.ACK, self.host, {"hosts": hosts})
+        if msg.type is MessageType.STAT:
+            snap = self._snapshot(name)
+            if snap is None:
+                return self._err("file not found")
+            version, holders = snap
+            return Message(MessageType.ACK, self.host,
+                           {"version": version, "hosts": sorted(holders)})
         return self._err(f"bad verb {msg.type}")
 
     # -- master verb implementations --------------------------------------

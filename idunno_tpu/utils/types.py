@@ -32,6 +32,7 @@ class MessageType(str, enum.Enum):
     LS = "LS"
     STORE = "STORE"
     GET_VERSIONS = "GET_VERSIONS"
+    STAT = "STAT"
 
     INFERENCE = "INFERENCE"
     JOB = "JOB"

@@ -95,19 +95,16 @@ def test_vit_serves_through_engine(eight_devices):
     assert names[0] == "test_0.JPEG" and names[-1] == "test_15.JPEG"
 
 
-def test_weights_distribute_through_store(eight_devices, tmp_path):
-    """Cluster weight distribution: one node publishes its weights into the
-    replicated store; every other node's engine loads THE SAME parameters
-    from there (provenance 'store'), so the cluster classifies uniformly."""
+def _store_cluster(tmp_path, hosts=("n0", "n1")):
     from idunno_tpu.comm.inproc import InProcNetwork
     from idunno_tpu.config import ClusterConfig
     from idunno_tpu.membership.service import MembershipService
     from idunno_tpu.store.sdfs import FileStoreService
     from tests.test_membership import FakeClock, pump
 
-    cfg = ClusterConfig(hosts=("n0", "n1"), coordinator="n0",
-                        standby_coordinator="n1", introducer="n0",
-                        replication_factor=2)
+    cfg = ClusterConfig(hosts=hosts, coordinator=hosts[0],
+                        standby_coordinator=hosts[1], introducer=hosts[0],
+                        replication_factor=len(hosts))
     net, clock = InProcNetwork(), FakeClock()
     members, stores = {}, {}
     for h in cfg.hosts:
@@ -119,6 +116,14 @@ def test_weights_distribute_through_store(eight_devices, tmp_path):
         members[h].join()
         clock.advance(0.01)
     pump(members, clock)
+    return stores
+
+
+def test_weights_distribute_through_store(eight_devices, tmp_path):
+    """Cluster weight distribution: one node publishes its weights into the
+    replicated store; every other node's engine loads THE SAME parameters
+    from there (provenance 'store'), so the cluster classifies uniformly."""
+    stores = _store_cluster(tmp_path)
 
     ecfg = EngineConfig(batch_size=8, image_size=64, resize_size=64)
     publisher = InferenceEngine(ecfg, mesh=local_mesh(), seed=0,
@@ -147,3 +152,106 @@ def test_weights_distribute_through_store(eight_devices, tmp_path):
                             pretrained=False)
     loner.load("alexnet")
     assert loner.weights_provenance("alexnet") == "random"
+
+
+def test_stale_local_replica_not_served(eight_devices, tmp_path):
+    """A node holding only an OLD version of the published weights must
+    fetch the latest from the master, not serve its stale local copy (the
+    stat-before-local-read check: re-replication after membership churn can
+    leave a node with yesterday's weights)."""
+    from idunno_tpu.engine.checkpoint import checkpoint_name
+
+    stores = _store_cluster(tmp_path)
+    ecfg = EngineConfig(batch_size=8, image_size=64, resize_size=64)
+    v1_engine = InferenceEngine(ecfg, mesh=local_mesh(), seed=0,
+                                pretrained=False, store=stores["n0"])
+    assert v1_engine.publish_weights("alexnet", allow_random=True) == 1
+    v2_engine = InferenceEngine(ecfg, mesh=local_mesh(), seed=1,
+                                pretrained=False, store=stores["n0"])
+    assert v2_engine.publish_weights("alexnet", allow_random=True) == 2
+
+    cname = checkpoint_name("alexnet")
+    # simulate a node whose local replica lags: strip v2, keep v1
+    blob_v1 = stores["n1"].local.read(cname, 1)
+    assert blob_v1 is not None
+    import os as _os
+    _os.remove(stores["n1"].local._path(cname, 2))
+    stores["n1"].local._versions[cname].remove(2)
+    stores["n1"].local._persist_meta()
+    assert stores["n1"].local_files()[cname] == [1]
+
+    consumer = InferenceEngine(ecfg, mesh=local_mesh(), seed=999,
+                               pretrained=True, store=stores["n1"])
+    consumer.load("alexnet")
+    assert consumer.weights_provenance("alexnet") == "store"
+    images = np.random.default_rng(0).integers(
+        0, 256, size=(8, 64, 64, 3), dtype=np.uint8)
+    _, prob_v2 = v2_engine.infer_batch("alexnet", images)
+    _, prob_got = consumer.infer_batch("alexnet", images)
+    np.testing.assert_allclose(prob_got, prob_v2, atol=1e-5, rtol=1e-5)
+    _, prob_v1 = v1_engine.infer_batch("alexnet", images)
+    assert not np.allclose(prob_got, prob_v1), \
+        "consumer served the stale v1 weights"
+
+
+def test_corrupt_local_replica_falls_back_to_remote(eight_devices, tmp_path):
+    """A corrupt local replica is not terminal: deserialization failure on
+    the local copy retries through the master, where a healthy holder
+    exists."""
+    from idunno_tpu.engine.checkpoint import checkpoint_name
+
+    stores = _store_cluster(tmp_path)
+    ecfg = EngineConfig(batch_size=8, image_size=64, resize_size=64)
+    publisher = InferenceEngine(ecfg, mesh=local_mesh(), seed=0,
+                                pretrained=False, store=stores["n0"])
+    publisher.publish_weights("alexnet", allow_random=True)
+
+    cname = checkpoint_name("alexnet")
+    # n1's on-disk copy is truncated garbage (e.g. partial write + crash)
+    stores["n1"].local.write(cname, 1, b"\x00garbage")
+
+    consumer = InferenceEngine(ecfg, mesh=local_mesh(), seed=999,
+                               pretrained=True, store=stores["n1"])
+    consumer.load("alexnet")
+    assert consumer.weights_provenance("alexnet") == "store"
+    images = np.random.default_rng(0).integers(
+        0, 256, size=(8, 64, 64, 3), dtype=np.uint8)
+    _, prob_pub = publisher.infer_batch("alexnet", images)
+    _, prob_got = consumer.infer_batch("alexnet", images)
+    np.testing.assert_allclose(prob_got, prob_pub, atol=1e-5, rtol=1e-5)
+
+
+def test_shape_mismatched_published_weights_rejected(eight_devices,
+                                                     tmp_path):
+    """A published blob whose tree STRUCTURE matches but whose leaf SHAPES
+    don't (e.g. published from a different architecture revision) must be
+    REJECTED at load time with a fallback — not accepted by from_bytes
+    (which validates structure, not shapes) only to crash later inside the
+    jitted predict mid-query."""
+    import flax.serialization
+    import jax
+
+    from idunno_tpu.engine.checkpoint import checkpoint_name
+    from idunno_tpu.models import create_model
+
+    stores = _store_cluster(tmp_path)
+    ecfg = EngineConfig(batch_size=8, image_size=64, resize_size=64)
+    module = create_model("alexnet")
+    variables = module.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 64, 64, 3), np.float32),
+                            train=False)
+    # same structure, every leaf widened by one along axis 0 → wrong shapes
+    bad = jax.tree.map(
+        lambda a: np.concatenate([np.asarray(a),
+                                  np.zeros((1, *a.shape[1:]), a.dtype)]),
+        variables)
+    stores["n0"].put_bytes(checkpoint_name("alexnet"),
+                           flax.serialization.to_bytes(bad))
+
+    consumer = InferenceEngine(ecfg, mesh=local_mesh(), seed=999,
+                               pretrained=True, store=stores["n1"])
+    consumer.load("alexnet")                 # must not raise
+    assert consumer.weights_provenance("alexnet") == "random"
+    res = consumer.infer_batch(
+        "alexnet", np.zeros((4, 64, 64, 3), np.uint8))  # serves, no crash
+    assert len(res[0]) == 4

@@ -1,0 +1,124 @@
+"""Weight-only int8 quantization (`ops/quantize.py`) and its serving
+integrations (engine `quantize="int8"`, `DecodeServer(quantize="int8")`).
+
+Exactness contract: the quantized serving paths must compute exactly what
+the full-precision paths compute over the DEQUANTIZED weights — quantization
+changes the weights once, not the serving math.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idunno_tpu.ops.quantize import (
+    QTensor, dequantize_tree, quantize_leaf, quantize_tree, quantized_bytes)
+
+
+def test_roundtrip_error_bounded_per_channel():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(0, 3.0, size=(9, 64, 32)), jnp.float32)
+    qt = quantize_leaf(w)
+    assert qt.q.dtype == jnp.int8
+    assert qt.scale.shape == (1, 1, 32)
+    deq = np.asarray(qt.q, np.float32) * np.asarray(qt.scale)
+    # symmetric rounding: error ≤ half a step per channel
+    np.testing.assert_array_less(
+        np.abs(deq - np.asarray(w)),
+        np.broadcast_to(np.asarray(qt.scale) / 2 + 1e-7, w.shape))
+
+
+def test_zero_channel_and_selection_rules():
+    w = jnp.zeros((4, 3), jnp.float32)
+    qt = quantize_leaf(w)                       # no 0/0
+    assert np.all(np.asarray(qt.q) == 0)
+    tree = {"kernel": jnp.ones((4, 3)), "bias": jnp.ones((3,)),
+            "step": jnp.ones((), jnp.int32)}
+    qtree = quantize_tree(tree)
+    assert isinstance(qtree["kernel"], QTensor)
+    assert not isinstance(qtree["bias"], QTensor)     # ndim 1 stays dense
+    assert not isinstance(qtree["step"], QTensor)
+    back = dequantize_tree(qtree)
+    np.testing.assert_allclose(np.asarray(back["kernel"]),
+                               np.ones((4, 3)), atol=1e-6)
+    stored, dense = quantized_bytes(qtree)
+    assert stored < dense
+
+
+def test_engine_serves_int8_exactly_as_dequantized_weights(eight_devices):
+    from idunno_tpu.config import EngineConfig
+    from idunno_tpu.engine.inference import InferenceEngine
+    from idunno_tpu.ops.preprocess import preprocess_batch
+    from idunno_tpu.ops.classify import top1_from_logits
+    from idunno_tpu.parallel.mesh import local_mesh
+
+    eng = InferenceEngine(
+        EngineConfig(batch_size=8, image_size=64, resize_size=64,
+                     quantize="int8"),
+        mesh=local_mesh(), pretrained=False)
+    images = np.random.default_rng(0).integers(
+        0, 256, size=(8, 64, 64, 3), dtype=np.uint8)
+    idx, prob = eng.infer_batch("alexnet", images)
+
+    m = eng._models["alexnet"]
+    deq = dequantize_tree(jax.device_get(m.variables), dtype=jnp.float32)
+    x = preprocess_batch(jnp.asarray(images), crop=64)
+    want_idx, want_prob = top1_from_logits(
+        m.module.apply(deq, x, train=False))
+    np.testing.assert_array_equal(idx, np.asarray(want_idx))
+    np.testing.assert_allclose(prob, np.asarray(want_prob),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_engine_rejects_unknown_quantize_mode(eight_devices):
+    from idunno_tpu.config import EngineConfig
+    from idunno_tpu.engine.inference import InferenceEngine
+    from idunno_tpu.parallel.mesh import local_mesh
+
+    eng = InferenceEngine(
+        EngineConfig(batch_size=8, image_size=64, resize_size=64,
+                     quantize="int4"),
+        mesh=local_mesh(), pretrained=False)
+    with pytest.raises(ValueError, match="int8"):
+        eng.load("alexnet")
+
+
+def test_decode_server_int8_matches_generate_on_dequantized(eight_devices):
+    from idunno_tpu.engine.generate import generate
+    from idunno_tpu.engine.serve_lm import DecodeServer
+    from idunno_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab=61, dim=32, depth=2, num_heads=4)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = DecodeServer(model, params, slots=2, prompt_len=4, max_len=16,
+                       quantize="int8")
+    prompt = [5, 11, 17]
+    srv.submit(prompt, max_new=8)
+    got = srv.run_until_drained()[0]
+
+    deq = dequantize_tree(srv.params)
+    want = generate(model, deq, jnp.asarray([prompt], jnp.int32),
+                    prompt_len=3, max_new=8)
+    assert got.tokens == [int(t) for t in np.asarray(want[0])]
+
+
+def test_int8_engine_publishes_full_precision(eight_devices, tmp_path):
+    """An int8 engine must publish FULL-precision weights (a QTensor tree
+    would not match any consumer's deserialization template)."""
+    from idunno_tpu.config import EngineConfig
+    from idunno_tpu.engine.inference import InferenceEngine
+    from idunno_tpu.parallel.mesh import local_mesh
+    from tests.test_engine_overlap import _store_cluster
+
+    stores = _store_cluster(tmp_path)
+    qcfg = EngineConfig(batch_size=8, image_size=64, resize_size=64,
+                        quantize="int8")
+    pub = InferenceEngine(qcfg, mesh=local_mesh(), seed=0,
+                          pretrained=False, store=stores["n0"])
+    pub.publish_weights("alexnet", allow_random=True)
+
+    cfg = EngineConfig(batch_size=8, image_size=64, resize_size=64)
+    con = InferenceEngine(cfg, mesh=local_mesh(), seed=999,
+                          pretrained=True, store=stores["n1"])
+    con.load("alexnet")
+    assert con.weights_provenance("alexnet") == "store"

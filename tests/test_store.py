@@ -56,6 +56,19 @@ def test_replication_and_ls(cluster):
     assert "n0" in hosts
 
 
+def test_stat_reports_latest_version_without_blob(cluster):
+    cfg, net, clock, members, stores = cluster
+    with pytest.raises(StoreError, match="not found"):
+        stores["n2"].stat("nope.bin")
+    stores["n2"].put_bytes("s.bin", b"v1")
+    stores["n3"].put_bytes("s.bin", b"v2")
+    version, hosts = stores["n4"].stat("s.bin")
+    assert version == 2
+    assert set(hosts) == set(stores["n4"].ls("s.bin"))
+    for h in hosts:
+        assert "s.bin" in stores[h].local_files(), h
+
+
 def test_get_versions_merged_with_delimiters(cluster, tmp_path):
     cfg, net, clock, members, stores = cluster
     for i in (1, 2, 3):
