@@ -52,7 +52,15 @@ HELP = """\
   c2 processing-time stats of a query per model
   c4 [path] dump all results to result.txt
   cvm  per-host running tasks
-  cq   per-query task assignment map"""
+  cq   per-query task assignment map
+  train <name> <corpus> <steps> [k=v ...]   background LM training job
+       (model: vocab/dim/depth/num_heads; batch_size seq_len lr
+        checkpoint_every seed resume=1)
+  train-status <name> | train-stop <name>
+  lm-serve <name> <prompt_len> <max_len> [k=v ...]  continuous-batching pool
+       (slots decode_steps quantize=int8)
+  lm-submit <name> <max_new> <tok> [tok ...]   queue a prompt -> request id
+  lm-poll <name> | lm-stop <name>              fetch completions / stop"""
 
 
 class Shell:
@@ -78,6 +86,13 @@ class Shell:
             "13": self.cmd_inference, "inference": self.cmd_inference,
             "c1": self.cmd_c1, "c2": self.cmd_c2, "c4": self.cmd_c4,
             "cvm": self.cmd_cvm, "cq": self.cmd_cq,
+            "train": self.cmd_train,
+            "train-status": self.cmd_train_status,
+            "train-stop": self.cmd_train_stop,
+            "lm-serve": self.cmd_lm_serve,
+            "lm-submit": self.cmd_lm_submit,
+            "lm-poll": self.cmd_lm_poll,
+            "lm-stop": self.cmd_lm_stop,
         }
 
     # -- driver -----------------------------------------------------------
@@ -285,3 +300,105 @@ class Shell:
                 for t in book.tasks_for_query(model, qnum))
             rows.append(f"{model}#{qnum}: {parts}")
         return "\n".join(rows) or "(no queries yet)"
+
+    # -- LM training / serving (the control verbs, local) -----------------
+
+    _MODEL_KEYS = ("vocab", "dim", "depth", "num_heads")
+    _TRAIN_KEYS = ("batch_size", "seq_len", "checkpoint_every", "seed")
+
+    @staticmethod
+    def _kv(args: list[str]) -> dict:
+        out = {}
+        for a in args:
+            if "=" not in a:
+                raise ValueError(f"expected key=value, got {a!r}")
+            k, v = a.split("=", 1)
+            out[k] = v
+        return out
+
+    def _control(self, verb: str, **payload) -> dict:
+        return self.node.control._dispatch(verb, payload)
+
+    def cmd_train(self, args: list[str]) -> str:
+        if len(args) < 3:
+            return ("usage: train <name> <corpus> <steps> [vocab= dim= "
+                    "depth= num_heads= batch_size= seq_len= lr= "
+                    "checkpoint_every= seed= resume=1]")
+        name, corpus, steps = args[0], args[1], int(args[2])
+        kv = self._kv(args[3:])
+        model = {k: int(kv.pop(k)) for k in self._MODEL_KEYS if k in kv}
+        payload = {k: int(kv.pop(k)) for k in self._TRAIN_KEYS if k in kv}
+        if "lr" in kv:
+            payload["lr"] = float(kv.pop("lr"))
+        if "resume" in kv:
+            payload["resume"] = kv.pop("resume") not in ("0", "false", "")
+        if kv:
+            return f"unknown train option(s): {sorted(kv)}"
+        self._control("train_start", name=name, corpus=corpus, steps=steps,
+                      model=model, **payload)
+        return f"training job {name} started ({steps} steps on {corpus})"
+
+    def cmd_train_status(self, args: list[str]) -> str:
+        if len(args) != 1:
+            return "usage: train-status <name>"
+        st = self._control("train_status", name=args[0])
+        loss = "-" if st["loss"] is None else f"{st['loss']:.4f}"
+        state = ("ERROR: " + st["error"] if st["error"] else
+                 "done" if st["done"] else
+                 "stopped" if st["stopped"] else "running")
+        return (f"{args[0]}: step={st['step']} loss={loss} {state} "
+                f"ckpt_v={st['checkpoint_version']} "
+                f"served_v={st['served_version']}")
+
+    def cmd_train_stop(self, args: list[str]) -> str:
+        if len(args) != 1:
+            return "usage: train-stop <name>"
+        out = self._control("train_stop", name=args[0])
+        if not out["stopped"]:
+            return f"no training job {args[0]}"
+        return f"stopped {args[0]} at step {out['status']['step']}"
+
+    def cmd_lm_serve(self, args: list[str]) -> str:
+        if len(args) < 3:
+            return ("usage: lm-serve <name> <prompt_len> <max_len> "
+                    "[slots= decode_steps= quantize=int8 reload=1]")
+        kv = self._kv(args[3:])
+        payload = {k: int(kv.pop(k)) for k in ("slots", "decode_steps")
+                   if k in kv}
+        if "quantize" in kv:
+            payload["quantize"] = kv.pop("quantize")
+        if "reload" in kv:
+            payload["reload"] = kv.pop("reload") not in ("0", "false", "")
+        if kv:
+            return f"unknown lm-serve option(s): {sorted(kv)}"
+        out = self._control("lm_serve", name=args[0],
+                            prompt_len=int(args[1]), max_len=int(args[2]),
+                            **payload)
+        if out.get("already"):
+            return f"{args[0]} already serving (pass reload=1 to restart)"
+        return f"serving {args[0]} with {out['slots']} slots"
+
+    def cmd_lm_submit(self, args: list[str]) -> str:
+        if len(args) < 3:
+            return "usage: lm-submit <name> <max_new> <tok> [tok ...]"
+        out = self._control("lm_submit", name=args[0],
+                            max_new=int(args[1]),
+                            prompt=[int(t) for t in args[2:]])
+        return f"request {out['id']} queued on {args[0]}"
+
+    def cmd_lm_poll(self, args: list[str]) -> str:
+        if len(args) != 1:
+            return "usage: lm-poll <name>"
+        out = self._control("lm_poll", name=args[0])
+        rows = [f"#{c['id']}: {' '.join(str(t) for t in c['tokens'])} "
+                f"(prompt_len={c['prompt_len']})"
+                for c in out["completions"]]
+        rows.extend(f"ERROR: {e}" for e in out.get("errors", []))
+        return "\n".join(rows) or "(no completions yet)"
+
+    def cmd_lm_stop(self, args: list[str]) -> str:
+        if len(args) != 1:
+            return "usage: lm-stop <name>"
+        out = self._control("lm_stop", name=args[0])
+        return (f"stopped {args[0]}" if out["stopped"]
+                else f"no serving pool {args[0]}")

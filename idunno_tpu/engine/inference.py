@@ -173,22 +173,34 @@ class InferenceEngine:
         try:
             latest, _holders = self.store.stat(cname)
         except Exception as e:  # noqa: BLE001 - split absent vs unreachable
-            msg = str(e).lower()
-            if "not found" in msg or "not exist" in msg:
-                log.debug("no store-published weights for %s", name)
+            not_found = ("not found" in str(e).lower()
+                         or "not exist" in str(e).lower())
+            if not local:
+                # nothing local either way; a get_bytes would only repeat
+                # the same not-found or block a second transport timeout
+                if not_found:
+                    log.debug("no store-published weights for %s", name)
+                else:
+                    log.warning(
+                        "store stat for %s weights failed (%s); no local "
+                        "replica to serve — falling back", name, e)
                 return None
             stat_failed = True
-            if not local:
-                # master unreachable AND nothing local: get_bytes would only
-                # block a second transport timeout against the same dead
-                # master — fall back now
+            if not_found:
+                # the master doesn't know the file but this node holds a
+                # replica — deleted, or a failover whose metadata rebuild
+                # hasn't re-learned it yet. Serve the local copy
+                # best-effort (the pre-STAT behavior).
                 log.warning(
-                    "store stat for %s weights failed (%s); no local "
-                    "replica to serve — falling back", name, e)
-                return None
-            log.warning(
-                "store stat for %s weights failed (%s); serving the local "
-                "replica without knowing whether it is current", name, e)
+                    "master has no record of %s weights but a local "
+                    "replica exists (deleted, or failover metadata rebuild "
+                    "in progress?); serving the local copy best-effort",
+                    name)
+            else:
+                log.warning(
+                    "store stat for %s weights failed (%s); serving the "
+                    "local replica without knowing whether it is current",
+                    name, e)
         use_version = None
         if local and (latest is None or latest in local):
             use_version = latest if latest is not None else max(local)
@@ -201,10 +213,10 @@ class InferenceEngine:
             # unreadable/corrupt/mismatched local replica: other holders
             # may have a healthy copy — fall through to the master fetch
         if stat_failed:
-            # the master is already known unreachable; a fetch would only
-            # block further transport timeouts against the same dead hosts
-            log.warning("local replica for %s unusable and the master is "
-                        "unreachable — falling back", name)
+            # the master already has no copy to serve or is unreachable; a
+            # fetch would only repeat the failure / block more timeouts
+            log.warning("local replica for %s unusable and the master has "
+                        "no fetchable copy — falling back", name)
             return None
         try:
             blob, _ = self.store.get_bytes(cname)

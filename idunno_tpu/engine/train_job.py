@@ -1,0 +1,138 @@
+"""Cluster LM training jobs: a background trainer any node can run via RPC.
+
+The reference is inference-only — its weights come from torch.hub and its
+only "job" type is a query range (`alexnet_resnet.py:17-22`). A complete
+framework also RUNS training as a first-class cluster job: this runner
+pulls a tokenized corpus from the replicated store (`engine.data_lm`),
+drives the jitted LM train step, checkpoints the full TrainState back into
+the store on a cadence (crash = resume from the last version, exactness
+tested in `test_lm_lifecycle.py::test_training_resume_is_exact`), and on
+completion publishes the servable (config + weights) LM object that
+`lm_serve`/`generate` load — so the whole train → checkpoint → serve loop
+runs over the control RPC with no out-of-band steps.
+
+One thread per job; `status()` is safe from any thread.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class LMTrainJob:
+    """Background training of a dense `TransformerLM` on one node."""
+
+    def __init__(self, store, name: str, *, corpus: str,
+                 model_config: dict[str, Any], steps: int,
+                 batch_size: int = 8, seq_len: int = 32,
+                 lr: float = 1e-2, checkpoint_every: int = 50,
+                 seed: int = 0, resume: bool = False) -> None:
+        if steps < 1:
+            raise ValueError(f"steps={steps}: must be >= 1")
+        self.store = store
+        self.name = name
+        self.corpus = corpus
+        self.model_config = dict(model_config)
+        self.steps = steps
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.lr = lr
+        self.checkpoint_every = checkpoint_every
+        self.seed = seed
+        self.resume = resume
+
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._state: dict[str, Any] = {
+            "step": 0, "start_step": 0, "loss": None, "first_loss": None,
+            "done": False, "stopped": False, "error": None,
+            "checkpoint_version": None, "served_version": None,
+        }
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"train-{name}")
+        self._thread.start()
+
+    # -- any thread -------------------------------------------------------
+
+    def status(self) -> dict[str, Any]:
+        with self._lock:
+            return dict(self._state)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Request a graceful stop: the loop checkpoints and exits."""
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+
+    def join(self, timeout: float | None = None) -> None:
+        self._thread.join(timeout=timeout)
+
+    def _set(self, **kw) -> None:
+        with self._lock:
+            self._state.update(kw)
+
+    # -- job thread -------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            self._train()
+        except Exception as e:  # noqa: BLE001 - RPC-visible, not node-fatal
+            self._set(error=f"{type(e).__name__}: {e}", done=False)
+
+    def _train(self) -> None:
+        import optax
+
+        from idunno_tpu.engine.checkpoint import (
+            restore_train_state, save_train_state)
+        from idunno_tpu.engine.data_lm import TokenDataset, load_corpus
+        from idunno_tpu.engine.generate import save_lm
+        from idunno_tpu.engine.train_lm import (
+            create_lm_train_state, make_lm_train_step)
+        from idunno_tpu.models.transformer import TransformerLM
+
+        tokens = load_corpus(self.store, self.corpus)
+        model = TransformerLM(**self.model_config)
+        tx = optax.adam(self.lr)
+        state = create_lm_train_state(model, jax.random.PRNGKey(self.seed),
+                                      self.seq_len, tx)
+        if self.resume:
+            state, _ = restore_train_state(self.store, self.name, state)
+        start = int(state.step)
+        self._set(step=start, start_step=start)
+        step_fn = jax.jit(make_lm_train_step(model, tx))
+        ds = TokenDataset(tokens, self.seq_len, seed=self.seed)
+
+        step = start
+        epoch = 0
+        loss = None
+        while step < self.steps and not self._stop.is_set():
+            progressed = False
+            for batch in ds.batches(self.batch_size, epoch):
+                if step >= self.steps or self._stop.is_set():
+                    break
+                state, metrics = step_fn(state, jnp.asarray(batch))
+                step += 1
+                progressed = True
+                loss = float(metrics["loss"])
+                self._set(step=step, loss=loss)
+                if step == start + 1:
+                    self._set(first_loss=loss)
+                if self.checkpoint_every and \
+                        step % self.checkpoint_every == 0:
+                    v = save_train_state(self.store, self.name, state)
+                    self._set(checkpoint_version=v)
+            epoch += 1
+            if not progressed:
+                raise ValueError(
+                    f"corpus {self.corpus!r} yields no "
+                    f"[{self.batch_size}, {self.seq_len + 1}] batches")
+
+        v = save_train_state(self.store, self.name, state)
+        if self._stop.is_set() and step < self.steps:
+            self._set(checkpoint_version=v, stopped=True)
+            return
+        served = save_lm(self.store, self.name, model, state.params)
+        self._set(checkpoint_version=v, served_version=served, done=True)

@@ -14,9 +14,10 @@ torch.hub shipped, reloaded per task — `alexnet_resnet.py:17-22`).
 
 Representation: a params-shaped pytree where each quantized leaf is a
 `QTensor` (int8 values + f32 per-channel scale, a registered pytree node)
-and every other leaf (biases, norms, embeddings below the size floor) stays
-untouched. `dequantize_tree` restores a plain params tree — `tree.apply`
-sees exactly the structure it was trained with.
+and every other leaf (biases, norm scales — anything with ndim ≤ 1) stays
+untouched; pass a custom ``should_quantize`` to exempt more (e.g. keep
+embeddings full precision). `dequantize_tree` restores a plain params tree
+— `module.apply` sees exactly the structure it was trained with.
 """
 from __future__ import annotations
 

@@ -12,6 +12,15 @@ remote CLI can run the shell's commands against any node:
                                shell's `inference` verb, C11)
   query_done / results       — poll completion and fetch accumulated records
                                (the master's c4 view, C9/C12)
+  stats / grep               — remote c1/c2 percentiles; distributed log grep
+  generate                   — one-shot batch decode of a store-persisted LM
+  lm_serve/lm_submit/lm_poll/lm_stop
+                             — continuous-batching decode pool per LM
+                               (engine/serve_lm.py via serve/lm_pool.py)
+  train_start/train_status/train_stop
+                             — background cluster training jobs
+                               (engine/train_job.py; checkpoints + servable
+                               LM published into the replicated store)
 
 One request/one reply on the existing node transport; `comm.net.oneshot_call`
 is the matching client side (no listener needed).
@@ -32,9 +41,28 @@ SERVICE = "control"
 
 class ControlService:
     def __init__(self, node: "Node") -> None:
+        import threading
+
         self.node = node
         self._lms: dict = {}          # name -> (model, params), loaded once
+        self._lm_loops: dict = {}     # name -> LMServingLoop (continuous)
+        self._train_jobs: dict = {}   # name -> LMTrainJob
+        # transports run one handler thread per connection: registry
+        # check-then-act must be atomic or two concurrent lm_serve/
+        # train_start calls each spawn a loop and one leaks unjoinable
+        self._reg_lock = threading.Lock()
         node.transport.serve(SERVICE, self._handle)
+
+    def close(self) -> None:
+        with self._reg_lock:
+            loops = list(self._lm_loops.values())
+            self._lm_loops.clear()
+            jobs = list(self._train_jobs.values())
+            self._train_jobs.clear()
+        for loop in loops:
+            loop.stop()
+        for job in jobs:
+            job.stop()
 
     def _handle(self, service: str, msg: Message) -> Message:
         try:
@@ -143,4 +171,95 @@ class ControlService:
                            temperature=temperature,
                            top_p=float(p.get("top_p", 1.0)), **kw)
             return {"tokens": [[int(t) for t in row] for row in out]}
+        if verb == "lm_serve":
+            # continuous-batching serving of a store-persisted LM: a decode
+            # pool with `slots` rows; requests stream in via lm_submit and
+            # complete independently (engine/serve_lm.py)
+            from idunno_tpu.engine.generate import load_lm
+            from idunno_tpu.engine.serve_lm import DecodeServer
+            from idunno_tpu.serve.lm_pool import LMServingLoop
+
+            name = p["name"]
+            with self._reg_lock:
+                if name in self._lm_loops:
+                    if not p.get("reload"):
+                        return {"already": True}
+                    self._lm_loops.pop(name).stop()
+                model, params = load_lm(node.store, name)
+                server = DecodeServer(
+                    model, params,
+                    slots=int(p.get("slots", 4)),
+                    prompt_len=int(p["prompt_len"]),
+                    max_len=int(p["max_len"]),
+                    decode_steps=int(p.get("decode_steps", 1)),
+                    quantize=p.get("quantize", "none"))
+                self._lm_loops[name] = LMServingLoop(
+                    server, name=f"{node.host}-{name}")
+            return {"slots": server.slots}
+        if verb == "lm_submit":
+            rid = self._lm_loop(p["name"]).submit(
+                [int(t) for t in p["prompt"]], int(p["max_new"]))
+            return {"id": rid}
+        if verb == "lm_poll":
+            loop = self._lm_loop(p["name"])
+            out = {"completions": [
+                {"id": c.id, "tokens": c.tokens, "prompt_len": c.prompt_len}
+                for c in loop.poll()]}
+            errs = loop.errors()
+            if errs:
+                out["errors"] = errs
+            return out
+        if verb == "lm_stop":
+            with self._reg_lock:
+                loop = self._lm_loops.pop(p["name"], None)
+            if loop is not None:
+                loop.stop()
+            return {"stopped": loop is not None}
+        if verb == "train_start":
+            # cluster training job: corpus from the replicated store,
+            # periodic TrainState checkpoints back into it, final servable
+            # LM published for lm_serve/generate (engine/train_job.py)
+            from idunno_tpu.engine.train_job import LMTrainJob
+
+            name = p["name"]
+            with self._reg_lock:
+                existing = self._train_jobs.get(name)
+                if existing is not None:
+                    st = existing.status()
+                    if not (st["done"] or st["stopped"] or st["error"]):
+                        raise ValueError(f"training job {name!r} already "
+                                         "running (train_stop it first)")
+                self._train_jobs[name] = LMTrainJob(
+                    node.store, name,
+                    corpus=p["corpus"],
+                    model_config=dict(p["model"]),
+                    steps=int(p["steps"]),
+                    batch_size=int(p.get("batch_size", 8)),
+                    seq_len=int(p.get("seq_len", 32)),
+                    lr=float(p.get("lr", 1e-2)),
+                    checkpoint_every=int(p.get("checkpoint_every", 50)),
+                    seed=int(p.get("seed", 0)),
+                    resume=bool(p.get("resume", False)))
+            return {"started": True}
+        if verb == "train_status":
+            job = self._train_jobs.get(p["name"])
+            if job is None:
+                raise ValueError(f"no training job {p['name']!r}")
+            return job.status()
+        if verb == "train_stop":
+            job = self._train_jobs.get(p["name"])
+            if job is None:
+                return {"stopped": False}
+            job.stop()
+            # "stopped" = the stop verb found+stopped a job; the job's own
+            # lifecycle flags live under "status" (its 'stopped' field is
+            # False when the job had already finished)
+            return {"stopped": True, "status": job.status()}
         raise ValueError(f"unknown control verb {verb!r}")
+
+    def _lm_loop(self, name: str):
+        loop = self._lm_loops.get(name)
+        if loop is None:
+            raise ValueError(f"no lm_serve pool for {name!r}; "
+                             "call lm_serve first")
+        return loop

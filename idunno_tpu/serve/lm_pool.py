@@ -1,0 +1,99 @@
+"""Thread-owned serving loop around `engine.serve_lm.DecodeServer`.
+
+`DecodeServer` is a single-threaded object (device state + host bookkeeping
+mutate together); the cluster runtime needs submissions and polls arriving
+from RPC handler threads while a dedicated thread drives the decode loop.
+This wrapper gives the server exactly one driving thread and puts a lock
+between it and the RPC side: submissions land in a host-side inbox the loop
+drains, completions accumulate in a host-side outbox polls swap out.
+
+The loop sleeps on an event while idle (no busy-spin — the reference's
+`monitor_query_rate` burns a core, `mp4_machinelearning.py:1016-1036`) and
+wakes on submit or stop.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from idunno_tpu.engine.serve_lm import Completion, DecodeServer
+
+
+class LMServingLoop:
+    """One background thread driving one DecodeServer; all public methods
+    are safe to call from any thread."""
+
+    def __init__(self, server: DecodeServer, name: str = "lm") -> None:
+        self.server = server
+        self._lock = threading.Lock()
+        self._inbox: list[tuple[int, list[int], int]] = []  # (id, toks, new)
+        self._outbox: list[Completion] = []
+        self._next_id = 0
+        self._id_map: dict[int, int] = {}     # server-side id → public id
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._errors: list[str] = []
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"{name}-decode-loop")
+        self._thread.start()
+
+    # -- any thread -------------------------------------------------------
+
+    def submit(self, tokens: list[int], max_new: int) -> int:
+        """Validate + queue a prompt; returns the public request id."""
+        # validate eagerly on the caller's thread so the RPC gets the error
+        # (the loop thread has nowhere to raise to)
+        self.server.validate(tokens, max_new)
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._inbox.append((rid, list(tokens), max_new))
+        self._wake.set()
+        return rid
+
+    def poll(self) -> list[Completion]:
+        """Completions since the last poll (public ids)."""
+        with self._lock:
+            out, self._outbox = self._outbox, []
+            return out
+
+    def errors(self) -> list[str]:
+        """Errors since the last call (drained, like `poll`)."""
+        with self._lock:
+            out, self._errors = self._errors, []
+            return out
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=timeout)
+
+    # -- loop thread ------------------------------------------------------
+
+    def _drain_inbox(self) -> None:
+        with self._lock:
+            batch, self._inbox = self._inbox, []
+        for rid, tokens, max_new in batch:
+            sid = self.server.submit(tokens, max_new)
+            self._id_map[sid] = rid
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._drain_inbox()
+                live = self.server.step()
+                done = self.server.poll()
+            except Exception as e:  # noqa: BLE001 - loop must stay alive
+                with self._lock:
+                    if len(self._errors) < 100:   # bounded between drains
+                        self._errors.append(f"{type(e).__name__}: {e}")
+                live, done = 0, []
+            if done:
+                with self._lock:
+                    for c in done:
+                        self._outbox.append(Completion(
+                            id=self._id_map.pop(c.id, c.id),
+                            tokens=c.tokens, prompt_len=c.prompt_len))
+            if live == 0:
+                self._wake.wait(timeout=0.5)
+                self._wake.clear()

@@ -93,6 +93,7 @@ class Node:
 
     def stop(self) -> None:
         self._stop.set()
+        self.control.close()          # continuous-batching decode loops
         for t in self._threads:
             t.join(timeout=2.0)
         self.transport.close()

@@ -120,6 +120,214 @@ def test_lm_served_through_cluster_control(stores, tmp_path):
         save_lm(stores["n0"], "moe", moe, state.params)
 
 
+def test_continuous_batching_served_over_control_rpc(stores):
+    """lm_serve / lm_submit / lm_poll: a store-persisted LM served through
+    the node's continuous-batching decode pool, with submissions arriving
+    from several RPC threads at once — every completion must match a
+    standalone `generate` of its own prompt."""
+    import threading
+    import time
+
+    from idunno_tpu.comm.message import Message
+    from idunno_tpu.engine.generate import save_lm
+    from idunno_tpu.serve.control import ControlService
+    from idunno_tpu.utils.types import MessageType
+
+    model = TransformerLM(vocab=32, dim=32, depth=2, num_heads=4)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    save_lm(stores["n0"], "pool", model, params)
+
+    node = type("NodeStub", (), {})()
+    node.host, node.store = "n2", stores["n2"]
+    node.transport = stores["n2"].transport
+    ctl = ControlService(node)
+
+    def call(payload):
+        out = ctl._handle("control", Message(
+            MessageType.INFERENCE, "client", payload))
+        return out
+
+    try:
+        out = call({"verb": "lm_submit", "name": "pool",
+                    "prompt": [1], "max_new": 1})
+        assert out.type is MessageType.ERROR          # pool not started yet
+        assert "lm_serve" in out.payload["error"]
+
+        out = call({"verb": "lm_serve", "name": "pool", "slots": 2,
+                    "prompt_len": 6, "max_len": 20})
+        assert out.type is MessageType.ACK and out.payload["slots"] == 2
+
+        rng = np.random.default_rng(3)
+        prompts = [[int(t) for t in rng.integers(0, 32, size=n)]
+                   for n in (3, 6, 2, 4, 5)]
+        ids: dict[int, list[int]] = {}
+        lock = threading.Lock()
+
+        def submit(prompt):
+            out = call({"verb": "lm_submit", "name": "pool",
+                        "prompt": prompt, "max_new": 8})
+            assert out.type is MessageType.ACK, out.payload
+            with lock:
+                ids[out.payload["id"]] = prompt
+
+        threads = [threading.Thread(target=submit, args=(p,))
+                   for p in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        done = {}
+        deadline = time.time() + 60.0
+        while time.time() < deadline and len(done) < len(prompts):
+            out = call({"verb": "lm_poll", "name": "pool"})
+            assert out.type is MessageType.ACK, out.payload
+            assert "errors" not in out.payload, out.payload
+            for c in out.payload["completions"]:
+                done[c["id"]] = c
+            time.sleep(0.05)
+        assert len(done) == len(prompts), f"only {len(done)} completed"
+
+        for rid, c in done.items():
+            prompt = ids[rid]
+            assert c["prompt_len"] == len(prompt)
+            want = generate(model, params,
+                            jnp.asarray([prompt], jnp.int32),
+                            prompt_len=len(prompt), max_new=8)
+            assert c["tokens"] == [int(t) for t in np.asarray(want[0])], rid
+
+        # oversized prompt: validation error surfaces on the RPC
+        out = call({"verb": "lm_submit", "name": "pool",
+                    "prompt": list(range(9)), "max_new": 1})
+        assert out.type is MessageType.ERROR
+        assert "bucket" in out.payload["error"]
+
+        out = call({"verb": "lm_stop", "name": "pool"})
+        assert out.type is MessageType.ACK and out.payload["stopped"]
+    finally:
+        ctl.close()
+
+
+def test_train_job_over_rpc_then_serve(stores):
+    """The whole LM story with NO out-of-band steps: publish a corpus into
+    the store → train_start over the control RPC (background job,
+    checkpoints into the store) → train_status until done (loss improved)
+    → lm_serve the published model → lm_submit/lm_poll completions match a
+    local generate from the job's own weights."""
+    import time
+
+    from idunno_tpu.comm.message import Message
+    from idunno_tpu.engine.data_lm import save_corpus
+    from idunno_tpu.engine.generate import load_lm
+    from idunno_tpu.serve.control import ControlService
+    from idunno_tpu.utils.types import MessageType
+
+    rng = np.random.default_rng(0)
+    # a learnable corpus: short periodic pattern, not uniform noise
+    pattern = rng.integers(0, 32, size=17)
+    save_corpus(stores["n0"], "corpus/tiny",
+                np.tile(pattern, 400).astype(np.int32))
+
+    node = type("NodeStub", (), {})()
+    node.host, node.store = "n1", stores["n1"]
+    node.transport = stores["n1"].transport
+    ctl = ControlService(node)
+
+    def call(payload):
+        return ctl._handle("control", Message(
+            MessageType.INFERENCE, "client", payload))
+
+    try:
+        out = call({"verb": "train_start", "name": "rpclm",
+                    "corpus": "corpus/tiny",
+                    "model": {"vocab": 32, "dim": 32, "depth": 1,
+                              "num_heads": 4},
+                    "steps": 12, "batch_size": 4, "seq_len": 16,
+                    "checkpoint_every": 5, "lr": 1e-2})
+        assert out.type is MessageType.ACK, out.payload
+
+        st = {}
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            out = call({"verb": "train_status", "name": "rpclm"})
+            assert out.type is MessageType.ACK, out.payload
+            st = out.payload
+            assert st["error"] is None, st
+            if st["done"]:
+                break
+            time.sleep(0.1)
+        assert st.get("done"), f"train job never finished: {st}"
+        assert st["step"] == 12
+        assert st["checkpoint_version"] >= 2      # periodic + final
+        assert st["served_version"] is not None
+        assert st["loss"] < st["first_loss"]      # it learned something
+
+        # the published LM is servable: continuous batching pool over RPC
+        out = call({"verb": "lm_serve", "name": "rpclm", "slots": 2,
+                    "prompt_len": 4, "max_len": 12})
+        assert out.type is MessageType.ACK, out.payload
+        prompt = [int(t) for t in pattern[:4]]
+        out = call({"verb": "lm_submit", "name": "rpclm",
+                    "prompt": prompt, "max_new": 6})
+        assert out.type is MessageType.ACK, out.payload
+        rid = out.payload["id"]
+        got = None
+        deadline = time.time() + 60.0
+        while time.time() < deadline and got is None:
+            out = call({"verb": "lm_poll", "name": "rpclm"})
+            for c in out.payload["completions"]:
+                if c["id"] == rid:
+                    got = c
+            time.sleep(0.05)
+        assert got is not None, "completion never arrived"
+
+        model, params = load_lm(stores["n2"], "rpclm")
+        want = generate(model, params, jnp.asarray([prompt], jnp.int32),
+                        prompt_len=4, max_new=6)
+        assert got["tokens"] == [int(t) for t in np.asarray(want[0])]
+    finally:
+        ctl.close()
+
+
+def test_train_job_stop_and_resume(stores):
+    """train_stop checkpoints and exits; a resume=True restart continues
+    from the checkpointed step, not from scratch."""
+    import time
+
+    from idunno_tpu.engine.data_lm import save_corpus
+    from idunno_tpu.engine.train_job import LMTrainJob
+
+    rng = np.random.default_rng(1)
+    save_corpus(stores["n0"], "corpus/stop",
+                rng.integers(0, 32, size=4000).astype(np.int32))
+    cfg = {"vocab": 32, "dim": 16, "depth": 1, "num_heads": 2}
+
+    job = LMTrainJob(stores["n1"], "stoplm", corpus="corpus/stop",
+                     model_config=cfg, steps=10_000, batch_size=4,
+                     seq_len=16, checkpoint_every=3)
+    deadline = time.time() + 120.0
+    while time.time() < deadline and job.status()["step"] < 4:
+        time.sleep(0.05)
+    assert job.status()["step"] >= 4, job.status()
+    job.stop()
+    st = job.status()
+    assert st["stopped"] and not st["done"] and st["error"] is None, st
+    assert st["checkpoint_version"] is not None
+    stopped_at = st["step"]
+
+    resumed = LMTrainJob(stores["n2"], "stoplm", corpus="corpus/stop",
+                         model_config=cfg, steps=stopped_at + 3,
+                         batch_size=4, seq_len=16, checkpoint_every=100,
+                         resume=True)
+    resumed.join(timeout=120.0)
+    st = resumed.status()
+    assert st["error"] is None, st
+    assert st["done"], st
+    assert st["start_step"] == stopped_at     # continued, didn't restart
+    assert st["step"] == stopped_at + 3
+
+
 def test_training_resume_is_exact(stores):
     """Full TrainState checkpoint/resume: train 5 steps, checkpoint, train
     5 more — a resume from the checkpoint on ANOTHER node must land on

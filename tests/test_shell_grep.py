@@ -87,6 +87,58 @@ def test_shell_full_command_surface(nodes, tmp_path):
     assert "joined" in sh.dispatch("join")
 
 
+def test_shell_lm_and_train_commands(nodes):
+    """The train/lm-serve/lm-submit/lm-poll shell verbs drive the node's
+    control service end-to-end: train a tiny LM from a store corpus, serve
+    it through the continuous-batching pool, fetch the completion."""
+    import numpy as np
+
+    from idunno_tpu.engine.data_lm import save_corpus
+
+    cfg, net, nodes_d, tp = nodes
+    outputs = []
+    sh = Shell(nodes_d["n1"], out=outputs.append)
+    try:
+        # usage/validation surfaces
+        assert "usage" in sh.dispatch("train onlyname")
+        assert "key=value" in sh.dispatch("train a b 3 junk")
+        assert "unknown train option" in sh.dispatch("train a b 3 zz=1")
+        assert "error" in sh.dispatch("train-status nosuch")
+        assert "no training job" in sh.dispatch("train-stop nosuch")
+        assert "no serving pool" in sh.dispatch("lm-stop nosuch")
+
+        pattern = np.random.default_rng(0).integers(0, 16, size=13)
+        save_corpus(nodes_d["n0"].store, "corpus/shell",
+                    np.tile(pattern, 300).astype(np.int32))
+        assert "started" in sh.dispatch(
+            "train shelllm corpus/shell 6 vocab=16 dim=16 depth=1 "
+            "num_heads=2 batch_size=4 seq_len=8 checkpoint_every=3")
+        deadline = time.time() + 120.0
+        status = ""
+        while time.time() < deadline and "done" not in status:
+            status = sh.dispatch("train-status shelllm")
+            assert "ERROR" not in status, status
+            time.sleep(0.1)
+        assert "done" in status and "step=6" in status
+
+        assert "2 slots" in sh.dispatch(
+            "lm-serve shelllm 4 10 slots=2")
+        assert "already serving" in sh.dispatch("lm-serve shelllm 4 10")
+        assert "request 0 queued" in sh.dispatch(
+            "lm-submit shelllm 4 3 1 2")
+        deadline = time.time() + 60.0
+        text = ""
+        while time.time() < deadline and "#0:" not in text:
+            text = sh.dispatch("lm-poll shelllm")
+            time.sleep(0.05)
+        assert "#0:" in text and "prompt_len=3" in text
+        toks = text.split(":")[1].split("(")[0].split()
+        assert len(toks) == 3 + 4                  # prompt + max_new
+        assert "stopped" in sh.dispatch("lm-stop shelllm")
+    finally:
+        nodes_d["n1"].control.close()
+
+
 def test_distributed_grep(nodes):
     cfg, net, nodes_d, tp = nodes
     # each node logs something distinctive through its own logger
