@@ -15,7 +15,8 @@ The full reference command surface (`README.md:31-50`,
   10 ls <sdfs>                     hosts storing a file
   11 store                         files stored on this host
   12 get-versions <sdfs> <k> <local>  last k versions, delimited
-  13 inference <start> <end> <model>  submit a query range
+  13 inference <start> <end> <model> [dataset]  submit a query range
+       (dataset: local dir or store://<name> published via the file layer)
   c1 query rates + finished counts per model
   c2 processing-time stats of a query per model
   c4 dump all results to result.txt
@@ -47,7 +48,8 @@ HELP = """\
   10 ls <sdfs>                     hosts storing a file
   11 store                         files stored on this host
   12 get-versions <sdfs> <k> <local>  last k versions, delimited
-  13 inference <start> <end> <model>  submit a query range
+  13 inference <start> <end> <model> [dataset]  submit a query range
+       (dataset: local dir or store://<name> published via the file layer)
   c1 query rates + finished counts per model
   c2 processing-time stats of a query per model
   c4 [path] dump all results to result.txt
@@ -216,14 +218,17 @@ class Shell:
     # -- inference --------------------------------------------------------
 
     def cmd_inference(self, args: list[str]) -> str:
-        if len(args) != 3:
-            return "usage: inference <start> <end> <model>"
+        if len(args) not in (3, 4):
+            return ("usage: inference <start> <end> <model> [dataset] "
+                    "(dataset may be a local dir or store://<name>)")
         start, end, model = int(args[0]), int(args[1]), args[2]
+        dataset = args[3] if len(args) == 4 else None
         if self.async_inference:
             # the reference runs the paced query pump in a thread (`:1200-1205`)
             def pump():
                 try:
-                    self.node.inference.inference(model, start, end)
+                    self.node.inference.inference(model, start, end,
+                                                  dataset=dataset)
                 except Exception as e:
                     self.out(f"inference pump {model} [{start}, {end}] "
                              f"aborted: {e}")
@@ -231,7 +236,8 @@ class Shell:
                              name=f"{self.node.host}-inference-pump").start()
             return (f"submitted inference {model} [{start}, {end}] "
                     f"(paced, 1 query / {self.node.config.query_interval_s:g} s)")
-        qnums = self.node.inference.inference(model, start, end, pace_s=0.0)
+        qnums = self.node.inference.inference(model, start, end, pace_s=0.0,
+                                              dataset=dataset)
         return f"submitted inference {model} [{start}, {end}] queries={qnums}"
 
     # -- stats ------------------------------------------------------------

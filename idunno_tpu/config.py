@@ -62,6 +62,10 @@ class ClusterConfig:
     # straggler threshold 30 s `:812`).
     rate_factor: int = 10
     straggler_timeout_s: float = 30.0
+    # re-dispatch cap per task: past this many moves the task is marked
+    # permanently FAILED and surfaced via query_failed, instead of bouncing
+    # a deterministically-failing job between workers forever
+    max_task_retries: int = 3
 
     # Query pump (reference: batch 400, 1 query / 20 s,
     # `mp4_machinelearning.py:45-46, 1104-1109`).

@@ -20,6 +20,7 @@ The public contract matches the reference: ``infer(model, start, end)`` →
 """
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -91,6 +92,7 @@ class InferenceEngine:
         # SDFS-dataset-distribution story applied to model weights
         self.store = store
         self._models: dict[str, _LoadedModel] = {}
+        self._store_datasets: dict[str, Any] = {}
         self._load_lock = threading.Lock()
         self._pallas_ok: bool | None = None   # resolved on first load
         self.categories = imagenet_categories()
@@ -464,9 +466,40 @@ class InferenceEngine:
     def _load_chunk(self, root: str | None, start: int,
                     end: int) -> tuple[list[str], np.ndarray]:
         """One device-batch worth of host decode (seam for tests to inject
-        decode cost)."""
+        decode cost). ``root="store://<name>"`` resolves against a dataset
+        published into the replicated store (`engine.data_store`) with a
+        host-local shard cache — the reference's SDFS-staged dataset flow
+        (`README.md:37-38`)."""
+        from idunno_tpu.engine.data_store import STORE_SCHEME
+
+        if root and root.startswith(STORE_SCHEME):
+            return self._store_dataset(root[len(STORE_SCHEME):]).load_range(
+                start, end)
         return data_lib.load_range(root, start, end,
                                    size=self.config.resize_size)
+
+    def _store_dataset(self, name: str):
+        """One cached `StoreDataset` per name (meta fetched once; shards
+        staged on demand into the store's host-local data dir)."""
+        from idunno_tpu.engine.data_store import StoreDataset
+
+        if self.store is None:
+            raise ValueError(
+                f"dataset 'store://{name}' needs an engine with a store "
+                "attached (this engine has none)")
+        with self._load_lock:
+            ds = self._store_datasets.get(name)
+            if ds is None:
+                cache = os.path.join(self.store.local.data_dir,
+                                     ".dataset_cache", name)
+                ds = StoreDataset(self.store, name, cache_dir=cache)
+                if ds.size != self.config.resize_size:
+                    raise ValueError(
+                        f"dataset 'store://{name}' was published at "
+                        f"{ds.size}x{ds.size} but this engine stages at "
+                        f"{self.config.resize_size}x{self.config.resize_size}")
+                self._store_datasets[name] = ds
+            return ds
 
     def infer(self, name: str, start: int, end: int,
               dataset_root: str | None = None) -> QueryResult:

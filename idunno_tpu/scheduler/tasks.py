@@ -15,6 +15,7 @@ from typing import Any
 
 WORKING = "w"        # reference's 'w' / 'f' task states (`:529-533, 645-652`)
 FINISHED = "f"
+FAILED = "x"         # beyond reference: permanently failed (retry cap hit)
 
 
 @dataclass
@@ -30,6 +31,10 @@ class Task:
     # the query's dataset root travels WITH the task so failure/straggler
     # re-dispatch (and post-failover resumption) reruns it on the same data
     dataset: str | None = None
+    # times this task was moved to another worker (failure or straggler);
+    # the straggler monitor caps this so a deterministically-failing job
+    # can't re-dispatch forever
+    retries: int = 0
 
     @property
     def n_items(self) -> int:
@@ -39,7 +44,7 @@ class Task:
         return {"model": self.model, "qnum": self.qnum, "worker": self.worker,
                 "start": self.start, "end": self.end, "state": self.state,
                 "t_assigned": self.t_assigned, "t_finished": self.t_finished,
-                "dataset": self.dataset}
+                "dataset": self.dataset, "retries": self.retries}
 
     @classmethod
     def from_wire(cls, d: dict[str, Any]) -> "Task":
@@ -47,7 +52,8 @@ class Task:
                    start=int(d["start"]), end=int(d["end"]), state=d["state"],
                    t_assigned=float(d["t_assigned"]),
                    t_finished=float(d["t_finished"]),
-                   dataset=d.get("dataset"))
+                   dataset=d.get("dataset"),
+                   retries=int(d.get("retries", 0)))
 
 
 class TaskBook:
@@ -70,6 +76,16 @@ class TaskBook:
         with self._lock:
             task.worker = new_worker
             task.t_assigned = now
+            task.retries += 1
+            return task
+
+    def mark_failed(self, task: Task, now: float) -> Task:
+        """Permanently fail a task (retry cap exhausted): the query will
+        never be 'done'; `query_failed` surfaces it to pollers instead of
+        letting them wait forever."""
+        with self._lock:
+            task.state = FAILED
+            task.t_finished = now
             return task
 
     def mark_finished(self, model: str, qnum: int, start: int, end: int,
@@ -94,6 +110,12 @@ class TaskBook:
         with self._lock:
             tasks = self._by_query.get((model, qnum), [])
             return bool(tasks) and all(t.state == FINISHED for t in tasks)
+
+    def query_failed(self, model: str, qnum: int) -> bool:
+        """True when any of the query's tasks is permanently failed."""
+        with self._lock:
+            return any(t.state == FAILED
+                       for t in self._by_query.get((model, qnum), []))
 
     def tasks_on_worker(self, worker: str) -> list[Task]:
         """The reference's ``working_vm_set`` view (`:140-144`)."""

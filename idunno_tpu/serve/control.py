@@ -39,6 +39,11 @@ if TYPE_CHECKING:                                    # pragma: no cover
 SERVICE = "control"
 
 
+class _Starting:
+    """Registry placeholder while an `lm_serve` builds its pool outside the
+    lock — reserves the name without blocking other verbs."""
+
+
 class ControlService:
     def __init__(self, node: "Node") -> None:
         import threading
@@ -60,7 +65,8 @@ class ControlService:
             jobs = list(self._train_jobs.values())
             self._train_jobs.clear()
         for loop in loops:
-            loop.stop()
+            if not isinstance(loop, _Starting):
+                loop.stop()
         for job in jobs:
             job.stop()
 
@@ -106,11 +112,14 @@ class ControlService:
         if verb == "inference":
             qnums = node.inference.inference(
                 p["model"], int(p["start"]), int(p["end"]),
-                pace_s=float(p.get("pace_s", 0.0)))
+                pace_s=float(p.get("pace_s", 0.0)),
+                dataset=p.get("dataset"))
             return {"qnums": qnums}
         if verb == "query_done":
             return {"done": node.inference.query_done(p["model"],
-                                                      int(p["qnum"]))}
+                                                      int(p["qnum"])),
+                    "failed": node.inference.query_failed(p["model"],
+                                                          int(p["qnum"]))}
         if verb == "results":
             recs = node.inference.results(p["model"], int(p["qnum"]))
             return {"records": [list(r) for r in recs],
@@ -180,11 +189,20 @@ class ControlService:
             from idunno_tpu.serve.lm_pool import LMServingLoop
 
             name = p["name"]
+            # only the registry check-then-act holds the lock; the heavy
+            # build (store fetch + device-state allocation) and the old
+            # loop's stop() run outside it, behind a reservation
+            # placeholder, so other verbs never stall behind a slow serve
+            placeholder = _Starting()
             with self._reg_lock:
-                if name in self._lm_loops:
-                    if not p.get("reload"):
-                        return {"already": True}
-                    self._lm_loops.pop(name).stop()
+                old = self._lm_loops.get(name)
+                if old is not None and (isinstance(old, _Starting)
+                                        or not p.get("reload")):
+                    return {"already": True}
+                self._lm_loops[name] = placeholder
+            try:
+                if old is not None:
+                    old.stop()
                 model, params = load_lm(node.store, name)
                 server = DecodeServer(
                     model, params,
@@ -193,9 +211,18 @@ class ControlService:
                     max_len=int(p["max_len"]),
                     decode_steps=int(p.get("decode_steps", 1)),
                     quantize=p.get("quantize", "none"))
-                self._lm_loops[name] = LMServingLoop(
-                    server, name=f"{node.host}-{name}")
-            return {"slots": server.slots}
+                loop = LMServingLoop(server, name=f"{node.host}-{name}")
+            except BaseException:
+                with self._reg_lock:
+                    if self._lm_loops.get(name) is placeholder:
+                        del self._lm_loops[name]
+                raise
+            with self._reg_lock:
+                if self._lm_loops.get(name) is placeholder:
+                    self._lm_loops[name] = loop
+                    return {"slots": server.slots}
+            loop.stop()               # lm_stop won the race mid-build
+            return {"stopped": True}
         if verb == "lm_submit":
             rid = self._lm_loop(p["name"]).submit(
                 [int(t) for t in p["prompt"]], int(p["max_new"]))
@@ -212,8 +239,10 @@ class ControlService:
         if verb == "lm_stop":
             with self._reg_lock:
                 loop = self._lm_loops.pop(p["name"], None)
-            if loop is not None:
+            if loop is not None and not isinstance(loop, _Starting):
                 loop.stop()
+            # popping a _Starting reservation makes the builder's final
+            # registry compare fail, so it stops its fresh loop itself
             return {"stopped": loop is not None}
         if verb == "train_start":
             # cluster training job: corpus from the replicated store,
@@ -258,8 +287,12 @@ class ControlService:
         raise ValueError(f"unknown control verb {verb!r}")
 
     def _lm_loop(self, name: str):
-        loop = self._lm_loops.get(name)
+        with self._reg_lock:
+            loop = self._lm_loops.get(name)
         if loop is None:
             raise ValueError(f"no lm_serve pool for {name!r}; "
                              "call lm_serve first")
+        if isinstance(loop, _Starting):
+            raise ValueError(f"lm_serve pool for {name!r} is still "
+                             "starting; retry shortly")
         return loop

@@ -123,17 +123,22 @@ class InferenceService:
                 return out
         raise InferenceServiceError(f"no reachable coordinator: {last}")
 
-    def submit_query(self, model: str, start: int, end: int) -> int:
-        """Submit one query range; returns the assigned query number."""
+    def submit_query(self, model: str, start: int, end: int,
+                     dataset: str | None = None) -> int:
+        """Submit one query range; returns the assigned query number.
+        ``dataset`` overrides this node's default root for the query —
+        e.g. ``store://<name>`` resolves against a dataset published into
+        the replicated store on every worker (`engine.data_store`)."""
         out = self._master_call(Message(
             MessageType.INFERENCE, self.host,
             {"model": model, "start": start, "end": end,
-             "dataset": self.dataset_root}))
+             "dataset": dataset or self.dataset_root}))
         return int(out.payload["qnum"])
 
     def inference(self, model: str, start: int, end: int,
                   pace_s: float | None = None,
-                  sleep: Callable[[float], None] = time.sleep) -> list[int]:
+                  sleep: Callable[[float], None] = time.sleep,
+                  dataset: str | None = None) -> list[int]:
         """The `inference <start> <end> <model>` verb: chunk the range into
         standard-batch queries, one submission per pacing interval
         (`Server.inference`, `:1104-1109`)."""
@@ -143,7 +148,8 @@ class InferenceService:
         cursor = start
         while cursor <= end:
             chunk_end = min(cursor + bs - 1, end)
-            qnums.append(self.submit_query(model, cursor, chunk_end))
+            qnums.append(self.submit_query(model, cursor, chunk_end,
+                                           dataset=dataset))
             cursor = chunk_end + 1
             if cursor <= end and pace > 0:
                 sleep(pace)
@@ -161,6 +167,11 @@ class InferenceService:
 
     def query_done(self, model: str, qnum: int) -> bool:
         return self.scheduler.book.query_done(model, qnum)
+
+    def query_failed(self, model: str, qnum: int) -> bool:
+        """True when part of the query permanently failed (retry cap):
+        waiting for `query_done` would block forever."""
+        return self.scheduler.book.query_failed(model, qnum)
 
     def models_seen(self) -> list[str]:
         """Models with at least one known query — the single source for the
@@ -296,12 +307,22 @@ class InferenceService:
 
     def monitor_stragglers_once(self) -> int:
         """Re-dispatch tasks stuck past the straggler timeout; returns how
-        many moved."""
+        many moved. A task past the retry cap is marked permanently FAILED
+        (deterministic failures must not bounce between workers forever);
+        pollers see it via `query_failed`."""
         if not self.membership.is_acting_master:
             return 0
         alive = self._eligible_workers()
         moved = 0
         for task in self.scheduler.stragglers():
+            if task.retries >= self.config.max_task_retries:
+                self.scheduler.book.mark_failed(task, self.clock())
+                import logging
+                logging.getLogger("idunno.serving").error(
+                    "task %s#%s [%s, %s] FAILED after %d re-dispatches "
+                    "(last worker %s)", task.model, task.qnum, task.start,
+                    task.end, task.retries, task.worker)
+                continue
             self._dispatch(self.scheduler.redispatch_straggler(task, alive))
             moved += 1
         return moved
@@ -332,8 +353,21 @@ class InferenceService:
 
     def _execute(self, job: Job) -> None:
         t0 = self.clock()
-        res = self.engine.infer(job.model, job.start, job.end,
-                                dataset_root=job.dataset or self.dataset_root)
+        try:
+            res = self.engine.infer(
+                job.model, job.start, job.end,
+                dataset_root=job.dataset or self.dataset_root)
+        except Exception as e:  # noqa: BLE001 - a bad job must not kill
+            # the worker: an engine failure (unfetchable dataset, bad model
+            # name, device error) is logged and the task is left unfinished
+            # — the master's straggler monitor re-dispatches it elsewhere
+            # while this worker keeps serving its queue.
+            import logging
+            logging.getLogger("idunno.serving").warning(
+                "job %s#%s [%s, %s] failed on %s (%s: %s); leaving for "
+                "straggler re-dispatch", job.model, job.qnum, job.start,
+                job.end, self.host, type(e).__name__, e)
+            return
         elapsed = getattr(res, "elapsed_s", None)
         if elapsed is None:
             elapsed = self.clock() - t0

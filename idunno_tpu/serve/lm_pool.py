@@ -40,11 +40,18 @@ class LMServingLoop:
     # -- any thread -------------------------------------------------------
 
     def submit(self, tokens: list[int], max_new: int) -> int:
-        """Validate + queue a prompt; returns the public request id."""
+        """Validate + queue a prompt; returns the public request id.
+        Raises once the pool is stopped — a submit racing `stop()` must
+        error loudly, not return an id that never completes."""
         # validate eagerly on the caller's thread so the RPC gets the error
         # (the loop thread has nowhere to raise to)
         self.server.validate(tokens, max_new)
         with self._lock:
+            # checked under the lock: stop() sets the flag BEFORE its own
+            # locked inbox drain, so an append here either precedes the
+            # drain (request errored there) or sees the flag (raises here)
+            if self._stop.is_set():
+                raise ValueError("serving pool is stopped")
             rid = self._next_id
             self._next_id += 1
             self._inbox.append((rid, list(tokens), max_new))
@@ -67,6 +74,12 @@ class LMServingLoop:
         self._stop.set()
         self._wake.set()
         self._thread.join(timeout=timeout)
+        with self._lock:          # fail anything the loop never drained
+            dropped, self._inbox = self._inbox, []
+            for rid, _tokens, _new in dropped:
+                if len(self._errors) < 100:
+                    self._errors.append(
+                        f"request {rid} dropped: pool stopped")
 
     # -- loop thread ------------------------------------------------------
 

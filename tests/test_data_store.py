@@ -1,0 +1,174 @@
+"""Dataset staging through the replicated store (`engine/data_store.py`) —
+the reference's put-dataset-over-SDFS-then-infer flow (`README.md:37-38`)
+made native: publish once, workers stage shards on demand into a host-local
+cache, the engine resolves ``store://<name>`` dataset roots against it.
+"""
+import numpy as np
+import pytest
+
+from idunno_tpu.engine.data_store import (
+    StoreDataset, dataset_shard_name, publish_images)
+from tests.test_engine_overlap import _store_cluster
+
+N, SIZE = 70, 64
+
+
+@pytest.fixture
+def dataset(tmp_path):
+    stores = _store_cluster(tmp_path, hosts=("n0", "n1", "n2"))
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(N, SIZE, SIZE, 3), dtype=np.uint8)
+    meta = publish_images(stores["n0"], "tiny", images, shard_size=16)
+    assert meta == {"n": N, "size": SIZE, "shard_size": 16, "n_shards": 5}
+    return stores, images, tmp_path
+
+
+def test_publish_load_roundtrip_across_nodes(dataset):
+    stores, images, tmp_path = dataset
+    ds = StoreDataset(stores["n1"], "tiny",
+                      cache_dir=str(tmp_path / "cache_n1"))
+    # a range crossing three shard boundaries, exact content
+    names, got = ds.load_range(10, 55)
+    assert names[0] == "test_10.JPEG" and names[-1] == "test_55.JPEG"
+    np.testing.assert_array_equal(got, images[10:56])
+    # the ragged final shard
+    _, tail = ds.load_range(64, N - 1)
+    np.testing.assert_array_equal(tail, images[64:])
+    # out-of-range indices get deterministic placeholders, count exact
+    names, over = ds.load_range(N - 2, N + 1)
+    assert len(names) == 4 and len(over) == 4
+    np.testing.assert_array_equal(over[:2], images[N - 2:])
+
+
+def test_local_cache_survives_store_loss(dataset):
+    stores, images, tmp_path = dataset
+    cache = str(tmp_path / "cache_warm")
+    ds = StoreDataset(stores["n2"], "tiny", cache_dir=cache)
+    ds.load_range(0, N - 1)                      # warm every shard
+
+    # same host restarts its reader: shards come from local disk even when
+    # the store can no longer serve them (the staging guarantee)
+    ds2 = StoreDataset(stores["n2"], "tiny", cache_dir=cache)
+
+    def boom(name, version=None):
+        raise AssertionError(f"unexpected store fetch for {name}")
+    ds2.store = type("S", (), {"get_bytes": staticmethod(boom)})()
+    _, got = ds2.load_range(5, 40)
+    np.testing.assert_array_equal(got, images[5:41])
+
+
+def test_republish_invalidates_cache(dataset):
+    stores, images, tmp_path = dataset
+    cache = str(tmp_path / "cache_v")
+    ds = StoreDataset(stores["n1"], "tiny", cache_dir=cache)
+    ds.load_range(0, 15)
+    flipped = images[::-1].copy()
+    publish_images(stores["n0"], "tiny", flipped, shard_size=16)
+    ds2 = StoreDataset(stores["n1"], "tiny", cache_dir=cache)
+    _, got = ds2.load_range(0, 15)
+    np.testing.assert_array_equal(got, flipped[:16])  # not the stale cache
+
+
+def test_engine_serves_store_dataset(dataset, eight_devices):
+    from idunno_tpu.config import EngineConfig
+    from idunno_tpu.engine.inference import InferenceEngine
+    from idunno_tpu.parallel.mesh import local_mesh
+
+    stores, images, tmp_path = dataset
+    eng = InferenceEngine(
+        EngineConfig(batch_size=16, image_size=SIZE, resize_size=SIZE),
+        mesh=local_mesh(), pretrained=False, store=stores["n1"])
+    res = eng.infer("alexnet", 3, 40, dataset_root="store://tiny")
+    assert len(res.records) == 38
+    assert res.records[0][0] == "test_3.JPEG"
+
+    # classifications must equal the direct forward over the same pixels
+    idx, _ = eng.infer_batch("alexnet", images[3:41])
+    want = [eng.categories[int(i)] for i in idx]
+    assert [r[1] for r in res.records] == want
+
+    # no store attached → loud error
+    loner = InferenceEngine(
+        EngineConfig(batch_size=16, image_size=SIZE, resize_size=SIZE),
+        mesh=local_mesh(), pretrained=False)
+    with pytest.raises(ValueError, match="store attached"):
+        loner.infer("alexnet", 0, 3, dataset_root="store://tiny")
+
+    # size mismatch → loud error, not silent resize
+    other = InferenceEngine(
+        EngineConfig(batch_size=16, image_size=32, resize_size=32),
+        mesh=local_mesh(), pretrained=False, store=stores["n2"])
+    with pytest.raises(ValueError, match="published at"):
+        other.infer("alexnet", 0, 3, dataset_root="store://tiny")
+
+
+def test_cluster_serves_store_dataset_end_to_end(tmp_path, eight_devices):
+    """The reference's full journey (`README.md:37-44`): stage the dataset
+    through the file layer, then `inference <start> <end> <model>` — here
+    in one step: publish into the store, submit with dataset=store://tiny,
+    and every worker's REAL engine stages shards on demand and classifies
+    identically (same seed → same weights → same top-1)."""
+    import time
+
+    from idunno_tpu.comm.inproc import InProcNetwork
+    from idunno_tpu.config import ClusterConfig, EngineConfig
+    from idunno_tpu.serve.node import Node
+
+    cfg = ClusterConfig(hosts=("n0", "n1"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0",
+                        replication_factor=2, query_batch_size=32,
+                        query_interval_s=0.0, ping_interval_s=0.05,
+                        failure_timeout_s=1.0, metadata_interval_s=0.2,
+                        rate_factor=10)
+    net = InProcNetwork()
+    ecfg = EngineConfig(batch_size=16, image_size=SIZE, resize_size=SIZE)
+    nodes = {h: Node(h, cfg, net.transport(h), str(tmp_path / h),
+                     engine_config=ecfg) for h in cfg.hosts}
+    try:
+        for n in nodes.values():
+            n.start()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not all(
+                len(n.membership.members.alive_hosts()) == 2
+                for n in nodes.values()):
+            time.sleep(0.02)
+
+        rng = np.random.default_rng(1)
+        images = rng.integers(0, 256, size=(48, SIZE, SIZE, 3),
+                              dtype=np.uint8)
+        publish_images(nodes["n0"].store, "tiny", images, shard_size=16)
+
+        master = nodes["n0"].inference
+        qnums = master.inference("alexnet", 0, 47, pace_s=0.0,
+                                 dataset="store://tiny")
+        assert qnums == [1, 2]        # 48 images / query_batch_size 32
+        deadline = time.time() + 120.0
+        while time.time() < deadline and not all(
+                master.query_done("alexnet", q) for q in qnums):
+            time.sleep(0.1)
+        assert all(master.query_done("alexnet", q) for q in qnums), \
+            "queries never completed"
+        recs = [r for q in qnums for r in master.results("alexnet", q)]
+        assert {r[0] for r in recs} == {f"test_{i}.JPEG" for i in range(48)}
+
+        # every worker classified the SAME pixels with the SAME weights:
+        # results must equal a direct local forward over the published block
+        eng = nodes["n0"].engine
+        idx, _ = eng.infer_batch("alexnet", images)
+        want = {f"test_{i}.JPEG": eng.categories[int(idx[i])]
+                for i in range(48)}
+        got = {r[0]: r[1] for r in recs}
+        assert got == want
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_validation(dataset):
+    stores, images, tmp_path = dataset
+    with pytest.raises(ValueError, match="uint8"):
+        publish_images(stores["n0"], "bad",
+                       np.zeros((4, 8, 9, 3), np.uint8))
+    with pytest.raises(ValueError, match="shard_size"):
+        publish_images(stores["n0"], "bad",
+                       np.zeros((4, 8, 8, 3), np.uint8), shard_size=0)

@@ -125,6 +125,118 @@ class HangingEngine:
             elapsed_s=0.01, weights="random")
 
 
+def test_worker_survives_engine_exception(tmp_path):
+    """An engine exception (unfetchable dataset, device error) must not
+    kill the worker thread: the task is left for straggler re-dispatch and
+    the SAME worker keeps serving later jobs."""
+
+    class FlakyEngine:
+        def __init__(self, fail_first: bool):
+            self.fail_first = fail_first
+            self.calls = 0
+
+        def infer(self, name, start, end, dataset_root=None):
+            self.calls += 1
+            if self.fail_first and self.calls == 1:
+                raise RuntimeError("injected engine failure")
+            return SimpleNamespace(
+                records=[(f"test_{i}.JPEG", f"class_{i % 1000}", 0.9)
+                         for i in range(start, end + 1)],
+                elapsed_s=0.01, weights="random")
+
+    cfg = ClusterConfig(hosts=("n0", "n1"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0",
+                        replication_factor=2, query_batch_size=400,
+                        query_interval_s=0.0, ping_interval_s=0.1,
+                        failure_timeout_s=5.0, straggler_timeout_s=0.5,
+                        metadata_interval_s=0.2, rate_factor=10)
+    net = InProcNetwork()
+    engines = {"n0": FlakyEngine(False), "n1": FlakyEngine(True)}
+    nodes = {h: Node(h, cfg, net.transport(h), str(tmp_path / h),
+                     engine=engines[h]) for h in cfg.hosts}
+    try:
+        for n in nodes.values():
+            n.start()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not all(
+                len(n.membership.members.alive_hosts()) == 2
+                for n in nodes.values()):
+            time.sleep(0.02)
+        master = nodes["n0"].inference
+        q1 = master.inference("resnet", 0, 199, pace_s=0.0)[0]
+        deadline = time.time() + 20.0
+        while time.time() < deadline and not master.query_done("resnet", q1):
+            time.sleep(0.02)
+        assert master.query_done("resnet", q1), \
+            "failed task was never re-dispatched"
+        assert {r[0] for r in master.results("resnet", q1)} == {
+            f"test_{i}.JPEG" for i in range(200)}
+        assert engines["n1"].calls >= 1           # it did receive + fail
+
+        # the worker that threw still serves: a second query completes with
+        # n1 doing real work again
+        before = engines["n1"].calls
+        q2 = master.inference("resnet", 0, 199, pace_s=0.0)[0]
+        deadline = time.time() + 20.0
+        while time.time() < deadline and not master.query_done("resnet", q2):
+            time.sleep(0.02)
+        assert master.query_done("resnet", q2)
+        assert engines["n1"].calls > before, "worker thread died"
+        assert nodes["n0"].membership.members.is_alive("n1")
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_deterministic_failure_caps_redispatch(tmp_path):
+    """A job that fails on EVERY worker (bad dataset name, broken model)
+    must not bounce between workers forever: after max_task_retries moves
+    the task is marked permanently FAILED and `query_failed` tells pollers
+    to stop waiting."""
+
+    class AlwaysFailing:
+        def infer(self, name, start, end, dataset_root=None):
+            raise RuntimeError("deterministic failure")
+
+    cfg = ClusterConfig(hosts=("n0", "n1"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0",
+                        replication_factor=2, query_batch_size=400,
+                        query_interval_s=0.0, ping_interval_s=0.1,
+                        failure_timeout_s=5.0, straggler_timeout_s=0.2,
+                        metadata_interval_s=0.1, max_task_retries=2,
+                        rate_factor=10)
+    net = InProcNetwork()
+    nodes = {h: Node(h, cfg, net.transport(h), str(tmp_path / h),
+                     engine=AlwaysFailing()) for h in cfg.hosts}
+    try:
+        for n in nodes.values():
+            n.start()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not all(
+                len(n.membership.members.alive_hosts()) == 2
+                for n in nodes.values()):
+            time.sleep(0.02)
+        master = nodes["n0"].inference
+        qnum = master.inference("resnet", 0, 99, pace_s=0.0)[0]
+        deadline = time.time() + 20.0
+        while time.time() < deadline and not master.query_failed("resnet",
+                                                                 qnum):
+            time.sleep(0.05)
+        assert master.query_failed("resnet", qnum), \
+            "query kept re-dispatching forever"
+        assert not master.query_done("resnet", qnum)
+        # the control verb surfaces it to remote pollers
+        out = nodes["n0"].control._dispatch(
+            "query_done", {"model": "resnet", "qnum": qnum})
+        assert out == {"done": False, "failed": True}
+        # retry accounting stayed within the cap
+        for t in master.scheduler.book.tasks_for_query("resnet", qnum):
+            assert t.retries <= cfg.max_task_retries + 1
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
 def test_straggler_redispatch_wall_clock(tmp_path):
     """A worker that accepts its task but never finishes (no crash, so the
     failure detector stays quiet) is caught by the straggler monitor and
