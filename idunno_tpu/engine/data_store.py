@@ -88,6 +88,10 @@ class StoreDataset:
         # version-qualified: a re-published dataset never serves stale cache
         return os.path.join(self.cache_dir, f"shard_{k}.v{self.version}.u8")
 
+    # without a disk cache, shards live in RAM — bound how many (a full
+    # dataset pinned in host memory per engine can OOM the node)
+    _MEM_SHARDS_MAX = 64
+
     def _shard(self, k: int) -> np.ndarray:
         with self._lock:
             arr = self._mem.get(k)
@@ -95,22 +99,29 @@ class StoreDataset:
             return arr
         rows = min(self.shard_size, self.n - k * self.shard_size)
         shape = (rows, self.size, self.size, 3)
-        blob = None
+        nbytes = int(np.prod(shape))
         path = self._shard_path(k) if self.cache_dir else None
-        if path and os.path.exists(path):
-            blob = open(path, "rb").read()
-            if len(blob) != int(np.prod(shape)):      # torn cache write
-                blob = None
-        if blob is None:
-            blob, _ = self.store.get_bytes(dataset_shard_name(self.name, k))
-            if path:
+        if path is not None:
+            if not (os.path.exists(path)
+                    and os.path.getsize(path) == nbytes):  # torn write
+                blob, _ = self.store.get_bytes(
+                    dataset_shard_name(self.name, k))
                 tmp = f"{path}.tmp.{os.getpid()}"
                 with open(tmp, "wb") as f:
                     f.write(blob)
                 os.replace(tmp, path)                 # atomic vs readers
-        arr = np.frombuffer(blob, dtype=np.uint8).reshape(shape)
+            # memmap: the OS page cache backs reads, nothing is pinned in
+            # this process — host RSS stays bounded however big the dataset
+            arr = np.memmap(path, dtype=np.uint8, mode="r", shape=shape)
+        else:
+            blob, _ = self.store.get_bytes(dataset_shard_name(self.name, k))
+            arr = np.frombuffer(blob, dtype=np.uint8).reshape(shape)
         with self._lock:
             self._mem[k] = arr
+            # bound the cache either way: RAM for frombuffer shards, open
+            # file handles for memmaps (both re-acquire cheaply)
+            while len(self._mem) > self._MEM_SHARDS_MAX:   # oldest-first
+                self._mem.pop(next(iter(self._mem)))
         return arr
 
     def load_range(self, start: int,

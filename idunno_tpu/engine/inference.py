@@ -479,9 +479,13 @@ class InferenceEngine:
                                    size=self.config.resize_size)
 
     def _store_dataset(self, name: str):
-        """One cached `StoreDataset` per name (meta fetched once; shards
-        staged on demand into the store's host-local data dir)."""
-        from idunno_tpu.engine.data_store import StoreDataset
+        """One cached `StoreDataset` per name, re-validated against the
+        master's current meta version on every access (one metadata-only
+        STAT per chunk): a re-published dataset is picked up by WARM
+        engines too, never mixing versions across workers. When the master
+        is unreachable the cached object serves best-effort."""
+        from idunno_tpu.engine.data_store import (
+            StoreDataset, dataset_meta_name)
 
         if self.store is None:
             raise ValueError(
@@ -489,6 +493,13 @@ class InferenceEngine:
                 "attached (this engine has none)")
         with self._load_lock:
             ds = self._store_datasets.get(name)
+            if ds is not None:
+                try:
+                    latest, _ = self.store.stat(dataset_meta_name(name))
+                except Exception:  # noqa: BLE001 - keep serving best-effort
+                    latest = ds.version
+                if latest != ds.version:
+                    ds = None                      # re-published: rebuild
             if ds is None:
                 cache = os.path.join(self.store.local.data_dir,
                                      ".dataset_cache", name)

@@ -133,6 +133,8 @@ class FairScheduler:
     def redispatch_straggler(self, task: Task, alive: list[str]) -> Task:
         """Move a stuck task to a different alive worker (reference
         `monitor_inference_work` re-sends to the same worker, `:809-830`;
-        moving is strictly better when the worker is wedged)."""
+        moving is strictly better when the worker is wedged). These moves —
+        and only these — count against the task's retry cap."""
         others = [h for h in alive if h != task.worker] or alive
-        return self.book.reassign(task, self.rng.choice(others), self.clock())
+        return self.book.reassign(task, self.rng.choice(others),
+                                  self.clock(), count_retry=True)

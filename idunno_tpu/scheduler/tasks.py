@@ -70,13 +70,19 @@ class TaskBook:
             for t in tasks:
                 self._by_query.setdefault((t.model, t.qnum), []).append(t)
 
-    def reassign(self, task: Task, new_worker: str, now: float) -> Task:
+    def reassign(self, task: Task, new_worker: str, now: float,
+                 count_retry: bool = False) -> Task:
         """Move an in-flight task to another worker (failure/straggler
-        re-dispatch, `:706-760`)."""
+        re-dispatch, `:706-760`). ``count_retry`` increments the
+        retry-cap counter — set ONLY by the straggler monitor: moves caused
+        by worker crashes or dispatch transport failures are infrastructure
+        churn and must not consume the budget meant for jobs that
+        deterministically fail wherever they run."""
         with self._lock:
             task.worker = new_worker
             task.t_assigned = now
-            task.retries += 1
+            if count_retry:
+                task.retries += 1
             return task
 
     def mark_failed(self, task: Task, now: float) -> Task:
@@ -91,10 +97,14 @@ class TaskBook:
     def mark_finished(self, model: str, qnum: int, start: int, end: int,
                       now: float) -> Task | None:
         """Flip the matching task to finished (`:645-652`); returns it, or
-        None if no matching in-flight task (duplicate/stale result)."""
+        None if no matching unfinished task (duplicate/stale result).
+        A FAILED task also accepts: failure is a give-up marker, not a
+        fact — a slow-but-correct worker delivering after the retry cap
+        heals the query instead of having its records dropped."""
         with self._lock:
             for t in self._by_query.get((model, qnum), []):
-                if t.start == start and t.end == end and t.state == WORKING:
+                if t.start == start and t.end == end \
+                        and t.state in (WORKING, FAILED):
                     t.state = FINISHED
                     t.t_finished = now
                     return t

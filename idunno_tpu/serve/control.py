@@ -270,6 +270,24 @@ class ControlService:
                     seed=int(p.get("seed", 0)),
                     resume=bool(p.get("resume", False)))
             return {"started": True}
+        if verb == "profile":
+            # capture a jax.profiler trace of whatever this node executes
+            # during the window (worker jobs, decode pools) — remote,
+            # on-demand observability the reference never had (its only
+            # timing is host wall-clock prints, `alexnet_resnet.py:91-92`)
+            import time as _time
+
+            from idunno_tpu.utils.tracing import trace
+
+            seconds = float(p.get("seconds", 3.0))
+            if not 0.0 < seconds <= 60.0:
+                raise ValueError(f"seconds={seconds}: want (0, 60]")
+            log_dir = p.get("log_dir") or os.path.join(
+                node.store.local.data_dir, "profiles",
+                _time.strftime("%Y%m%d-%H%M%S"))
+            with trace(log_dir):
+                _time.sleep(seconds)
+            return {"log_dir": log_dir, "seconds": seconds}
         if verb == "train_status":
             job = self._train_jobs.get(p["name"])
             if job is None:

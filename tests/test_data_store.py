@@ -102,6 +102,33 @@ def test_engine_serves_store_dataset(dataset, eight_devices):
         other.infer("alexnet", 0, 3, dataset_root="store://tiny")
 
 
+def test_warm_engine_picks_up_republished_dataset(dataset, eight_devices):
+    """A WARM engine (StoreDataset already cached) must serve the new
+    pixels after a re-publish — the per-access meta STAT invalidates the
+    cached object, so one query never mixes dataset versions across
+    fresh and warm workers."""
+    from idunno_tpu.config import EngineConfig
+    from idunno_tpu.engine.inference import InferenceEngine
+    from idunno_tpu.parallel.mesh import local_mesh
+
+    stores, images, tmp_path = dataset
+    eng = InferenceEngine(
+        EngineConfig(batch_size=16, image_size=SIZE, resize_size=SIZE),
+        mesh=local_mesh(), pretrained=False, store=stores["n1"])
+    res1 = eng.infer("alexnet", 0, 15, dataset_root="store://tiny")
+
+    flipped = images[::-1].copy()
+    publish_images(stores["n0"], "tiny", flipped, shard_size=16)
+    res2 = eng.infer("alexnet", 0, 15, dataset_root="store://tiny")
+
+    idx_new, _ = eng.infer_batch("alexnet", flipped[:16])
+    want_new = [eng.categories[int(i)] for i in idx_new]
+    assert [r[1] for r in res2.records] == want_new
+    idx_old, _ = eng.infer_batch("alexnet", images[:16])
+    want_old = [eng.categories[int(i)] for i in idx_old]
+    assert [r[1] for r in res1.records] == want_old
+
+
 def test_cluster_serves_store_dataset_end_to_end(tmp_path, eight_devices):
     """The reference's full journey (`README.md:37-44`): stage the dataset
     through the file layer, then `inference <start> <end> <model>` — here

@@ -87,6 +87,34 @@ def test_taskbook_mark_finished_and_done():
     assert book.query_done("resnet", 1)
 
 
+def test_retry_cap_counts_only_straggler_moves_and_failure_heals():
+    """Infrastructure churn (crash/transport reassignments) must not
+    consume the retry cap, and a late CORRECT result heals a
+    retry-capped FAILED task instead of being dropped as stale."""
+    book = TaskBook()
+    t = Task("resnet", 1, "n1", 0, 49, t_assigned=0.0)
+    book.record([t])
+    # crash/transport moves: no retry accounting
+    book.reassign(t, "n2", 1.0)
+    book.reassign(t, "n3", 2.0)
+    assert t.retries == 0
+    # straggler moves: counted
+    book.reassign(t, "n4", 3.0, count_retry=True)
+    assert t.retries == 1
+    book.mark_failed(t, 4.0)
+    assert book.query_failed("resnet", 1)
+    assert not book.query_done("resnet", 1)
+    # the slow worker's correct result arrives after the give-up marker
+    healed = book.mark_finished("resnet", 1, 0, 49, 5.0)
+    assert healed is not None and healed.state == FINISHED
+    assert book.query_done("resnet", 1)
+    assert not book.query_failed("resnet", 1)
+    # retries survive the failover wire round-trip
+    book2 = TaskBook()
+    book2.load_wire(book.to_wire())
+    assert book2.tasks_for_query("resnet", 1)[0].retries == 1
+
+
 def test_straggler_detection_direction():
     # the reference's comparison is inverted and never fires (`:822`)
     book = TaskBook()

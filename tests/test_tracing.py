@@ -54,6 +54,46 @@ def test_trace_writes_profile(tmp_path):
     assert found, f"no trace artifacts under {log_dir}"
 
 
+def test_profile_control_verb(tmp_path):
+    """The `profile` RPC captures a trace of whatever the node runs during
+    the window, into a caller-chosen (or node-local default) directory."""
+    import threading
+
+    import jax.numpy as jnp
+    import pytest
+
+    from idunno_tpu.serve.control import ControlService
+
+    class T:
+        def serve(self, *_a, **_k):
+            pass
+    node = type("NodeStub", (), {})()
+    node.host, node.transport = "n0", T()
+    ctl = ControlService(node)
+
+    # keep the device busy during the window so the trace has content
+    stop = threading.Event()
+
+    def busy():
+        x = jnp.ones((64, 64))
+        while not stop.is_set():
+            (x @ x).block_until_ready()
+    t = threading.Thread(target=busy, daemon=True)
+    t.start()
+    try:
+        log_dir = str(tmp_path / "prof")
+        out = ctl._dispatch("profile", {"seconds": 0.5, "log_dir": log_dir})
+        assert out == {"log_dir": log_dir, "seconds": 0.5}
+        found = any(fn for _, _, files in __import__("os").walk(log_dir)
+                    for fn in files)
+        assert found, f"no trace artifacts under {log_dir}"
+        with pytest.raises(ValueError, match="seconds"):
+            ctl._dispatch("profile", {"seconds": 0})
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
 def test_device_timed_exact_compile_detection_survives_rewrap():
     """ADVICE round-1 #4: with a jitted fn, compile detection keys on the
     jit cache, so a second wrapper over the same (already warm) fn must not
