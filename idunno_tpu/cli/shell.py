@@ -60,8 +60,9 @@ HELP = """\
         checkpoint_every seed resume=1)
   train-status <name> | train-stop <name>
   lm-serve <name> <prompt_len> <max_len> [k=v ...]  continuous-batching pool
-       (slots decode_steps quantize=int8)
-  lm-submit <name> <max_new> <tok> [tok ...]   queue a prompt -> request id
+       (slots decode_steps quantize=int8 eos_id=N)
+  lm-submit <name> <max_new> [temperature= seed=] <tok> [tok ...]
+       queue a prompt -> request id (temperature 0=greedy, >0 sampled)
   lm-poll <name> | lm-stop <name>              fetch completions / stop"""
 
 
@@ -367,10 +368,11 @@ class Shell:
     def cmd_lm_serve(self, args: list[str]) -> str:
         if len(args) < 3:
             return ("usage: lm-serve <name> <prompt_len> <max_len> "
-                    "[slots= decode_steps= quantize=int8 reload=1]")
+                    "[slots= decode_steps= quantize=int8 eos_id=N "
+                    "reload=1]")
         kv = self._kv(args[3:])
-        payload = {k: int(kv.pop(k)) for k in ("slots", "decode_steps")
-                   if k in kv}
+        payload = {k: int(kv.pop(k))
+                   for k in ("slots", "decode_steps", "eos_id") if k in kv}
         if "quantize" in kv:
             payload["quantize"] = kv.pop("quantize")
         if "reload" in kv:
@@ -386,10 +388,19 @@ class Shell:
 
     def cmd_lm_submit(self, args: list[str]) -> str:
         if len(args) < 3:
-            return "usage: lm-submit <name> <max_new> <tok> [tok ...]"
+            return ("usage: lm-submit <name> <max_new> [temperature= seed=] "
+                    "<tok> [tok ...]")
+        kv = self._kv([a for a in args[2:] if "=" in a])
+        toks = [int(t) for t in args[2:] if "=" not in t]
+        payload = {}
+        if "temperature" in kv:
+            payload["temperature"] = float(kv.pop("temperature"))
+        if "seed" in kv:
+            payload["seed"] = int(kv.pop("seed"))
+        if kv:
+            return f"unknown lm-submit option(s): {sorted(kv)}"
         out = self._control("lm_submit", name=args[0],
-                            max_new=int(args[1]),
-                            prompt=[int(t) for t in args[2:]])
+                            max_new=int(args[1]), prompt=toks, **payload)
         return f"request {out['id']} queued on {args[0]}"
 
     def cmd_lm_poll(self, args: list[str]) -> str:

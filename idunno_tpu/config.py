@@ -62,10 +62,13 @@ class ClusterConfig:
     # straggler threshold 30 s `:812`).
     rate_factor: int = 10
     straggler_timeout_s: float = 30.0
-    # re-dispatch cap per task: past this many moves the task is marked
-    # permanently FAILED and surfaced via query_failed, instead of bouncing
-    # a deterministically-failing job between workers forever
+    # re-dispatch caps: past max_task_retries STRAGGLER moves (worker
+    # alive, task never finishes) or max_task_moves TOTAL moves (also
+    # counting crash/transport churn — bounds a job that kills its
+    # workers), the task is marked permanently FAILED and surfaced via
+    # query_failed instead of bouncing between workers forever
     max_task_retries: int = 3
+    max_task_moves: int = 12
 
     # Query pump (reference: batch 400, 1 query / 20 s,
     # `mp4_machinelearning.py:45-46, 1104-1109`).

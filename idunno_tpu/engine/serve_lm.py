@@ -45,11 +45,16 @@ from idunno_tpu.ops.quantize import dequantize_tree, quantize_tree
 
 @dataclass
 class Request:
-    """One generation request: ``tokens`` is the raw prompt (host ints)."""
+    """One generation request: ``tokens`` is the raw prompt (host ints);
+    ``temperature`` 0 = greedy, > 0 = per-row softmax sampling seeded by
+    ``seed`` (defaults to the request id, so every request draws an
+    independent, reproducible stream)."""
 
     id: int
     tokens: list[int]
     max_new: int
+    temperature: float = 0.0
+    seed: int | None = None
 
 
 @dataclass
@@ -84,8 +89,26 @@ def _prefill(model: TransformerLM, params: Any, prompt: jnp.ndarray,
                                 prompt.astype(jnp.int32), mutable=["cache"])
     last = jax.lax.dynamic_index_in_dim(logits[0], true_len - 1, axis=0,
                                         keepdims=False)     # [vocab]
-    first = jnp.argmax(last).astype(jnp.int32)
-    return mutated["cache"], first
+    return mutated["cache"], last
+
+
+def _next_token(logits: jnp.ndarray, temp: jnp.ndarray,
+                key: jnp.ndarray) -> jnp.ndarray:
+    """Greedy (temp == 0) or temperature-sampled next token; shared by the
+    prefill pick and the batched decode step (vmapped there)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temp, 1e-6)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temp > 0.0, sampled, greedy)
+
+
+@jax.jit
+def _pick_first(logits: jnp.ndarray, temp: jnp.ndarray,
+                key: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """First generated token from the prefill logits; returns (token,
+    advanced key) so the decode stream continues from a fresh subkey."""
+    sub, nxt_key = jax.random.split(key)
+    return _next_token(logits, temp, sub), nxt_key
 
 
 @partial(jax.jit, static_argnames=("prompt_len",), donate_argnums=(0, 1))
@@ -140,7 +163,7 @@ class DecodeServer:
 
     def __init__(self, model: TransformerLM, params: Any, *, slots: int,
                  prompt_len: int, max_len: int, decode_steps: int = 1,
-                 quantize: str = "none") -> None:
+                 quantize: str = "none", eos_id: int | None = None) -> None:
         if not model.causal:
             raise ValueError("continuous batching needs a causal LM")
         if prompt_len > max_len:
@@ -159,6 +182,10 @@ class DecodeServer:
         self.prompt_len = prompt_len
         self.max_len = max_len
         self.decode_steps = decode_steps
+        # generating eos_id retires the row immediately (the eos token is
+        # kept in the output, truncating the sequence below max_new) — the
+        # freed slot admits the next queued prompt at the following step
+        self.eos_id = eos_id
 
         self._dec = dataclasses.replace(model, decode=True,
                                         max_decode_len=max_len,
@@ -170,6 +197,8 @@ class DecodeServer:
         self._cache = init_cache(self._dec_for_init(), slots, max_len)
         self._cursors = jnp.zeros((slots,), jnp.int32)
         self._remaining = jnp.zeros((slots,), jnp.int32)
+        self._temps = jnp.zeros((slots,), jnp.float32)
+        self._keys = jnp.zeros((slots, 2), jnp.uint32)   # per-row rng
 
         # host state
         self._queue: deque[Request] = deque()
@@ -186,11 +215,11 @@ class DecodeServer:
     def _build_decode(self, n_steps: int):
         dec = self._dec
 
-        def run(params, tokens, cache, cursors, remaining):
+        def run(params, tokens, cache, cursors, remaining, temps, keys):
             params = dequantize_tree(params)   # int8 stays HBM-resident
 
             def body(_, carry):
-                tokens, cache, cursors, remaining = carry
+                tokens, cache, cursors, remaining, keys = carry
                 active = remaining > 0
                 cache = _set_cursors(cache, cursors)
                 tok = jnp.take_along_axis(tokens, cursors[:, None], axis=1)
@@ -198,33 +227,45 @@ class DecodeServer:
                     {"params": params, "cache": cache}, tok,
                     mutable=["cache"])
                 cache = mutated["cache"]
-                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                # per-row key advance + greedy/sampled pick (row streams
+                # stay independent of co-resident rows and of admissions)
+                split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+                nxt = jax.vmap(_next_token)(logits[:, 0], temps,
+                                            split[:, 0])
+                keys = split[:, 1]
                 wpos = jnp.clip(cursors + 1, 0, self.max_len - 1)
                 old = jnp.take_along_axis(tokens, wpos[:, None], axis=1)[:, 0]
                 rows = jnp.arange(tokens.shape[0])
                 tokens = tokens.at[rows, wpos].set(
                     jnp.where(active, nxt, old))
                 cursors = jnp.where(active, cursors + 1, cursors)
-                remaining = jnp.where(active, remaining - 1, remaining)
-                return tokens, cache, cursors, remaining
+                new_remaining = remaining - 1
+                if self.eos_id is not None:        # static: traced once
+                    new_remaining = jnp.where(nxt == self.eos_id, 0,
+                                              new_remaining)
+                remaining = jnp.where(active, new_remaining, remaining)
+                return tokens, cache, cursors, remaining, keys
 
             return jax.lax.fori_loop(
-                0, n_steps, body, (tokens, cache, cursors, remaining))
+                0, n_steps, body,
+                (tokens, cache, cursors, remaining, keys))
 
-        # donate the decode state (tokens/cache/cursors/remaining): the KV
-        # cache is by far the largest buffer and every step returns a fresh
-        # one — donation lets XLA update it in place instead of copying it
-        # per dispatch. (CPU doesn't implement donation and would warn.)
+        # donate the decode state (tokens/cache/cursors/remaining/keys):
+        # the KV cache is by far the largest buffer and every step returns
+        # a fresh one — donation lets XLA update it in place instead of
+        # copying it per dispatch. (CPU doesn't implement donation and
+        # would warn.) temps is read-only and not donated.
         if jax.devices()[0].platform == "tpu":
-            return jax.jit(run, donate_argnums=(1, 2, 3, 4))
+            return jax.jit(run, donate_argnums=(1, 2, 3, 4, 6))
         return jax.jit(run)
 
     # -- client surface ---------------------------------------------------
 
-    def validate(self, tokens: list[int], max_new: int) -> None:
-        """Raise ValueError if (tokens, max_new) can't fit this server's
-        static buckets; shared by every submission front-end (the RPC
-        serving loop validates on the caller's thread with this)."""
+    def validate(self, tokens: list[int], max_new: int,
+                 temperature: float = 0.0) -> None:
+        """Raise ValueError if the request can't fit this server's static
+        buckets; shared by every submission front-end (the RPC serving
+        loop validates on the caller's thread with this)."""
         if not tokens:
             raise ValueError("empty prompt")
         if len(tokens) > self.prompt_len:
@@ -236,14 +277,20 @@ class DecodeServer:
                 f"{self.max_len}")
         if max_new < 1:
             raise ValueError("max_new must be >= 1")
+        if temperature < 0.0:
+            raise ValueError(f"temperature {temperature} must be >= 0")
 
-    def submit(self, tokens: list[int], max_new: int) -> int:
-        """Queue a prompt; returns the request id."""
-        self.validate(tokens, max_new)
+    def submit(self, tokens: list[int], max_new: int, *,
+               temperature: float = 0.0, seed: int | None = None) -> int:
+        """Queue a prompt; returns the request id. ``temperature`` 0 =
+        greedy; > 0 samples with a per-request stream seeded by ``seed``
+        (default: the request id)."""
+        self.validate(tokens, max_new, temperature)
         rid = self._next_id
         self._next_id += 1
         self._queue.append(Request(id=rid, tokens=list(tokens),
-                                   max_new=max_new))
+                                   max_new=max_new,
+                                   temperature=temperature, seed=seed))
         return rid
 
     def poll(self) -> list[Completion]:
@@ -278,15 +325,24 @@ class DecodeServer:
             true_len = len(req.tokens)
             prompt = np.zeros((1, self.prompt_len), np.int32)
             prompt[0, :true_len] = req.tokens
-            row_cache, first = _prefill(
+            row_cache, last_logits = _prefill(
                 self._prefill_model, self.params, jnp.asarray(prompt),
                 jnp.int32(true_len), self.prompt_len)
+            temp = jnp.float32(req.temperature)
+            seed = req.id if req.seed is None else req.seed
+            first, key = _pick_first(last_logits, temp,
+                                     jax.random.PRNGKey(seed))
             self._tokens, self._cache = _insert(
                 self._tokens, self._cache, row_cache, jnp.asarray(prompt),
                 first, jnp.int32(true_len), jnp.int32(slot),
                 self.prompt_len)
             self._cursors = self._cursors.at[slot].set(true_len)
-            self._remaining = self._remaining.at[slot].set(req.max_new - 1)
+            self._temps = self._temps.at[slot].set(temp)
+            self._keys = self._keys.at[slot].set(key)
+            rem = req.max_new - 1
+            if self.eos_id is not None and int(first) == self.eos_id:
+                rem = 0                   # the prompt's very next token
+            self._remaining = self._remaining.at[slot].set(rem)
             self._live[slot] = req
             # max_new == 1: the prefill's token was the only one; the next
             # _retire_finished pass (step() runs one post-admission) retires
@@ -302,10 +358,10 @@ class DecodeServer:
         self._admit()
         self._retire_finished()           # max_new == 1 admissions
         if self._live:
-            (self._tokens, self._cache, self._cursors,
-             self._remaining) = self._decode(
+            (self._tokens, self._cache, self._cursors, self._remaining,
+             self._keys) = self._decode(
                 self.params, self._tokens, self._cache, self._cursors,
-                self._remaining)
+                self._remaining, self._temps, self._keys)
             self._retire_finished()
         return len(self._live) + len(self._queue)
 

@@ -103,13 +103,25 @@ class FairScheduler:
         in-flight task on the dead worker moves to its first eligible ring
         successor (round-robin over alive workers here — the ring-successor
         walk with dead/master skips, minus the reference's bias of piling
-        everything onto one neighbor)."""
+        everything onto one neighbor). A task already moved
+        ``max_task_moves`` times is marked permanently FAILED instead (its
+        t_assigned resets on every move, so the straggler cap can never
+        catch a job that keeps killing its workers); returns only the
+        tasks that actually moved."""
         moved = []
         candidates = [h for h in alive if h != dead]
         if not candidates:
             return []
         now = self.clock()
         for i, task in enumerate(self.book.in_flight(worker=dead)):
+            if task.moves >= self.config.max_task_moves:
+                self.book.mark_failed(task, now)
+                import logging
+                logging.getLogger("idunno.scheduler").error(
+                    "task %s#%s [%s, %s] FAILED after %d total moves "
+                    "(kept losing its workers)", task.model, task.qnum,
+                    task.start, task.end, task.moves)
+                continue
             successor = self._ring_successor(dead, candidates, i)
             moved.append(self.book.reassign(task, successor, now))
         return moved

@@ -31,10 +31,15 @@ class Task:
     # the query's dataset root travels WITH the task so failure/straggler
     # re-dispatch (and post-failover resumption) reruns it on the same data
     dataset: str | None = None
-    # times this task was moved to another worker (failure or straggler);
-    # the straggler monitor caps this so a deterministically-failing job
+    # straggler-monitor moves only — capped by max_task_retries so a job
+    # that deterministically FAILS (worker survives, task never finishes)
     # can't re-dispatch forever
     retries: int = 0
+    # every move (straggler + crash/transport) — capped by the much larger
+    # max_task_moves so a job that deterministically KILLS its workers
+    # (whose moves reset t_assigned and never look like stragglers) is
+    # also bounded
+    moves: int = 0
 
     @property
     def n_items(self) -> int:
@@ -44,7 +49,8 @@ class Task:
         return {"model": self.model, "qnum": self.qnum, "worker": self.worker,
                 "start": self.start, "end": self.end, "state": self.state,
                 "t_assigned": self.t_assigned, "t_finished": self.t_finished,
-                "dataset": self.dataset, "retries": self.retries}
+                "dataset": self.dataset, "retries": self.retries,
+                "moves": self.moves}
 
     @classmethod
     def from_wire(cls, d: dict[str, Any]) -> "Task":
@@ -53,7 +59,8 @@ class Task:
                    t_assigned=float(d["t_assigned"]),
                    t_finished=float(d["t_finished"]),
                    dataset=d.get("dataset"),
-                   retries=int(d.get("retries", 0)))
+                   retries=int(d.get("retries", 0)),
+                   moves=int(d.get("moves", 0)))
 
 
 class TaskBook:
@@ -81,6 +88,7 @@ class TaskBook:
         with self._lock:
             task.worker = new_worker
             task.t_assigned = now
+            task.moves += 1
             if count_retry:
                 task.retries += 1
             return task

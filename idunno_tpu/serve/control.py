@@ -210,7 +210,9 @@ class ControlService:
                     prompt_len=int(p["prompt_len"]),
                     max_len=int(p["max_len"]),
                     decode_steps=int(p.get("decode_steps", 1)),
-                    quantize=p.get("quantize", "none"))
+                    quantize=p.get("quantize", "none"),
+                    eos_id=(int(p["eos_id"])
+                            if p.get("eos_id") is not None else None))
                 loop = LMServingLoop(server, name=f"{node.host}-{name}")
             except BaseException:
                 with self._reg_lock:
@@ -225,7 +227,10 @@ class ControlService:
             return {"stopped": True}
         if verb == "lm_submit":
             rid = self._lm_loop(p["name"]).submit(
-                [int(t) for t in p["prompt"]], int(p["max_new"]))
+                [int(t) for t in p["prompt"]], int(p["max_new"]),
+                temperature=float(p.get("temperature", 0.0)),
+                seed=(int(p["seed"]) if p.get("seed") is not None
+                      else None))
             return {"id": rid}
         if verb == "lm_poll":
             loop = self._lm_loop(p["name"])

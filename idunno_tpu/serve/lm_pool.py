@@ -39,13 +39,14 @@ class LMServingLoop:
 
     # -- any thread -------------------------------------------------------
 
-    def submit(self, tokens: list[int], max_new: int) -> int:
+    def submit(self, tokens: list[int], max_new: int, *,
+               temperature: float = 0.0, seed: int | None = None) -> int:
         """Validate + queue a prompt; returns the public request id.
         Raises once the pool is stopped — a submit racing `stop()` must
         error loudly, not return an id that never completes."""
         # validate eagerly on the caller's thread so the RPC gets the error
         # (the loop thread has nowhere to raise to)
-        self.server.validate(tokens, max_new)
+        self.server.validate(tokens, max_new, temperature)
         with self._lock:
             # checked under the lock: stop() sets the flag BEFORE its own
             # locked inbox drain, so an append here either precedes the
@@ -54,7 +55,8 @@ class LMServingLoop:
                 raise ValueError("serving pool is stopped")
             rid = self._next_id
             self._next_id += 1
-            self._inbox.append((rid, list(tokens), max_new))
+            self._inbox.append((rid, list(tokens), max_new,
+                                temperature, seed))
         self._wake.set()
         return rid
 
@@ -76,18 +78,20 @@ class LMServingLoop:
         self._thread.join(timeout=timeout)
         with self._lock:          # fail anything the loop never drained
             dropped, self._inbox = self._inbox, []
-            for rid, _tokens, _new in dropped:
+            for entry in dropped:
                 if len(self._errors) < 100:
                     self._errors.append(
-                        f"request {rid} dropped: pool stopped")
+                        f"request {entry[0]} dropped: pool stopped")
 
     # -- loop thread ------------------------------------------------------
 
     def _drain_inbox(self) -> None:
         with self._lock:
             batch, self._inbox = self._inbox, []
-        for rid, tokens, max_new in batch:
-            sid = self.server.submit(tokens, max_new)
+        for rid, tokens, max_new, temperature, seed in batch:
+            sid = self.server.submit(tokens, max_new,
+                                     temperature=temperature,
+                                     seed=rid if seed is None else seed)
             self._id_map[sid] = rid
 
     def _run(self) -> None:

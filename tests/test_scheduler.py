@@ -109,10 +109,31 @@ def test_retry_cap_counts_only_straggler_moves_and_failure_heals():
     assert healed is not None and healed.state == FINISHED
     assert book.query_done("resnet", 1)
     assert not book.query_failed("resnet", 1)
-    # retries survive the failover wire round-trip
+    # retries/moves survive the failover wire round-trip
     book2 = TaskBook()
     book2.load_wire(book.to_wire())
     assert book2.tasks_for_query("resnet", 1)[0].retries == 1
+    assert book2.tasks_for_query("resnet", 1)[0].moves == 3
+
+
+def test_worker_killing_task_bounded_by_total_moves():
+    """A task whose moves all come from worker DEATHS (t_assigned resets
+    each time, so the straggler cap never fires) is still bounded: past
+    max_task_moves, reassign_failed marks it FAILED instead of feeding it
+    another victim."""
+    cfg = ClusterConfig(hosts=("n0", "n1", "n2"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0",
+                        replication_factor=2, max_task_moves=4)
+    sched = FairScheduler(cfg, rng=random.Random(0), clock=lambda: 100.0)
+    t = Task("resnet", 1, "n1", 0, 49, t_assigned=0.0)
+    sched.book.record([t])
+    for i in range(4):                    # four crash-reassignments
+        moved = sched.reassign_failed(t.worker, ["n0", "n1", "n2"])
+        assert len(moved) == 1
+    assert t.moves == 4 and t.retries == 0
+    assert sched.reassign_failed(t.worker, ["n0", "n1", "n2"]) == []
+    assert t.state == "x"
+    assert sched.book.query_failed("resnet", 1)
 
 
 def test_straggler_detection_direction():

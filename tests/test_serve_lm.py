@@ -102,6 +102,61 @@ def test_docstring_loop_serves_all_instant_requests(lm):
         assert c.tokens == expected(model, params, prompt, 1)
 
 
+def test_eos_retires_rows_early(lm):
+    """Generating ``eos_id`` stops that row immediately (eos kept in the
+    output): the completion is the exact PREFIX of the non-eos greedy
+    rollout through the first eos, and the freed slot serves queued work."""
+    model, params = lm
+    prompt = [9, 21, 3]
+    full = expected(model, params, prompt, 12)      # greedy, no eos
+    eos = full[len(prompt) + 5]                     # token at mid-rollout
+    cut = full[:full.index(eos, len(prompt)) + 1]   # prefix THROUGH 1st eos
+
+    srv = DecodeServer(model, params, slots=1, prompt_len=4, max_len=24,
+                       eos_id=eos)
+    first = srv.submit(prompt, max_new=12)
+    second = srv.submit([2, 5], max_new=3)          # queued behind slot 0
+    done = {c.id: c for c in srv.run_until_drained()}
+    assert done[first].tokens == cut, "eos did not truncate the rollout"
+    assert len(done[first].tokens) < len(full)
+    assert second in done                           # freed slot was reused
+
+    # an eos that never occurs → full-length generation
+    srv2 = DecodeServer(model, params, slots=1, prompt_len=4, max_len=24,
+                        eos_id=VOCAB + 5)
+    srv2.submit(prompt, max_new=12)
+    assert srv2.run_until_drained()[0].tokens == full
+
+
+def test_per_request_sampling(lm):
+    """temperature > 0 rows sample from a per-request seeded stream:
+    reproducible across pools, independent of co-resident rows, and a
+    greedy request co-resident with sampled ones stays EXACTLY greedy."""
+    model, params = lm
+    prompt = [5, 11, 17]
+
+    def serve(order):
+        srv = DecodeServer(model, params, slots=2, prompt_len=4,
+                           max_len=24)
+        ids = {}
+        for kind in order:
+            if kind == "greedy":
+                ids[srv.submit(prompt, max_new=10)] = kind
+            else:
+                ids[srv.submit(prompt, max_new=10, temperature=1.0,
+                               seed=kind)] = kind
+        return {ids[c.id]: c.tokens for c in srv.run_until_drained()}
+
+    a = serve(["greedy", 7, 8])
+    b = serve([7, "greedy", 8])           # different slots/admission order
+    assert a["greedy"] == expected(model, params, prompt, 10)
+    assert b["greedy"] == a["greedy"]     # co-residency can't perturb it
+    assert a[7] == b[7] and a[8] == b[8]  # seeded streams reproduce
+    assert a[7] != a[8]                   # different seeds diverge
+    assert a[7] != a["greedy"]            # sampling actually sampled
+    assert all(0 <= t < VOCAB for t in a[7][3:])
+
+
 def test_submit_validation(lm):
     model, params = lm
     srv = DecodeServer(model, params, slots=1, prompt_len=4, max_len=8)
@@ -113,3 +168,5 @@ def test_submit_validation(lm):
         srv.submit([1, 2, 3], max_new=6)
     with pytest.raises(ValueError, match="max_new"):
         srv.submit([1], max_new=0)
+    with pytest.raises(ValueError, match="temperature"):
+        srv.submit([1], max_new=1, temperature=-0.5)
