@@ -163,7 +163,8 @@ class DecodeServer:
 
     def __init__(self, model: TransformerLM, params: Any, *, slots: int,
                  prompt_len: int, max_len: int, decode_steps: int = 1,
-                 quantize: str = "none", eos_id: int | None = None) -> None:
+                 quantize: str = "none", eos_id: int | None = None,
+                 mesh=None) -> None:
         if not model.causal:
             raise ValueError("continuous batching needs a causal LM")
         if prompt_len > max_len:
@@ -192,13 +193,44 @@ class DecodeServer:
                                         decode_per_row=True)
         self._prefill_model = model
 
+        # mesh sharding: the pool's slot dimension spreads over the mesh's
+        # data axis (every per-row decode op is elementwise over slots, so
+        # the step runs SPMD with zero cross-row collectives); params
+        # replicate. One pool then scales its co-resident sequences — and
+        # its KV-cache HBM — across chips.
+        self.mesh = mesh
+        rows = None
+        if mesh is not None:
+            from idunno_tpu.parallel.mesh import DATA_AXIS
+            from idunno_tpu.parallel.sharding import (
+                batch_sharding, replicated_sharding)
+            n_data = mesh.shape[DATA_AXIS]
+            if slots % n_data:
+                raise ValueError(f"slots={slots} must divide over the "
+                                 f"mesh data axis ({n_data})")
+            rows = batch_sharding(mesh)
+            self.params = jax.device_put(self.params,
+                                         replicated_sharding(mesh))
+
+        def zeros(shape, dtype):
+            # allocate UNDER the sharding: materializing the full cache on
+            # one device first would need the whole pool to fit one chip's
+            # HBM, defeating the point of sharding the slot dimension
+            if rows is None:
+                return jnp.zeros(shape, dtype)
+            return jax.jit(lambda: jnp.zeros(shape, dtype),
+                           out_shardings=rows)()
+
         # device state
-        self._tokens = jnp.zeros((slots, max_len), jnp.int32)
-        self._cache = init_cache(self._dec_for_init(), slots, max_len)
-        self._cursors = jnp.zeros((slots,), jnp.int32)
-        self._remaining = jnp.zeros((slots,), jnp.int32)
-        self._temps = jnp.zeros((slots,), jnp.float32)
-        self._keys = jnp.zeros((slots, 2), jnp.uint32)   # per-row rng
+        self._tokens = zeros((slots, max_len), jnp.int32)
+        cache_shapes = jax.eval_shape(
+            lambda: init_cache(self._dec_for_init(), slots, max_len))
+        self._cache = jax.tree.map(lambda s: zeros(s.shape, s.dtype),
+                                   cache_shapes)
+        self._cursors = zeros((slots,), jnp.int32)
+        self._remaining = zeros((slots,), jnp.int32)
+        self._temps = zeros((slots,), jnp.float32)
+        self._keys = zeros((slots, 2), jnp.uint32)       # per-row rng
 
         # host state
         self._queue: deque[Request] = deque()

@@ -128,6 +128,38 @@ def test_eos_retires_rows_early(lm):
     assert srv2.run_until_drained()[0].tokens == full
 
 
+def test_pool_shards_over_mesh(lm, eight_devices):
+    """The pool's slot dimension shards over the mesh data axis (SPMD
+    decode, zero cross-row collectives): outputs must be token-for-token
+    identical to the unsharded pool / standalone generate."""
+    from idunno_tpu.parallel.mesh import local_mesh
+
+    model, params = lm
+    mesh = local_mesh()
+    n = mesh.shape["data"]
+    srv = DecodeServer(model, params, slots=n, prompt_len=8, max_len=24,
+                       mesh=mesh)
+    rng = np.random.default_rng(5)
+    reqs = [([int(t) for t in rng.integers(0, VOCAB, size=k)], m)
+            for k, m in [(3, 9), (8, 4), (5, 12), (2, 7), (6, 6),
+                         (1, 10), (4, 5), (7, 8), (3, 3), (2, 11)]]
+    ids = {srv.submit(p, m): (p, m) for p, m in reqs[:n]}
+    for _ in range(2):
+        srv.step()
+    for p, m in reqs[n:]:                  # admitted into freed slots
+        ids[srv.submit(p, m)] = (p, m)
+    done = srv.run_until_drained()
+    assert {c.id for c in done} == set(ids)
+    for c in done:
+        p, m = ids[c.id]
+        assert c.tokens == expected(model, params, p, m), c.id
+
+    import pytest
+    with pytest.raises(ValueError, match="divide"):
+        DecodeServer(model, params, slots=n + 1, prompt_len=4, max_len=8,
+                     mesh=mesh)
+
+
 def test_per_request_sampling(lm):
     """temperature > 0 rows sample from a per-request seeded stream:
     reproducible across pools, independent of co-resident rows, and a
