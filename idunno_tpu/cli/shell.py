@@ -63,7 +63,8 @@ HELP = """\
        (slots decode_steps quantize=int8 eos_id=N)
   lm-submit <name> <max_new> [temperature= seed=] <tok> [tok ...]
        queue a prompt -> request id (temperature 0=greedy, >0 sampled)
-  lm-poll <name> | lm-stop <name>              fetch completions / stop"""
+  lm-poll <name> | lm-stats <name> | lm-stop <name>
+       fetch completions / occupancy+token counters / stop"""
 
 
 class Shell:
@@ -95,6 +96,7 @@ class Shell:
             "lm-serve": self.cmd_lm_serve,
             "lm-submit": self.cmd_lm_submit,
             "lm-poll": self.cmd_lm_poll,
+            "lm-stats": self.cmd_lm_stats,
             "lm-stop": self.cmd_lm_stop,
         }
 
@@ -412,6 +414,17 @@ class Shell:
                 for c in out["completions"]]
         rows.extend(f"ERROR: {e}" for e in out.get("errors", []))
         return "\n".join(rows) or "(no completions yet)"
+
+    def cmd_lm_stats(self, args: list[str]) -> str:
+        if len(args) != 1:
+            return "usage: lm-stats <name>"
+        s = self._control("lm_stats", name=args[0])["stats"]
+        return (f"{args[0]}: live={s['live']}/{s['slots']} "
+                f"queued={s['queued']} inbox={s['inbox']} "
+                f"unpolled={s['unpolled']} admitted={s['admitted']} "
+                f"completed={s['completed']} "
+                f"tokens_generated={s['tokens_generated']} "
+                f"dispatches={s['dispatches']}")
 
     def cmd_lm_stop(self, args: list[str]) -> str:
         if len(args) != 1:

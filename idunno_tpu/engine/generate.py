@@ -204,8 +204,11 @@ def beam_search(model: TransformerLM, params: Any, prompt: jnp.ndarray,
 #
 # The image engine reconstructs its models from the registry by name; LMs
 # carry their hyperparameters with the checkpoint instead, so any node can
-# reconstruct the module and serve `generate` without out-of-band config
-# (dense architectures only — attn_fn/ffn_factory are code, not data).
+# reconstruct the module and serve `generate` without out-of-band config.
+# Dense AND switch-MoE architectures persist (the MoE factory publishes a
+# declarative twin, `moe.switch_ffn_factory(...).lm_store_ffn`); custom
+# attn_fn / ffn_factory closures are code, not data, and save_lm refuses
+# both (swap a numerically-equivalent kernel for full_attention first).
 # Config and weights live in ONE versioned store object (length-prefixed
 # JSON header + flax bytes), so a save is atomic and any historical version
 # pairs its architecture with its own weights.
@@ -219,18 +222,36 @@ def lm_store_name(name: str) -> str:
 
 
 def save_lm(store, name: str, model: TransformerLM, params: Any) -> int:
-    """Version a dense TransformerLM (architecture + weights, one atomic
-    object) into the replicated store under ``lm/<name>``; returns the
-    store version."""
+    """Version a TransformerLM (architecture + weights, one atomic object)
+    into the replicated store under ``lm/<name>``; returns the store
+    version. Dense and switch-MoE FFNs are storable; a custom
+    ``ffn_factory`` without a declarative ``lm_store_ffn`` twin is code
+    and is refused."""
     import json
     import struct
 
     import flax.serialization
 
-    if model.ffn_factory is not None:
-        raise ValueError("save_lm stores dense LMs only (ffn_factory is "
-                         "code, not serializable config)")
+    from idunno_tpu.parallel.ring_attention import full_attention
+
     config = {f: getattr(model, f) for f in _LM_CONFIG_FIELDS}
+    if model.attn_fn is not full_attention:
+        # silently dropping it would make load_lm rebuild a DIFFERENT
+        # model (default attention); numerically-equivalent kernels can be
+        # swapped explicitly before saving:
+        # dataclasses.replace(model, attn_fn=full_attention)
+        raise ValueError(
+            "save_lm stores models with the default full_attention only "
+            "(a custom attn_fn is code, not serializable config; replace "
+            "it with full_attention before saving if it is numerically "
+            "equivalent)")
+    if model.ffn_factory is not None:
+        ffn = getattr(model.ffn_factory, "lm_store_ffn", None)
+        if ffn is None:
+            raise ValueError(
+                "save_lm stores dense or switch-MoE LMs only (this custom "
+                "ffn_factory is code, not serializable config)")
+        config["ffn"] = dict(ffn)
     config["dtype"] = jnp.dtype(model.dtype).name
     config["param_dtype"] = jnp.dtype(model.param_dtype).name
     header = json.dumps(config).encode()
@@ -255,6 +276,17 @@ def load_lm(store, name: str,
     config = json.loads(blob[4:4 + hlen])
     config["dtype"] = jnp.dtype(config["dtype"])
     config["param_dtype"] = jnp.dtype(config["param_dtype"])
+    ffn = config.pop("ffn", None)
+    if ffn is not None:
+        kind = ffn.pop("kind", None)
+        if kind != "switch":
+            raise ValueError(f"stored LM {name!r} uses unknown ffn kind "
+                             f"{kind!r}")
+        from idunno_tpu.models.moe import switch_ffn_factory
+        config["ffn_factory"] = switch_ffn_factory(
+            n_experts=int(ffn["n_experts"]),
+            capacity_factor=float(ffn["capacity_factor"]),
+            hidden_ratio=int(ffn["hidden_ratio"]), k=int(ffn["k"]))
     model = TransformerLM(**config)
     # structure-only template (no init compute, mirrors init_cache)
     template = jax.eval_shape(

@@ -203,14 +203,13 @@ class DecodeServer:
         if mesh is not None:
             from idunno_tpu.parallel.mesh import DATA_AXIS
             from idunno_tpu.parallel.sharding import (
-                batch_sharding, replicated_sharding)
+                batch_sharding, replicate)
             n_data = mesh.shape[DATA_AXIS]
             if slots % n_data:
                 raise ValueError(f"slots={slots} must divide over the "
                                  f"mesh data axis ({n_data})")
             rows = batch_sharding(mesh)
-            self.params = jax.device_put(self.params,
-                                         replicated_sharding(mesh))
+            self.params = replicate(mesh, self.params)
 
         def zeros(shape, dtype):
             # allocate UNDER the sharding: materializing the full cache on
@@ -237,6 +236,8 @@ class DecodeServer:
         self._live: dict[int, Request] = {}       # slot → request
         self._done: list[Completion] = []
         self._next_id = 0
+        self._stats = {"dispatches": 0, "admitted": 0, "completed": 0,
+                       "tokens_generated": 0}
 
         self._decode = self._build_decode(decode_steps)
 
@@ -333,6 +334,13 @@ class DecodeServer:
     def pending(self) -> int:
         return len(self._queue) + len(self._live)
 
+    def stats(self) -> dict:
+        """Serving counters: decode dispatches (``decode_steps`` tokens per
+        live row each), requests admitted/completed, generated-token total,
+        plus current occupancy."""
+        return dict(self._stats, live=len(self._live),
+                    queued=len(self._queue), slots=self.slots)
+
     # -- serving loop -----------------------------------------------------
 
     def _retire_finished(self) -> None:
@@ -348,6 +356,8 @@ class DecodeServer:
             self._done.append(Completion(
                 id=req.id, tokens=[int(t) for t in row],
                 prompt_len=len(req.tokens)))
+            self._stats["completed"] += 1
+            self._stats["tokens_generated"] += total - len(req.tokens)
 
     def _admit(self) -> None:
         free = [s for s in range(self.slots) if s not in self._live]
@@ -376,6 +386,7 @@ class DecodeServer:
                 rem = 0                   # the prompt's very next token
             self._remaining = self._remaining.at[slot].set(rem)
             self._live[slot] = req
+            self._stats["admitted"] += 1
             # max_new == 1: the prefill's token was the only one; the next
             # _retire_finished pass (step() runs one post-admission) retires
             # the row before any decode dispatch
@@ -394,6 +405,7 @@ class DecodeServer:
              self._keys) = self._decode(
                 self.params, self._tokens, self._cache, self._cursors,
                 self._remaining, self._temps, self._keys)
+            self._stats["dispatches"] += 1
             self._retire_finished()
         return len(self._live) + len(self._queue)
 

@@ -140,6 +140,13 @@ def switch_ffn_factory(n_experts: int, capacity_factor: float = 2.0,
                          capacity_factor=capacity_factor, mesh=mesh,
                          axis=axis, dtype=dtype, param_dtype=param_dtype,
                          name=name)
+    # declarative twin of this factory so `engine.generate.save_lm` can
+    # persist MoE architectures: everything here is data; the mesh is CODE
+    # and deliberately absent — loaders reconstruct dense (mesh=None) and
+    # re-apply expert parallelism themselves if they want it
+    make.lm_store_ffn = {"kind": "switch", "n_experts": n_experts,
+                         "capacity_factor": capacity_factor,
+                         "hidden_ratio": hidden_ratio, "k": k}
     return make
 
 

@@ -241,6 +241,8 @@ class ControlService:
             if errs:
                 out["errors"] = errs
             return out
+        if verb == "lm_stats":
+            return {"stats": self._lm_loop(p["name"]).stats()}
         if verb == "lm_stop":
             with self._reg_lock:
                 loop = self._lm_loops.pop(p["name"], None)

@@ -66,6 +66,15 @@ class LMServingLoop:
             out, self._outbox = self._outbox, []
             return out
 
+    def stats(self) -> dict:
+        """Server counters + this loop's queue depths. The server's dict is
+        only mutated by the loop thread; int reads are GIL-atomic."""
+        out = self.server.stats()
+        with self._lock:
+            out["inbox"] = len(self._inbox)
+            out["unpolled"] = len(self._outbox)
+        return out
+
     def errors(self) -> list[str]:
         """Errors since the last call (drained, like `poll`)."""
         with self._lock:

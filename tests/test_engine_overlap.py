@@ -63,11 +63,12 @@ def test_infer_overlaps_decode_with_compute(eight_devices, monkeypatch):
     speedup = t_seq / t_pipe
     # balanced decode/compute: ideal 2 - 1/k = 1.875; measured 1.7-1.9 on
     # an idle box. The threshold only needs to prove overlap exists (a
-    # sequential path scores ~1.0), so leave headroom for loaded CI boxes
-    # where compute timings drift after calibration.
-    assert speedup >= 1.3, (
+    # sequential path scores ~1.0), so leave generous headroom: on a box
+    # also running another test suite the compute timings drift well past
+    # the calibration and 1.3x has flaked.
+    assert speedup >= 1.2, (
         f"pipelined {t_pipe:.3f}s vs sequential {t_seq:.3f}s "
-        f"(speedup {speedup:.2f}x < 1.3x)")
+        f"(speedup {speedup:.2f}x < 1.2x)")
 
 
 def test_infer_empty_and_partial_ranges(eight_devices):

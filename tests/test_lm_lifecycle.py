@@ -112,12 +112,40 @@ def test_lm_served_through_cluster_control(stores, tmp_path):
                             max_new=5)),
         np.asarray(want))
 
-    # dense-only guard
+    # storable-architecture guards: code-only closures refuse loudly
+    custom = TransformerLM(vocab=32, dim=16, depth=1, num_heads=2,
+                           ffn_factory=lambda **kw: None)
+    with pytest.raises(ValueError, match="custom"):
+        save_lm(stores["n0"], "custom", custom, state.params)
+    odd_attn = TransformerLM(vocab=32, dim=16, depth=1, num_heads=2,
+                             attn_fn=lambda q, k, v, causal=True: v)
+    with pytest.raises(ValueError, match="attn_fn"):
+        save_lm(stores["n0"], "oddattn", odd_attn, state.params)
+
+
+def test_moe_lm_persists_and_serves_from_store(stores):
+    """Switch-MoE LMs round-trip through the store (the factory's
+    declarative twin travels in the header) and serve from ANY node —
+    generation from the reconstructed model is exact."""
+    from idunno_tpu.engine.generate import load_lm, save_lm
     from idunno_tpu.models.moe import MoETransformerLM
-    moe = MoETransformerLM(vocab=32, dim=16, depth=1, num_heads=2,
-                           n_experts=2)
-    with pytest.raises(ValueError, match="dense"):
-        save_lm(stores["n0"], "moe", moe, state.params)
+
+    moe = MoETransformerLM(vocab=32, dim=16, depth=2, num_heads=2,
+                           n_experts=4, capacity_factor=4.0, k=2,
+                           moe_every=2)
+    params = moe.init(jax.random.PRNGKey(2),
+                      jnp.zeros((1, 8), jnp.int32))["params"]
+    assert save_lm(stores["n0"], "moe", moe, params) == 1
+
+    loaded, lparams = load_lm(stores["n2"], "moe")
+    assert loaded.ffn_factory.lm_store_ffn == {
+        "kind": "switch", "n_experts": 4, "capacity_factor": 4.0,
+        "hidden_ratio": 4, "k": 2}
+    assert loaded.ffn_every == 2
+    prompt = jnp.asarray([[3, 7, 11]], jnp.int32)
+    want = generate(moe, params, prompt, prompt_len=3, max_new=6)
+    got = generate(loaded, lparams, prompt, prompt_len=3, max_new=6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
 def test_continuous_batching_served_over_control_rpc(stores):

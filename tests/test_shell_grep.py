@@ -134,6 +134,9 @@ def test_shell_lm_and_train_commands(nodes):
         assert "#0:" in text and "prompt_len=3" in text
         toks = text.split(":")[1].split("(")[0].split()
         assert len(toks) == 3 + 4                  # prompt + max_new
+        stats = sh.dispatch("lm-stats shelllm")
+        assert "completed=1" in stats and "tokens_generated=4" in stats
+        assert "live=0/2" in stats
         assert "stopped" in sh.dispatch("lm-stop shelllm")
     finally:
         nodes_d["n1"].control.close()
