@@ -111,6 +111,25 @@ def _pick_first(logits: jnp.ndarray, temp: jnp.ndarray,
     return _next_token(logits, temp, sub), nxt_key
 
 
+def _splice_rows(cache: Any, row_cache: Any, slot: jnp.ndarray) -> Any:
+    """Write a batch-1 prefill cache's K/V rows into row ``slot`` of a
+    pool cache. The two trees' structures differ only at the cursor leaves
+    (scalar "cursor" in the prefill cache vs caller-owned [S] "cursors"
+    in the pool) — K/V leaves match by path, everything else untouched."""
+    src = {jax.tree_util.keystr(p): leaf for p, leaf
+           in jax.tree_util.tree_flatten_with_path(row_cache)[0]}
+
+    def splice(path, dst):
+        if getattr(path[-1], "key", None) not in ("cached_k", "cached_v"):
+            return dst
+        kv = src[jax.tree_util.keystr(path)]          # [1, P, h, d]
+        dst_row = jax.lax.dynamic_update_slice(
+            dst[slot], kv[0], (0,) * kv[0].ndim)
+        return dst.at[slot].set(dst_row)
+
+    return jax.tree_util.tree_map_with_path(splice, cache)
+
+
 @partial(jax.jit, static_argnames=("prompt_len",), donate_argnums=(0, 1))
 def _insert(tokens: jnp.ndarray, cache: Any, row_cache: Any,
             prompt: jnp.ndarray, first_tok: jnp.ndarray,
@@ -124,23 +143,14 @@ def _insert(tokens: jnp.ndarray, cache: Any, row_cache: Any,
                                        (0,))
     row = row.at[true_len].set(first_tok)
     tokens = tokens.at[slot].set(row)
+    return tokens, _splice_rows(cache, row_cache, slot)
 
-    # the two caches' tree structures differ only at the cursor leaves
-    # (scalar "cursor" in the prefill cache vs caller-owned [S] "cursors"
-    # here) — match K/V leaves by path, leave everything else untouched
-    src = {jax.tree_util.keystr(p): leaf for p, leaf
-           in jax.tree_util.tree_flatten_with_path(row_cache)[0]}
 
-    def splice(path, dst):
-        if getattr(path[-1], "key", None) not in ("cached_k", "cached_v"):
-            return dst
-        kv = src[jax.tree_util.keystr(path)]          # [1, P, h, d]
-        dst_row = jax.lax.dynamic_update_slice(
-            dst[slot], kv[0], (0,) * kv[0].ndim)
-        return dst.at[slot].set(dst_row)
-
-    cache = jax.tree_util.tree_map_with_path(splice, cache)
-    return tokens, cache
+@partial(jax.jit, donate_argnums=(0,))
+def _insert_cache(cache: Any, row_cache: Any, slot: jnp.ndarray) -> Any:
+    """Cache-only splice (the draft model's prompt prefill — tokens were
+    already written by the target's `_insert`)."""
+    return _splice_rows(cache, row_cache, slot)
 
 
 class DecodeServer:
@@ -164,13 +174,38 @@ class DecodeServer:
     def __init__(self, model: TransformerLM, params: Any, *, slots: int,
                  prompt_len: int, max_len: int, decode_steps: int = 1,
                  quantize: str = "none", eos_id: int | None = None,
-                 mesh=None) -> None:
+                 mesh=None, draft: tuple | None = None,
+                 draft_len: int = 4) -> None:
         if not model.causal:
             raise ValueError("continuous batching needs a causal LM")
         if prompt_len > max_len:
             raise ValueError(f"prompt_len {prompt_len} > max_len {max_len}")
         if decode_steps < 1:
             raise ValueError(f"decode_steps {decode_steps} must be >= 1")
+        if draft is not None:
+            if decode_steps != 1:
+                raise ValueError("speculative decoding fuses its own "
+                                 "multi-token rounds; use decode_steps=1")
+            if draft_len < 1:
+                raise ValueError(f"draft_len {draft_len} must be >= 1")
+            if not draft[0].causal:
+                raise ValueError("the draft model must be causal")
+            if draft[0].vocab != model.vocab:
+                raise ValueError(
+                    f"draft vocab {draft[0].vocab} != target {model.vocab}")
+            if model.ffn_factory is not None:
+                # routed-FFN logits depend on the batch COMPOSITION (expert
+                # capacity is proportional to tokens-per-apply, so a γ+1
+                # verify chunk routes differently than token-by-token
+                # decode) — the verify would silently diverge from the
+                # target's own greedy stream, breaking the exactness
+                # contract. The DRAFT may be anything: proposals are only
+                # guesses the dense target verifies.
+                raise ValueError(
+                    "speculative decoding requires a dense target "
+                    "(routed-FFN logits are batch-composition-dependent, "
+                    "so chunked verification is not equivalent to "
+                    "per-token decode)")
         if quantize == "int8":
             # decode re-reads every weight per step — int8 residency halves
             # that HBM traffic; dequant happens inside the jitted programs
@@ -192,6 +227,14 @@ class DecodeServer:
                                         max_decode_len=max_len,
                                         decode_per_row=True)
         self._prefill_model = model
+
+        # speculative decoding: a cheap draft proposes draft_len tokens per
+        # round, the target verifies them all in ONE chunked apply; output
+        # is EXACTLY the target's own greedy sequence (greedy-only)
+        self.draft_len = draft_len
+        self._draft_model = self._draft_params = None
+        if draft is not None:
+            self._draft_model, self._draft_params = draft
 
         # mesh sharding: the pool's slot dimension spreads over the mesh's
         # data axis (every per-row decode op is elementwise over slots, so
@@ -230,6 +273,17 @@ class DecodeServer:
         self._remaining = zeros((slots,), jnp.int32)
         self._temps = zeros((slots,), jnp.float32)
         self._keys = zeros((slots, 2), jnp.uint32)       # per-row rng
+        self._draft_cache = None
+        if self._draft_model is not None:
+            ddec = dataclasses.replace(self._draft_model, decode=True,
+                                       decode_per_row=True)
+            dshapes = jax.eval_shape(
+                lambda: init_cache(ddec, slots, max_len))
+            self._draft_cache = jax.tree.map(
+                lambda s: zeros(s.shape, s.dtype), dshapes)
+            if mesh is not None:
+                from idunno_tpu.parallel.sharding import replicate
+                self._draft_params = replicate(mesh, self._draft_params)
 
         # host state
         self._queue: deque[Request] = deque()
@@ -239,6 +293,8 @@ class DecodeServer:
         self._stats = {"dispatches": 0, "admitted": 0, "completed": 0,
                        "tokens_generated": 0}
 
+        if self._draft_model is not None:
+            self._decode_spec = self._build_spec_round(draft_len)
         self._decode = self._build_decode(decode_steps)
 
     def _dec_for_init(self) -> TransformerLM:
@@ -292,6 +348,91 @@ class DecodeServer:
             return jax.jit(run, donate_argnums=(1, 2, 3, 4, 6))
         return jax.jit(run)
 
+    def _build_spec_round(self, gamma: int):
+        """One speculative round, all rows, one compiled program:
+
+          1. the draft runs ``gamma`` single-token steps → proposals;
+          2. the target verifies committed-last + all proposals in ONE
+             chunked per-row apply (γ+1 positions);
+          3. each row commits the longest proposal prefix the target
+             agrees with, plus the target's own next token — so every
+             round advances 1..γ+1 tokens and the committed stream is
+             EXACTLY the target's greedy sequence.
+
+        Rejected positions leave stale K/V in both caches strictly past
+        the new cursors; they are overwritten when those positions are
+        genuinely ingested (the standard per-row-cursor invariant)."""
+        dec = self._dec
+        ddec = dataclasses.replace(self._draft_model, decode=True,
+                                   max_decode_len=self.max_len,
+                                   decode_per_row=True)
+
+        def run(params, dparams, tokens, cache, dcache, cursors,
+                remaining):
+            params = dequantize_tree(params)
+            dparams = dequantize_tree(dparams)
+            active = remaining > 0
+            s = tokens.shape[0]
+            rows = jnp.arange(s)
+            prev = jnp.take_along_axis(tokens, cursors[:, None],
+                                       axis=1)[:, 0]        # [S]
+
+            # -- 1. draft: gamma greedy proposals ------------------------
+            def dbody(j, carry):
+                dcache, dcur, tok, props = carry
+                dcache = _set_cursors(dcache, dcur)
+                logits, mutated = ddec.apply(
+                    {"params": dparams, "cache": dcache},
+                    tok[:, None], mutable=["cache"])
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                return (mutated["cache"], dcur + 1, nxt,
+                        props.at[:, j].set(nxt))
+
+            props0 = jnp.zeros((s, gamma), jnp.int32)
+            dcache, _, _, proposals = jax.lax.fori_loop(
+                0, gamma, dbody, (dcache, cursors, prev, props0))
+
+            # -- 2. target: verify the whole chunk in one apply ----------
+            cache = _set_cursors(cache, cursors)
+            tin = jnp.concatenate([prev[:, None], proposals], axis=1)
+            logits, mutated = dec.apply(
+                {"params": params, "cache": cache}, tin, mutable=["cache"])
+            cache = mutated["cache"]
+            tpred = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S,γ+1]
+
+            # -- 3. acceptance + commit ----------------------------------
+            match = proposals == tpred[:, :gamma]            # [S, γ]
+            acc = jnp.cumprod(match.astype(jnp.int32),
+                              axis=1).sum(axis=1)            # [S] 0..γ
+            jidx = jnp.arange(gamma + 1)[None, :]
+            bonus = jnp.take_along_axis(tpred, acc[:, None], axis=1)
+            props_pad = jnp.concatenate(
+                [proposals, jnp.zeros((s, 1), jnp.int32)], axis=1)
+            cand = jnp.where(jidx < acc[:, None], props_pad,
+                             jnp.where(jidx == acc[:, None], bonus, 0))
+            commit = jnp.minimum(acc + 1, remaining)         # [S] ≥1 active
+            if self.eos_id is not None:
+                hit = (cand == self.eos_id) & (jidx < commit[:, None])
+                any_eos = hit.any(axis=1)
+                eos_pos = jnp.argmax(hit, axis=1)
+                commit = jnp.where(any_eos, eos_pos + 1, commit)
+                rem_after = jnp.where(any_eos, 0, remaining - commit)
+            else:
+                rem_after = remaining - commit
+            wpos = jnp.clip(cursors[:, None] + 1 + jidx, 0,
+                            self.max_len - 1)                # [S, γ+1]
+            old = jnp.take_along_axis(tokens, wpos, axis=1)
+            keep = (jidx < commit[:, None]) & active[:, None]
+            tokens = tokens.at[rows[:, None], wpos].set(
+                jnp.where(keep, cand, old))
+            cursors = jnp.where(active, cursors + commit, cursors)
+            remaining = jnp.where(active, rem_after, remaining)
+            return tokens, cache, dcache, cursors, remaining
+
+        if jax.devices()[0].platform == "tpu":
+            return jax.jit(run, donate_argnums=(2, 3, 4, 5, 6))
+        return jax.jit(run)
+
     # -- client surface ---------------------------------------------------
 
     def validate(self, tokens: list[int], max_new: int,
@@ -304,14 +445,21 @@ class DecodeServer:
         if len(tokens) > self.prompt_len:
             raise ValueError(f"prompt of {len(tokens)} tokens exceeds the "
                              f"prompt_len bucket {self.prompt_len}")
-        if len(tokens) + max_new > self.max_len:
+        headroom = (self.draft_len + 1 if self._draft_model is not None
+                    else 0)   # a verify chunk may overshoot the last token
+        if len(tokens) + max_new + headroom > self.max_len:
             raise ValueError(
-                f"{len(tokens)} prompt + {max_new} new > max_len "
-                f"{self.max_len}")
+                f"{len(tokens)} prompt + {max_new} new"
+                + (f" + {headroom} speculative headroom" if headroom
+                   else "")
+                + f" > max_len {self.max_len}")
         if max_new < 1:
             raise ValueError("max_new must be >= 1")
         if temperature < 0.0:
             raise ValueError(f"temperature {temperature} must be >= 0")
+        if temperature > 0.0 and self._draft_model is not None:
+            raise ValueError("speculative pools are greedy-only "
+                             "(temperature must be 0)")
 
     def submit(self, tokens: list[int], max_new: int, *,
                temperature: float = 0.0, seed: int | None = None) -> int:
@@ -378,6 +526,13 @@ class DecodeServer:
                 self._tokens, self._cache, row_cache, jnp.asarray(prompt),
                 first, jnp.int32(true_len), jnp.int32(slot),
                 self.prompt_len)
+            if self._draft_model is not None:
+                # the draft needs the prompt through ITS OWN weights
+                drow, _ = _prefill(self._draft_model, self._draft_params,
+                                   jnp.asarray(prompt),
+                                   jnp.int32(true_len), self.prompt_len)
+                self._draft_cache = _insert_cache(self._draft_cache, drow,
+                                                  jnp.int32(slot))
             self._cursors = self._cursors.at[slot].set(true_len)
             self._temps = self._temps.at[slot].set(temp)
             self._keys = self._keys.at[slot].set(key)
@@ -401,10 +556,17 @@ class DecodeServer:
         self._admit()
         self._retire_finished()           # max_new == 1 admissions
         if self._live:
-            (self._tokens, self._cache, self._cursors, self._remaining,
-             self._keys) = self._decode(
-                self.params, self._tokens, self._cache, self._cursors,
-                self._remaining, self._temps, self._keys)
+            if self._draft_model is not None:
+                (self._tokens, self._cache, self._draft_cache,
+                 self._cursors, self._remaining) = self._decode_spec(
+                    self.params, self._draft_params, self._tokens,
+                    self._cache, self._draft_cache, self._cursors,
+                    self._remaining)
+            else:
+                (self._tokens, self._cache, self._cursors,
+                 self._remaining, self._keys) = self._decode(
+                    self.params, self._tokens, self._cache, self._cursors,
+                    self._remaining, self._temps, self._keys)
             self._stats["dispatches"] += 1
             self._retire_finished()
         return len(self._live) + len(self._queue)

@@ -135,10 +135,13 @@ class MultiHeadAttention(nn.Module):
         scalar cursor, t=1: one token in, one out (``engine.generate``);
         scalar cursor, t>1: CHUNKED prefill — the whole prompt in one apply,
           K/V written at cursor..cursor+t-1, causal within the chunk;
-        per-row cursors (``decode_per_row``), t=1: continuous batching —
-          every batch row sits at its own depth, cursors are int32 [B] and
-          OWNED BY THE CALLER (read, never advanced here; the serving loop
-          advances only its live rows — `engine.serve_lm.DecodeServer`).
+        per-row cursors (``decode_per_row``): continuous batching — every
+          batch row sits at its own depth, cursors are int32 [B] and OWNED
+          BY THE CALLER (read, never advanced here; the serving loop
+          advances only its live rows — `engine.serve_lm.DecodeServer`);
+          t>1 is the per-row chunk: row r writes K/V at cursors[r]..
+          cursors[r]+t-1, causal within the chunk (speculative-decoding
+          verification feeds the whole draft in one apply).
 
         Uses its own cached softmax-attention kernel — any correct causal
         ``attn_fn`` (full/ring/flash) is numerically equivalent, so the
@@ -151,8 +154,6 @@ class MultiHeadAttention(nn.Module):
                              "(autoregressive serving of a bidirectional "
                              "model would silently change its semantics)")
         b, t, h, d = q.shape
-        if self.decode_per_row and t != 1:
-            raise ValueError(f"per-row decode takes one token, got {t}")
         ck = self.variable("cache", "cached_k", jnp.zeros,
                            (b, self.max_decode_len, h, d), k.dtype)
         cv = self.variable("cache", "cached_v", jnp.zeros,
@@ -161,24 +162,26 @@ class MultiHeadAttention(nn.Module):
             cur = self.variable("cache", "cursors",
                                 lambda: jnp.zeros((b,), jnp.int32))
             i = cur.value                                  # [B]
-            pos = i[:, None].astype(jnp.float32)           # [B, 1]
+            # per-row positions [B, t]: row r covers i[r]..i[r]+t-1
+            pos_bt = i[:, None] + jnp.arange(t)[None, :]
             # overflow guard: keep the cache intact and poison the scores
             # to NaN so misuse is loud, not silent
-            overflow = i >= self.max_decode_len            # [B]
+            overflow = i + t > self.max_decode_len         # [B]
             if self.use_rope:
-                q, k = rope(q, positions=pos), rope(k, positions=pos)
-            slot = jnp.clip(i, 0, self.max_decode_len - 1)
+                p = pos_bt.astype(jnp.float32)
+                q, k = rope(q, positions=p), rope(k, positions=p)
+            slot = jnp.clip(pos_bt, 0, self.max_decode_len - 1)  # [B, t]
             rows = jnp.arange(b)
-            new_k = ck.value.at[rows, slot].set(k[:, 0])
-            new_v = cv.value.at[rows, slot].set(v[:, 0])
+            new_k = ck.value.at[rows[:, None], slot].set(k)
+            new_v = cv.value.at[rows[:, None], slot].set(v)
             ovr = overflow[:, None, None, None]
             new_k = jnp.where(ovr, ck.value, new_k)
             new_v = jnp.where(ovr, cv.value, new_v)
             if not self.is_initializing():  # init returns a CLEAN cache;
                 ck.value, cv.value = new_k, new_v   # cursors: caller-owned
-            # [B, 1, T] → broadcast over heads
-            mask = (jnp.arange(self.max_decode_len)[None, :]
-                    <= i[:, None])[:, None, None, :]
+            # [B, 1, t, T]: row r's chunk position j attends slots ≤ i[r]+j
+            mask = (jnp.arange(self.max_decode_len)[None, None, :]
+                    <= pos_bt[:, :, None])[:, None, :, :]
             poison = overflow[:, None, None, None]
         else:
             cur = self.variable("cache", "cursor",

@@ -204,6 +204,11 @@ class ControlService:
                 if old is not None:
                     old.stop()
                 model, params = load_lm(node.store, name)
+                draft = None
+                if p.get("draft"):
+                    # speculative decoding: the draft is another
+                    # store-persisted LM (typically a much smaller one)
+                    draft = load_lm(node.store, p["draft"])
                 server = DecodeServer(
                     model, params,
                     slots=int(p.get("slots", 4)),
@@ -212,7 +217,9 @@ class ControlService:
                     decode_steps=int(p.get("decode_steps", 1)),
                     quantize=p.get("quantize", "none"),
                     eos_id=(int(p["eos_id"])
-                            if p.get("eos_id") is not None else None))
+                            if p.get("eos_id") is not None else None),
+                    draft=draft,
+                    draft_len=int(p.get("draft_len", 4)))
                 loop = LMServingLoop(server, name=f"{node.host}-{name}")
             except BaseException:
                 with self._reg_lock:

@@ -237,6 +237,59 @@ def test_continuous_batching_served_over_control_rpc(stores):
         ctl.close()
 
 
+def test_speculative_pool_over_rpc(stores):
+    """lm_serve with draft=<another stored LM>: speculative continuous
+    batching over RPC, exact vs local generate from the target."""
+    import time
+
+    from idunno_tpu.comm.message import Message
+    from idunno_tpu.engine.generate import save_lm
+    from idunno_tpu.serve.control import ControlService
+    from idunno_tpu.utils.types import MessageType
+
+    target = TransformerLM(vocab=32, dim=32, depth=2, num_heads=4)
+    tparams = target.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    draft = TransformerLM(vocab=32, dim=16, depth=1, num_heads=2)
+    dparams = draft.init(jax.random.PRNGKey(1),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    save_lm(stores["n0"], "spec-target", target, tparams)
+    save_lm(stores["n0"], "spec-draft", draft, dparams)
+
+    node = type("NodeStub", (), {})()
+    node.host, node.store = "n1", stores["n1"]
+    node.transport = stores["n1"].transport
+    ctl = ControlService(node)
+
+    def call(payload):
+        return ctl._handle("control", Message(
+            MessageType.INFERENCE, "client", payload))
+
+    try:
+        out = call({"verb": "lm_serve", "name": "spec-target",
+                    "draft": "spec-draft", "draft_len": 3,
+                    "slots": 2, "prompt_len": 4, "max_len": 24})
+        assert out.type is MessageType.ACK, out.payload
+        prompt = [3, 9, 14]
+        out = call({"verb": "lm_submit", "name": "spec-target",
+                    "prompt": prompt, "max_new": 8})
+        assert out.type is MessageType.ACK, out.payload
+        rid, got = out.payload["id"], None
+        deadline = time.time() + 60.0
+        while time.time() < deadline and got is None:
+            for c in call({"verb": "lm_poll",
+                           "name": "spec-target"}).payload["completions"]:
+                if c["id"] == rid:
+                    got = c
+            time.sleep(0.05)
+        assert got is not None
+        want = generate(target, tparams, jnp.asarray([prompt], jnp.int32),
+                        prompt_len=3, max_new=8)
+        assert got["tokens"] == [int(t) for t in np.asarray(want[0])]
+    finally:
+        ctl.close()
+
+
 def test_train_job_over_rpc_then_serve(stores):
     """The whole LM story with NO out-of-band steps: publish a corpus into
     the store → train_start over the control RPC (background job,

@@ -189,6 +189,95 @@ def test_per_request_sampling(lm):
     assert all(0 <= t < VOCAB for t in a[7][3:])
 
 
+def test_speculative_decoding_exact_and_fewer_dispatches(lm):
+    """Speculative decoding's contract: the committed stream is EXACTLY
+    the target's own greedy sequence, for any draft. With draft == target
+    every proposal is accepted, so each round commits draft_len+1 tokens
+    and dispatch count collapses accordingly."""
+    model, params = lm
+    rng = np.random.default_rng(9)
+    reqs = [([int(t) for t in rng.integers(0, VOCAB, size=n)], m)
+            for n, m in [(3, 12), (6, 9), (2, 14), (5, 8)]]
+
+    # draft == target: full acceptance, big dispatch win
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=40,
+                       draft=(model, params), draft_len=3)
+    ids = {srv.submit(p, m): (p, m) for p, m in reqs}
+    done = srv.run_until_drained()
+    assert {c.id for c in done} == set(ids)
+    for c in done:
+        p, m = ids[c.id]
+        assert c.tokens == expected(model, params, p, m), \
+            f"speculative output diverged from target greedy (req {c.id})"
+    stats = srv.stats()
+    # 4 requests x ~11 avg tokens ≈ 43 generated; full acceptance commits
+    # draft_len+1 = 4/round/row → far fewer dispatches than tokens
+    assert stats["tokens_generated"] >= 40
+    assert stats["dispatches"] * 2 < stats["tokens_generated"], stats
+
+    # an unrelated (differently-initialized) draft: still EXACT, whatever
+    # its acceptance rate
+    weak = TransformerLM(vocab=VOCAB, dim=16, depth=1, num_heads=2)
+    weak_params = weak.init(jax.random.PRNGKey(42),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+    srv2 = DecodeServer(model, params, slots=2, prompt_len=8, max_len=40,
+                        draft=(weak, weak_params), draft_len=3)
+    ids2 = {srv2.submit(p, m): (p, m) for p, m in reqs}
+    for c in srv2.run_until_drained():
+        p, m = ids2[c.id]
+        assert c.tokens == expected(model, params, p, m), \
+            f"weak-draft speculative output diverged (req {c.id})"
+
+
+def test_speculative_respects_eos(lm):
+    model, params = lm
+    prompt = [9, 21, 3]
+    full = expected(model, params, prompt, 12)
+    eos = full[len(prompt) + 5]
+    cut = full[:full.index(eos, len(prompt)) + 1]
+    srv = DecodeServer(model, params, slots=1, prompt_len=4, max_len=40,
+                       draft=(model, params), draft_len=3, eos_id=eos)
+    srv.submit(prompt, max_new=12)
+    assert srv.run_until_drained()[0].tokens == cut
+
+
+def test_speculative_validation(lm):
+    model, params = lm
+    srv = DecodeServer(model, params, slots=1, prompt_len=4, max_len=12,
+                       draft=(model, params), draft_len=3)
+    with pytest.raises(ValueError, match="greedy-only"):
+        srv.submit([1], max_new=2, temperature=0.5)
+    with pytest.raises(ValueError, match="headroom"):
+        srv.submit([1, 2], max_new=7)     # 2+7+4 > 12
+    srv.submit([1, 2], max_new=6)         # 2+6+4 = 12 fits
+    with pytest.raises(ValueError, match="decode_steps"):
+        DecodeServer(model, params, slots=1, prompt_len=4, max_len=16,
+                     draft=(model, params), decode_steps=2)
+    bad_vocab = TransformerLM(vocab=VOCAB + 1, dim=16, depth=1,
+                              num_heads=2)
+    with pytest.raises(ValueError, match="vocab"):
+        DecodeServer(model, params, slots=1, prompt_len=4, max_len=16,
+                     draft=(bad_vocab, params))
+    # MoE TARGETS are rejected: routed-FFN logits are batch-composition-
+    # dependent, so the chunked verify would silently diverge from the
+    # target's own per-token greedy stream
+    from idunno_tpu.models.moe import MoETransformerLM
+    moe = MoETransformerLM(vocab=VOCAB, dim=16, depth=1, num_heads=2,
+                           n_experts=2)
+    moe_params = moe.init(jax.random.PRNGKey(3),
+                          jnp.zeros((1, 4), jnp.int32))["params"]
+    with pytest.raises(ValueError, match="dense target"):
+        DecodeServer(moe, moe_params, slots=1, prompt_len=4, max_len=16,
+                     draft=(model, params))
+    # ...but an MoE DRAFT is fine (proposals are only guesses)
+    srv_moe_draft = DecodeServer(model, params, slots=1, prompt_len=4,
+                                 max_len=20, draft=(moe, moe_params),
+                                 draft_len=2)
+    srv_moe_draft.submit([1, 2], max_new=6)
+    got = srv_moe_draft.run_until_drained()[0]
+    assert got.tokens == expected(model, params, [1, 2], 6)
+
+
 def test_submit_validation(lm):
     model, params = lm
     srv = DecodeServer(model, params, slots=1, prompt_len=4, max_len=8)
