@@ -175,11 +175,26 @@ class DecodeServer:
                  prompt_len: int, max_len: int, decode_steps: int = 1,
                  quantize: str = "none", eos_id: int | None = None,
                  mesh=None, draft: tuple | None = None,
-                 draft_len: int = 4) -> None:
+                 draft_len: int = 4,
+                 prompt_buckets: tuple[int, ...] | None = None) -> None:
         if not model.causal:
             raise ValueError("continuous batching needs a causal LM")
         if prompt_len > max_len:
             raise ValueError(f"prompt_len {prompt_len} > max_len {max_len}")
+        # static-shape buckets: each admission prefills at the SMALLEST
+        # bucket covering its true length (one compile per bucket) instead
+        # of padding every prompt to prompt_len — short prompts stop paying
+        # the long bucket's prefill FLOPs
+        self.prompt_buckets = tuple(sorted(set(prompt_buckets or ())))
+        if self.prompt_buckets:
+            if self.prompt_buckets[-1] != prompt_len:
+                raise ValueError(
+                    f"largest prompt bucket {self.prompt_buckets[-1]} must "
+                    f"equal prompt_len {prompt_len}")
+            if self.prompt_buckets[0] < 1:
+                raise ValueError("prompt buckets must be >= 1")
+        else:
+            self.prompt_buckets = (prompt_len,)
         if decode_steps < 1:
             raise ValueError(f"decode_steps {decode_steps} must be >= 1")
         if draft is not None:
@@ -223,9 +238,7 @@ class DecodeServer:
         # freed slot admits the next queued prompt at the following step
         self.eos_id = eos_id
 
-        self._dec = dataclasses.replace(model, decode=True,
-                                        max_decode_len=max_len,
-                                        decode_per_row=True)
+        self._dec = self._per_row_decode(model, max_len)
         self._prefill_model = model
 
         # speculative decoding: a cheap draft proposes draft_len tokens per
@@ -275,8 +288,7 @@ class DecodeServer:
         self._keys = zeros((slots, 2), jnp.uint32)       # per-row rng
         self._draft_cache = None
         if self._draft_model is not None:
-            ddec = dataclasses.replace(self._draft_model, decode=True,
-                                       decode_per_row=True)
+            ddec = self._per_row_decode(self._draft_model)
             dshapes = jax.eval_shape(
                 lambda: init_cache(ddec, slots, max_len))
             self._draft_cache = jax.tree.map(
@@ -297,9 +309,17 @@ class DecodeServer:
             self._decode_spec = self._build_spec_round(draft_len)
         self._decode = self._build_decode(decode_steps)
 
+    @staticmethod
+    def _per_row_decode(model: TransformerLM,
+                        max_len: int = 0) -> TransformerLM:
+        """The per-row-cursor decode twin of ``model`` (max_len 0 = leave
+        for `init_cache` to set) — single source for every decode-mode
+        replace (pool, draft cache, speculative round)."""
+        return dataclasses.replace(model, decode=True, decode_per_row=True,
+                                   max_decode_len=max_len)
+
     def _dec_for_init(self) -> TransformerLM:
-        return dataclasses.replace(self.model, decode=True,
-                                   decode_per_row=True)
+        return self._per_row_decode(self.model)
 
     def _build_decode(self, n_steps: int):
         dec = self._dec
@@ -363,9 +383,7 @@ class DecodeServer:
         the new cursors; they are overwritten when those positions are
         genuinely ingested (the standard per-row-cursor invariant)."""
         dec = self._dec
-        ddec = dataclasses.replace(self._draft_model, decode=True,
-                                   max_decode_len=self.max_len,
-                                   decode_per_row=True)
+        ddec = self._per_row_decode(self._draft_model, self.max_len)
 
         def run(params, dparams, tokens, cache, dcache, cursors,
                 remaining):
@@ -513,24 +531,24 @@ class DecodeServer:
             slot = free.pop(0)
             req = self._queue.popleft()
             true_len = len(req.tokens)
-            prompt = np.zeros((1, self.prompt_len), np.int32)
+            bucket = next(b for b in self.prompt_buckets if b >= true_len)
+            prompt = np.zeros((1, bucket), np.int32)
             prompt[0, :true_len] = req.tokens
             row_cache, last_logits = _prefill(
                 self._prefill_model, self.params, jnp.asarray(prompt),
-                jnp.int32(true_len), self.prompt_len)
+                jnp.int32(true_len), bucket)
             temp = jnp.float32(req.temperature)
             seed = req.id if req.seed is None else req.seed
             first, key = _pick_first(last_logits, temp,
                                      jax.random.PRNGKey(seed))
             self._tokens, self._cache = _insert(
                 self._tokens, self._cache, row_cache, jnp.asarray(prompt),
-                first, jnp.int32(true_len), jnp.int32(slot),
-                self.prompt_len)
+                first, jnp.int32(true_len), jnp.int32(slot), bucket)
             if self._draft_model is not None:
                 # the draft needs the prompt through ITS OWN weights
                 drow, _ = _prefill(self._draft_model, self._draft_params,
                                    jnp.asarray(prompt),
-                                   jnp.int32(true_len), self.prompt_len)
+                                   jnp.int32(true_len), bucket)
                 self._draft_cache = _insert_cache(self._draft_cache, drow,
                                                   jnp.int32(slot))
             self._cursors = self._cursors.at[slot].set(true_len)

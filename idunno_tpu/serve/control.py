@@ -219,7 +219,10 @@ class ControlService:
                     eos_id=(int(p["eos_id"])
                             if p.get("eos_id") is not None else None),
                     draft=draft,
-                    draft_len=int(p.get("draft_len", 4)))
+                    draft_len=int(p.get("draft_len", 4)),
+                    prompt_buckets=(tuple(int(b) for b
+                                          in p["prompt_buckets"])
+                                    if p.get("prompt_buckets") else None))
                 loop = LMServingLoop(server, name=f"{node.host}-{name}")
             except BaseException:
                 with self._reg_lock:

@@ -154,7 +154,6 @@ def test_pool_shards_over_mesh(lm, eight_devices):
         p, m = ids[c.id]
         assert c.tokens == expected(model, params, p, m), c.id
 
-    import pytest
     with pytest.raises(ValueError, match="divide"):
         DecodeServer(model, params, slots=n + 1, prompt_len=4, max_len=8,
                      mesh=mesh)
@@ -227,6 +226,40 @@ def test_speculative_decoding_exact_and_fewer_dispatches(lm):
         p, m = ids2[c.id]
         assert c.tokens == expected(model, params, p, m), \
             f"weak-draft speculative output diverged (req {c.id})"
+
+
+def test_prompt_buckets_exact_across_slot_reuse(lm):
+    """Multi-bucket prefill: each admission uses the smallest bucket
+    covering its prompt; outputs stay exact when a long-prompt request
+    reuses a slot that previously held a short one and vice versa (stale
+    cache/tokens beyond the bucket must never leak)."""
+    model, params = lm
+    srv = DecodeServer(model, params, slots=1, prompt_len=8, max_len=24,
+                       prompt_buckets=(2, 4, 8))
+    rng = np.random.default_rng(11)
+    lens = [2, 7, 1, 8, 3, 5]              # hits all three buckets
+    ids = {}
+    for n in lens:
+        p = [int(t) for t in rng.integers(0, VOCAB, size=n)]
+        ids[srv.submit(p, max_new=6)] = p
+    for c in srv.run_until_drained():
+        assert c.tokens == expected(model, params, ids[c.id], 6), \
+            f"bucketed prefill diverged for prompt len {len(ids[c.id])}"
+
+    # speculative + buckets compose
+    spec = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                        prompt_buckets=(4, 8), draft=(model, params),
+                        draft_len=2)
+    ids2 = {}
+    for n in (3, 8, 2, 6):
+        p = [int(t) for t in rng.integers(0, VOCAB, size=n)]
+        ids2[spec.submit(p, max_new=5)] = p
+    for c in spec.run_until_drained():
+        assert c.tokens == expected(model, params, ids2[c.id], 5)
+
+    with pytest.raises(ValueError, match="largest prompt bucket"):
+        DecodeServer(model, params, slots=1, prompt_len=8, max_len=24,
+                     prompt_buckets=(2, 4))
 
 
 def test_speculative_respects_eos(lm):
