@@ -282,6 +282,15 @@ class InferenceEngine:
 
         if self.store is None:
             raise ValueError("engine has no store attached")
+        if self.config.quantize != "none":
+            # a quantized engine only holds int8 weights; dequantizing them
+            # would publish lossy round-tripped values as the cluster's
+            # canonical full-precision checkpoint, silently degrading every
+            # consumer — publish from an unquantized engine instead
+            raise ValueError(
+                f"refusing to publish from a quantize={self.config.quantize!r}"
+                " engine: its weights are lossy; publish from an engine with"
+                " quantize='none'")
         self.load(name)
         m = self._models[name]
         if m.provenance == "random" and not allow_random:
@@ -289,15 +298,7 @@ class InferenceEngine:
                 f"refusing to publish RANDOM weights for {name!r}; load a "
                 "pretrained/trained checkpoint first or pass "
                 "allow_random=True (test/demo clusters only)")
-        variables = m.variables
-        if self.config.quantize == "int8":
-            # published checkpoints are always full precision (consumers
-            # choose their own quantization; a QTensor tree would not match
-            # their deserialization template)
-            from idunno_tpu.ops.quantize import dequantize_tree
-            variables = dequantize_tree(
-                variables, dtype=jnp.dtype(self.config.param_dtype))
-        return save_variables(self.store, name, variables)
+        return save_variables(self.store, name, m.variables)
 
     def weights_provenance(self, name: str) -> str:
         """"pretrained" | "store" | "random" for an already-loaded model;

@@ -460,6 +460,13 @@ class DecodeServer:
         loop validates on the caller's thread with this)."""
         if not tokens:
             raise ValueError("empty prompt")
+        for t in tokens:
+            # out-of-range ids would be silently clamped by the embedding
+            # gather on TPU, producing a plausible-looking but meaningless
+            # completion — fail on the caller's thread instead
+            if not 0 <= t < self.model.vocab:
+                raise ValueError(f"prompt token {t} outside vocab "
+                                 f"[0, {self.model.vocab})")
         if len(tokens) > self.prompt_len:
             raise ValueError(f"prompt of {len(tokens)} tokens exceeds the "
                              f"prompt_len bucket {self.prompt_len}")

@@ -26,7 +26,8 @@ class LMServingLoop:
     def __init__(self, server: DecodeServer, name: str = "lm") -> None:
         self.server = server
         self._lock = threading.Lock()
-        self._inbox: list[tuple[int, list[int], int]] = []  # (id, toks, new)
+        # (id, toks, max_new, temperature, seed)
+        self._inbox: list[tuple[int, list[int], int, float, int | None]] = []
         self._outbox: list[Completion] = []
         self._next_id = 0
         self._id_map: dict[int, int] = {}     # server-side id → public id

@@ -102,9 +102,11 @@ def test_decode_server_int8_matches_generate_on_dequantized(eight_devices):
     assert got.tokens == [int(t) for t in np.asarray(want[0])]
 
 
-def test_int8_engine_publishes_full_precision(eight_devices, tmp_path):
-    """An int8 engine must publish FULL-precision weights (a QTensor tree
-    would not match any consumer's deserialization template)."""
+def test_int8_engine_refuses_publish(eight_devices, tmp_path):
+    """An int8 engine only holds lossy weights; publishing them would make
+    a degraded round-trip the cluster's canonical full-precision
+    checkpoint. It must refuse — full-precision engines publish, quantized
+    engines consume (ADVICE r2: engine/inference.py publish path)."""
     from idunno_tpu.config import EngineConfig
     from idunno_tpu.engine.inference import InferenceEngine
     from idunno_tpu.parallel.mesh import local_mesh
@@ -115,10 +117,15 @@ def test_int8_engine_publishes_full_precision(eight_devices, tmp_path):
                         quantize="int8")
     pub = InferenceEngine(qcfg, mesh=local_mesh(), seed=0,
                           pretrained=False, store=stores["n0"])
-    pub.publish_weights("alexnet", allow_random=True)
+    with pytest.raises(ValueError, match="lossy"):
+        pub.publish_weights("alexnet", allow_random=True)
 
-    cfg = EngineConfig(batch_size=8, image_size=64, resize_size=64)
-    con = InferenceEngine(cfg, mesh=local_mesh(), seed=999,
+    # the supported direction: full-precision publisher → int8 consumer
+    fcfg = EngineConfig(batch_size=8, image_size=64, resize_size=64)
+    full = InferenceEngine(fcfg, mesh=local_mesh(), seed=0,
+                           pretrained=False, store=stores["n0"])
+    full.publish_weights("alexnet", allow_random=True)
+    con = InferenceEngine(qcfg, mesh=local_mesh(), seed=999,
                           pretrained=True, store=stores["n1"])
     con.load("alexnet")
     assert con.weights_provenance("alexnet") == "store"
