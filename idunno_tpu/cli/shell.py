@@ -57,10 +57,14 @@ HELP = """\
   cq   per-query task assignment map
   train <name> <corpus> <steps> [k=v ...]   background LM training job
        (model: vocab/dim/depth/num_heads; batch_size seq_len lr
-        checkpoint_every seed resume=1)
+        checkpoint_every seed resume=1; place=1 = master-placed,
+        auto-resumed on another node if its host dies)
   train-status <name> | train-stop <name>
   lm-serve <name> <prompt_len> <max_len> [k=v ...]  continuous-batching pool
-       (slots decode_steps quantize=int8 eos_id=N)
+       (slots decode_steps quantize=int8 eos_id=N draft=<lm> draft_len=N;
+        draft pools are GREEDY-ONLY — temperature>0 submits are rejected;
+        place=1 = cluster-managed: master-placed, requests journaled to
+        the standby, pool+requests recovered if its node dies)
   lm-submit <name> <max_new> [temperature= seed=] <tok> [tok ...]
        queue a prompt -> request id (temperature 0=greedy, >0 sampled)
   lm-poll <name> | lm-stats <name> | lm-stop <name>
@@ -258,6 +262,20 @@ class Shell:
                 f"image_rate={svc.metrics.image_rate(m):.1f}/s "
                 f"finished_images={svc.metrics.finished_images(m)} "
                 f"finished_queries={svc.metrics.finished_queries(m)}")
+        # heterogeneous fair share: how the worker units currently divide
+        # between CNN query jobs and LM decode pools (measured rates)
+        mgr = getattr(self.node, "lm_manager", None)
+        if mgr is not None and mgr.managed_pools():
+            view = mgr.allocation_view()
+            rows.append(f"fair share (rate_factor={view['rate_factor']}, "
+                        f"workers={view['n_workers']}):")
+            for job, d in sorted(view["jobs"].items()):
+                meas = (f"avg_query_s={d['avg_query_s']}"
+                        if "avg_query_s" in d else
+                        f"avg_request_s={d['avg_request_s']} "
+                        f"avg_token_s={d['avg_token_s']} "
+                        f"slots={d['slots']}")
+                rows.append(f"  {job}: {meas} share={d['share']}")
         return "\n".join(rows) or "(no queries yet)"
 
     def cmd_c2(self, args: list[str]) -> str:
@@ -341,11 +359,15 @@ class Shell:
             payload["lr"] = float(kv.pop("lr"))
         if "resume" in kv:
             payload["resume"] = kv.pop("resume") not in ("0", "false", "")
+        if "place" in kv and kv.pop("place") not in ("0", "false", ""):
+            payload["placement"] = "auto"   # master-placed, auto-resumed
         if kv:
             return f"unknown train option(s): {sorted(kv)}"
-        self._control("train_start", name=name, corpus=corpus, steps=steps,
-                      model=model, **payload)
-        return f"training job {name} started ({steps} steps on {corpus})"
+        out = self._control("train_start", name=name, corpus=corpus,
+                            steps=steps, model=model, **payload)
+        where = f" on {out['node']}" if out.get("node") else ""
+        return (f"training job {name} started{where} "
+                f"({steps} steps on {corpus})")
 
     def cmd_train_status(self, args: list[str]) -> str:
         if len(args) != 1:
@@ -371,12 +393,22 @@ class Shell:
         if len(args) < 3:
             return ("usage: lm-serve <name> <prompt_len> <max_len> "
                     "[slots= decode_steps= quantize=int8 eos_id=N "
-                    "reload=1]")
+                    "draft=<lm> draft_len=N place=1 reload=1]\n"
+                    "note: draft (speculative) pools are greedy-only — "
+                    "submits with temperature>0 are rejected")
         kv = self._kv(args[3:])
         payload = {k: int(kv.pop(k))
-                   for k in ("slots", "decode_steps", "eos_id") if k in kv}
+                   for k in ("slots", "decode_steps", "eos_id",
+                             "draft_len") if k in kv}
         if "quantize" in kv:
             payload["quantize"] = kv.pop("quantize")
+        if "draft" in kv:
+            payload["draft"] = kv.pop("draft")
+        if "place" in kv and kv.pop("place") not in ("0", "false", ""):
+            # cluster-managed pool: the acting master places it on the
+            # least-loaded node, journals requests, and recovers it (with
+            # its unfinished requests) if its node dies
+            payload["placement"] = "auto"
         if "reload" in kv:
             payload["reload"] = kv.pop("reload") not in ("0", "false", "")
         if kv:
@@ -386,7 +418,8 @@ class Shell:
                             **payload)
         if out.get("already"):
             return f"{args[0]} already serving (pass reload=1 to restart)"
-        return f"serving {args[0]} with {out['slots']} slots"
+        where = f" on {out['node']}" if out.get("node") else ""
+        return f"serving {args[0]} with {out['slots']} slots{where}"
 
     def cmd_lm_submit(self, args: list[str]) -> str:
         if len(args) < 3:
@@ -419,6 +452,17 @@ class Shell:
         if len(args) != 1:
             return "usage: lm-stats <name>"
         s = self._control("lm_stats", name=args[0])["stats"]
+        if "journal" in s:              # cluster-managed pool
+            j = s["journal"]
+            head = (f"{args[0]}: node={s['node']} "
+                    f"pending={j['pending']} inflight={j['inflight']} "
+                    f"done={j['done']} failed={j['failed']}")
+            p = s.get("pool")
+            if not p:
+                return head + f" (pool: {s.get('pool_error', 'n/a')})"
+            return (head + f" | live={p['live']}/{p['slots']} "
+                    f"completed={p['completed']} "
+                    f"tokens_generated={p['tokens_generated']}")
         return (f"{args[0]}: live={s['live']}/{s['slots']} "
                 f"queued={s['queued']} inbox={s['inbox']} "
                 f"unpolled={s['unpolled']} admitted={s['admitted']} "

@@ -44,6 +44,23 @@ def fair_shares(avg_query_time: dict[str, float], rate_factor: int,
     return shares
 
 
+def heterogeneous_shares(cnn_query_s: dict[str, float],
+                         lm_request_s: dict[str, float],
+                         rate_factor: int,
+                         n_workers: int) -> dict[str, int]:
+    """The reference's two-model ratio formula (`mp4_machinelearning.py
+    :501-539`) generalized across JOB TYPES: CNN query jobs (measured avg
+    seconds per query) and LM decode pools (measured avg seconds per
+    request) divide the cluster's worker units proportionally to measured
+    per-unit cost, so every job — whatever its type — makes equal
+    wall-clock progress. Keys come back namespaced ``cnn:<model>`` /
+    ``lm:<pool>``; a job with no history yet weighs as the mean of the
+    others, exactly like the reference's no-data ratio 1.0."""
+    times = {f"cnn:{m}": t for m, t in cnn_query_s.items()}
+    times.update({f"lm:{p}": t for p, t in lm_request_s.items()})
+    return fair_shares(times, rate_factor, n_workers)
+
+
 def split_range(start: int, end: int, workers: list[str]) -> list[tuple[str, int, int]]:
     """Contiguous near-even split of the inclusive range across workers
     (`:523-536`: per step, round(remaining_items / remaining_workers))."""
@@ -72,6 +89,11 @@ class FairScheduler:
         self.book = TaskBook()
         # measured avg query seconds per model — fed by the metrics layer
         self.avg_query_time: dict[str, float] = {}
+        # non-CNN jobs sharing the cluster (namespaced keys, e.g.
+        # "lm:<pool>" → measured avg seconds per request) — fed by the LM
+        # pool manager; they weigh in the fair share but are never
+        # assigned CNN tasks
+        self.extra_jobs: dict[str, float] = {}
 
     def active_models(self) -> list[str]:
         """Models with unfinished work (the 'concurrent jobs' the fair share
@@ -87,6 +109,10 @@ class FairScheduler:
         times = dict(self.avg_query_time)
         for m in {model, *self.active_models()}:
             times.setdefault(m, 0.0)
+        # heterogeneous arbitration: live LM pools claim their measured
+        # share of the worker units, shrinking every CNN job's slice
+        # proportionally (reference formula over the job UNION)
+        times.update(self.extra_jobs)
         shares = fair_shares(times, self.config.rate_factor, len(workers))
         n = max(1, min(shares.get(model, 1), len(workers),
                        end - start + 1))

@@ -80,6 +80,9 @@ class ControlService:
 
     def _dispatch(self, verb: str, p: dict) -> dict:
         node = self.node
+        routed = self._route_cluster(verb, p)
+        if routed is not None:
+            return routed
         if verb == "status":
             members = {e.host: e.status.value
                        for e in node.membership.members.entries()}
@@ -146,7 +149,13 @@ class ControlService:
                     "processing": ps.as_list() if ps else None,
                     "weights": provenance.get(model, "unknown"),
                 }
-            return {"stats": out}
+            reply = {"stats": out}
+            mgr = getattr(node, "lm_manager", None)
+            if mgr is not None and mgr.managed_pools():
+                # heterogeneous fair-share arbitration (CNN jobs vs LM
+                # pools, measured per-query/per-request rates)
+                reply["allocation"] = mgr.allocation_view()
+            return reply
         if verb == "grep":
             return {"matches": node.grep.query(p["pattern"])}
         if verb == "generate":
@@ -320,6 +329,48 @@ class ControlService:
             # False when the job had already finished)
             return {"stopped": True, "status": job.status()}
         raise ValueError(f"unknown control verb {verb!r}")
+
+    def _route_cluster(self, verb: str, p: dict) -> dict | None:
+        """Cluster-managed LM tier (serve/lm_manager.py): placement verbs
+        carry ``placement="auto"`` and MUST land on the acting master;
+        follow-up verbs route to the manager whenever it owns the name.
+        ``local=True`` (set by the manager's own node-to-node RPCs) pins
+        the node-local tier, so a managed pool's host still answers the
+        manager. None = not a cluster-routed call, fall through."""
+        mgr = getattr(self.node, "lm_manager", None)
+        if mgr is None or p.get("local"):
+            return None
+        placed = (p.get("placement") == "auto"
+                  and verb in ("lm_serve", "train_start"))
+        if placed:
+            master = self.node.membership.acting_master()
+            if master != self.node.host:
+                raise ValueError(
+                    f"placement=auto must go to the acting master "
+                    f"({master}), not {self.node.host}")
+            return (mgr.serve(p) if verb == "lm_serve"
+                    else mgr.train(p))
+        name = p.get("name")
+        if verb in ("lm_submit", "lm_poll", "lm_stats", "lm_stop") \
+                and mgr.has_pool(name):
+            if verb == "lm_submit":
+                rid = mgr.submit(name, [int(t) for t in p["prompt"]],
+                                 int(p["max_new"]),
+                                 temperature=float(
+                                     p.get("temperature", 0.0)),
+                                 seed=(int(p["seed"])
+                                       if p.get("seed") is not None
+                                       else None))
+                return {"id": rid}
+            if verb == "lm_poll":
+                return mgr.poll(name)
+            if verb == "lm_stats":
+                return {"stats": mgr.stats(name)}
+            return mgr.stop(name)
+        if verb in ("train_status", "train_stop") and mgr.has_job(name):
+            return (mgr.train_status(name) if verb == "train_status"
+                    else mgr.train_stop(name))
+        return None
 
     def _lm_loop(self, name: str):
         with self._reg_lock:

@@ -34,12 +34,13 @@ SERVICE = "metadata"
 class FailoverManager:
     def __init__(self, host: str, config: ClusterConfig,
                  transport: Transport, membership: MembershipService,
-                 service: InferenceService) -> None:
+                 service: InferenceService, lm_manager=None) -> None:
         self.host = host
         self.config = config
         self.transport = transport
         self.membership = membership
         self.service = service
+        self.lm_manager = lm_manager    # serve/lm_manager.LMPoolManager
         self._lock = threading.RLock()
         self._seq = 0
         self._received: dict[str, Any] | None = None
@@ -57,11 +58,17 @@ class FailoverManager:
                        for (m, q), v in svc._results.items()}
             qnum = dict(svc._qnum)
         self._seq += 1
-        return {"seq": self._seq,
+        snap = {"seq": self._seq,
                 "tasks": svc.scheduler.book.to_wire(),
                 "qnum": qnum,
                 "metrics": svc.metrics.to_wire(),
                 "results": results}
+        if self.lm_manager is not None:
+            # LM pool registry + request journal ride the same snapshot,
+            # so decode pools and train jobs survive a coordinator death
+            # exactly like the CNN task book (round-2 VERDICT item 3)
+            snap["lm"] = self.lm_manager.to_wire()
+        return snap
 
     def replicate_once(self) -> bool:
         """Acting master → standby; returns True if delivered."""
@@ -119,6 +126,9 @@ class FailoverManager:
                 existing.extend(tuple(r) for r in recs
                                 if tuple(r) not in seen)
         self.resume_in_flight()
+        if self.lm_manager is not None and "lm" in snap:
+            self.lm_manager.load_wire(snap["lm"])
+            self.lm_manager.on_adopt()
 
     def resume_in_flight(self) -> None:
         """Reassign in-flight tasks stranded on dead hosts (including the

@@ -1,0 +1,790 @@
+"""Cluster management for the LM serving tier (round-2 VERDICT item 3).
+
+Round 2's LM tier was node-local: ``lm_serve`` built a pool on whichever
+node took the RPC, so decode pools and train jobs sat outside the
+cluster's core guarantees — not placed by the coordinator, not fair-shared,
+not journaled to the standby, and dead with their node (queued + in-flight
+requests lost; train jobs resumed only by manual re-``train_start``). The
+reference applies its guarantees to *all* work: coordinator task placement
+and failed-worker reassignment (`mp4_machinelearning.py:706-760`), standby
+metadata replication (`:971-1011`).
+
+This manager runs on the acting master and closes that gap for the LM tier:
+
+- **Placement**: ``serve()``/``train()`` pick the least-loaded alive node
+  (measured load: the scheduler book's in-flight CNN tasks per host, plus
+  managed pools/jobs already placed there) and issue the node-local verb
+  over the control RPC.
+- **Journaling**: every submitted request's full descriptor (prompt,
+  max_new, temperature, *pinned* seed) and its completion tokens live in a
+  master-side journal. Sampling seeds are pinned at admission (default:
+  the global request id), so a replayed request — greedy OR sampled — is
+  token-exact.
+- **Standby replication**: ``to_wire()``/``load_wire()`` ride the
+  FailoverManager snapshot, so the standby adopts the pool registry and
+  the journal along with the task book.
+- **Recovery**: on a pool node's death the manager re-issues ``lm_serve``
+  on a survivor and resubmits every unfinished request; a dead train-job
+  node gets ``train_start(resume=True)`` on a survivor, resuming from the
+  job's last store checkpoint. On coordinator failover the new master
+  conservatively requeues every unfinished request (completions drained
+  from a pool but not yet replicated are unrecoverable from the node;
+  pinned seeds make the replay exact, and the journal dedupes).
+
+Threading: verbs arrive on RPC handler threads, the pump runs on the
+master loop, membership changes on the monitor thread — one RLock guards
+the registry; all transport calls happen OUTSIDE the lock (a slow or dead
+peer must never stall the registry).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from idunno_tpu.comm.message import Message
+from idunno_tpu.comm.transport import Transport, TransportError
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.service import MembershipService
+from idunno_tpu.utils.types import MemberStatus, MessageType
+
+CONTROL = "control"
+
+# request lifecycle: pending (not yet on any node) -> inflight (forwarded,
+# node id known) -> done (tokens journaled). Recovery moves inflight back
+# to pending; done and failed (node rejected the request — permanent, e.g.
+# a validation error) are terminal.
+_PENDING, _INFLIGHT, _DONE, _FAILED = "pending", "inflight", "done", "failed"
+
+
+class LMPoolManager:
+    """Acting-master registry + journal + recovery for decode pools and
+    train jobs. Constructed on every node (the standby needs one to adopt
+    into); only the acting master's instance pumps or places."""
+
+    # an inflight request older than this is assumed lost (node-side error
+    # consumed by a failed poll, or a drained-but-undelivered reply) and is
+    # requeued — exact replay, so the only cost is wasted decode. Capped at
+    # max_request_attempts total forwards, then FAILED loudly.
+    request_timeout_s = 120.0
+    max_request_attempts = 3
+
+    def __init__(self, host: str, config: ClusterConfig,
+                 transport: Transport, membership: MembershipService,
+                 inference_service=None) -> None:
+        self.host = host
+        self.config = config
+        self.transport = transport
+        self.membership = membership
+        self.service = inference_service      # scheduler book = load signal
+        self._lock = threading.RLock()
+        # name -> {"spec": dict, "node": str|None, "next_rid": int,
+        #          "requests": {rid: descriptor}}
+        self._pools: dict[str, dict[str, Any]] = {}
+        # name -> {"spec": dict, "node": str|None, "status": dict|None}
+        self._jobs: dict[str, dict[str, Any]] = {}
+        membership.on_change(self._on_member_change)
+
+    # -- placement ---------------------------------------------------------
+
+    def _load_score(self, host: str) -> float:
+        """Measured load on ``host``: in-flight CNN tasks the scheduler
+        book currently assigns to it, plus LM pools and train jobs this
+        manager already placed there (each pool/job owns the device for
+        its steps, so it weighs like an in-flight task stream)."""
+        score = 0.0
+        if self.service is not None:
+            score += len(self.service.scheduler.book.in_flight(host))
+        with self._lock:
+            score += sum(1 for p in self._pools.values()
+                         if p["node"] == host)
+            score += sum(1 for j in self._jobs.values()
+                         if j["node"] == host and not self._job_over(j))
+        return score
+
+    @staticmethod
+    def _job_over(job: dict[str, Any]) -> bool:
+        # stop_requested records the USER's intent even when the node was
+        # unreachable at train_stop time — a stop-requested job must never
+        # be auto-resumed by recovery
+        if job.get("stop_requested"):
+            return True
+        st = job.get("status") or {}
+        return bool(st.get("done") or st.get("stopped") or st.get("error"))
+
+    def _place(self) -> str:
+        alive = sorted(self.membership.members.alive_hosts())
+        if not alive:
+            raise ValueError("no alive hosts to place on")
+        master = self.membership.acting_master()
+
+        def key(h: str):
+            # control-plane hosts carry the pump/replication loops: bias
+            # ties away from the acting master (and, lighter, the standby)
+            # without ever excluding them — a loaded worker still loses to
+            # an idle master
+            bias = (0.5 if h == master
+                    else 0.25 if h == self.config.standby_coordinator
+                    else 0.0)
+            return (self._load_score(h) + bias, h)
+
+        return min(alive, key=key)
+
+    def _call(self, node: str, payload: dict[str, Any],
+              timeout: float = 30.0) -> dict[str, Any]:
+        """Control RPC to a node's LOCAL lm tier (``local``=True keeps the
+        receiving dispatcher from routing back into its own manager)."""
+        payload = dict(payload, local=True)
+        reply = self.transport.call(
+            node, CONTROL, Message(MessageType.INFERENCE, self.host,
+                                   payload), timeout=timeout)
+        if reply is None:
+            raise TransportError(f"no reply from {node}")
+        if reply.type is MessageType.ERROR:
+            raise ValueError(f"{node}: {reply.payload.get('error')}")
+        return reply.payload
+
+    # -- pools: client surface (acting master) -----------------------------
+
+    def serve(self, spec: dict[str, Any]) -> dict[str, Any]:
+        """Place a decode pool on the least-loaded alive node and register
+        it. ``spec`` is the node-local ``lm_serve`` payload (name,
+        prompt_len, max_len, slots, draft, ...)."""
+        spec = {k: v for k, v in spec.items()
+                if k not in ("verb", "placement", "local", "reload")}
+        name = spec["name"]
+        with self._lock:
+            if name in self._pools:
+                return {"already": True,
+                        "node": self._pools[name]["node"]}
+            # reserve before the (slow) remote build so a concurrent serve
+            # of the same name returns "already" instead of double-placing
+            self._pools[name] = {"spec": dict(spec), "node": None,
+                                 "next_rid": 0, "requests": {},
+                                 "done_total": 0, "failed_total": 0,
+                                 "node_errors": [],
+                                 # measured service samples feeding the
+                                 # heterogeneous fair share: (seconds from
+                                 # submit to completion, new tokens)
+                                 "svc_samples": [],
+                                 "slots_now": int(spec.get("slots", 4)),
+                                 "slots_cap": int(spec.get("slots", 4)),
+                                 "slots_target_prev": None}
+        try:
+            node = self._place()
+            out = self._call(node, dict(spec, verb="lm_serve"))
+        except BaseException:
+            with self._lock:
+                if self._pools.get(name, {}).get("node") is None:
+                    del self._pools[name]
+            raise
+        with self._lock:
+            self._pools[name]["node"] = node
+        return {"node": node, "slots": out.get("slots")}
+
+    def submit(self, name: str, prompt: list[int], max_new: int,
+               temperature: float = 0.0, seed: int | None = None) -> int:
+        """Journal a request (seed pinned NOW — replay after any failure
+        must be token-exact even for sampled requests), then forward it to
+        the pool's node. Forward failures leave it pending; the pump
+        retries/relocates."""
+        with self._lock:
+            pool = self._pools.get(name)
+            if pool is None:
+                raise ValueError(f"no managed pool {name!r}; "
+                                 "lm_serve (placement=auto) first")
+            rid = pool["next_rid"]
+            pool["next_rid"] += 1
+            req = {"prompt": [int(t) for t in prompt],
+                   "max_new": int(max_new),
+                   "temperature": float(temperature),
+                   "seed": int(seed) if seed is not None else rid,
+                   "status": _PENDING, "node_id": None,
+                   "tokens": None, "prompt_len": None, "delivered": False,
+                   "t_forwarded": None, "attempts": 0,
+                   "t_submitted": time.time()}
+            pool["requests"][rid] = req
+            node = pool["node"]
+        if node is not None:
+            self._forward(name, node, rid, req)
+        return rid
+
+    def _forward(self, name: str, node: str, rid: int,
+                 req: dict[str, Any]) -> None:
+        try:
+            out = self._call(node, {
+                "verb": "lm_submit", "name": name,
+                "prompt": req["prompt"], "max_new": req["max_new"],
+                "temperature": req["temperature"], "seed": req["seed"]})
+        except (TransportError, OSError):
+            return                      # stays pending; pump will retry
+        except ValueError as e:
+            with self._lock:
+                pool = self._pools.get(name)
+                req2 = pool["requests"].get(rid) if pool else None
+                if "no lm_serve pool" in str(e):
+                    # the node is alive but has NO loop under this name
+                    # (stale snapshot / out-of-band lm_stop): recoverable —
+                    # orphan the pool so the pump re-establishes it, and
+                    # leave the request pending for the resubmission
+                    if pool is not None and pool["node"] == node:
+                        self._orphan_pool_locked(name)
+                elif req2 is not None and req2["status"] == _PENDING:
+                    # the node REJECTED the request (validation) —
+                    # permanent; retrying would loop forever. Surface via
+                    # poll().
+                    req2["status"] = _FAILED
+                    req2["error"] = str(e)
+                    pool["failed_total"] += 1
+            return
+        with self._lock:
+            # recovery may have requeued/re-placed while the RPC ran; only
+            # a still-pending request on the same node takes the mapping
+            pool = self._pools.get(name)
+            if (pool is not None and pool["node"] == node
+                    and pool["requests"].get(rid, {}).get("status")
+                    == _PENDING):
+                req2 = pool["requests"][rid]
+                req2["status"] = _INFLIGHT
+                req2["node_id"] = int(out["id"])
+                req2["t_forwarded"] = time.time()
+                req2["attempts"] += 1
+
+    def poll(self, name: str) -> dict[str, Any]:
+        """Completions not yet delivered to a client (at-least-once across
+        failovers: the delivered flag replicates with the journal)."""
+        with self._lock:
+            pool = self._pools.get(name)
+            if pool is None:
+                raise ValueError(f"no managed pool {name!r}")
+            out, errors = [], []
+            for rid, req in sorted(pool["requests"].items()):
+                if req["delivered"]:
+                    continue
+                if req["status"] == _DONE:
+                    req["delivered"] = True
+                    out.append({"id": rid, "tokens": req["tokens"],
+                                "prompt_len": req["prompt_len"]})
+                elif req["status"] == _FAILED:
+                    req["delivered"] = True
+                    errors.append(f"request {rid} failed: "
+                                  f"{req.get('error', '?')}")
+            # delivered terminal requests are never replayed or re-polled:
+            # prune them so the journal (and every standby snapshot) stays
+            # bounded by the number of requests actually in flight
+            for rid in [r for r, q in pool["requests"].items()
+                        if q["delivered"]]:
+                del pool["requests"][rid]
+        reply: dict[str, Any] = {"completions": out}
+        if errors:
+            reply["errors"] = errors
+        return reply
+
+    def stats(self, name: str) -> dict[str, Any]:
+        with self._lock:
+            pool = self._pools.get(name)
+            if pool is None:
+                raise ValueError(f"no managed pool {name!r}")
+            node = pool["node"]
+            counts = {s: 0 for s in (_PENDING, _INFLIGHT)}
+            for req in pool["requests"].values():
+                if req["status"] in counts:
+                    counts[req["status"]] += 1
+            # terminal states are cumulative counters (delivered requests
+            # are pruned from the journal)
+            counts[_DONE] = pool["done_total"]
+            counts[_FAILED] = pool["failed_total"]
+            node_errors = list(pool["node_errors"][-5:])
+        out = {"node": node, "journal": counts}
+        if node_errors:
+            out["node_errors"] = node_errors
+        if node is not None:
+            try:
+                out["pool"] = self._call(
+                    node, {"verb": "lm_stats", "name": name})["stats"]
+            except (TransportError, ValueError, OSError) as e:
+                out["pool_error"] = str(e)
+        return out
+
+    def stop(self, name: str) -> dict[str, Any]:
+        with self._lock:
+            pool = self._pools.pop(name, None)
+        if pool is None:
+            return {"stopped": False}
+        if pool["node"] is not None:
+            try:
+                self._call(pool["node"], {"verb": "lm_stop", "name": name})
+            except (TransportError, ValueError, OSError):
+                pass                    # node may already be dead
+        return {"stopped": True}
+
+    def managed_pools(self) -> list[str]:
+        with self._lock:
+            return sorted(self._pools)
+
+    def has_pool(self, name: str) -> bool:
+        with self._lock:
+            return name in self._pools
+
+    # -- train jobs --------------------------------------------------------
+
+    def train(self, spec: dict[str, Any]) -> dict[str, Any]:
+        """Place a training job on the least-loaded alive node; on that
+        node's death the job restarts on a survivor with resume=True,
+        continuing from its last store checkpoint."""
+        spec = {k: v for k, v in spec.items()
+                if k not in ("verb", "placement", "local", "resume")}
+        name = spec["name"]
+        with self._lock:
+            job = self._jobs.get(name)
+            if job is not None and not self._job_over(job):
+                raise ValueError(f"training job {name!r} already running "
+                                 f"on {job['node']}")
+            self._jobs[name] = {"spec": dict(spec), "node": None,
+                                "status": None, "stop_requested": False}
+        try:
+            node = self._place()
+            self._call(node, dict(spec, verb="train_start"))
+        except BaseException:
+            with self._lock:
+                if self._jobs.get(name, {}).get("node") is None:
+                    del self._jobs[name]
+            raise
+        with self._lock:
+            self._jobs[name]["node"] = node
+        return {"started": True, "node": node}
+
+    def train_status(self, name: str) -> dict[str, Any]:
+        with self._lock:
+            job = self._jobs.get(name)
+            if job is None:
+                raise ValueError(f"no managed training job {name!r}")
+            node, cached = job["node"], job["status"]
+        if node is not None:
+            try:
+                st = self._call(node, {"verb": "train_status",
+                                       "name": name})
+                with self._lock:
+                    if name in self._jobs:
+                        self._jobs[name]["status"] = st
+                return dict(st, node=node)
+            except (TransportError, ValueError, OSError):
+                pass
+        return dict(cached or {}, node=node, stale=True)
+
+    def train_stop(self, name: str) -> dict[str, Any]:
+        """Record the stop intent FIRST (so a dead/unreachable node can
+        never turn an explicit stop into an auto-resume), then best-effort
+        stop the node-local job; the pump retries unconfirmed stops."""
+        with self._lock:
+            job = self._jobs.get(name)
+            if job is None:
+                return {"stopped": False}
+            job["stop_requested"] = True
+            node = job["node"]
+        out: dict[str, Any] = {"stopped": True}
+        if node is not None:
+            try:
+                out = self._call(node, {"verb": "train_stop",
+                                        "name": name})
+                out["stopped"] = True
+            except (TransportError, ValueError, OSError) as e:
+                out["pending"] = f"node {node} unreachable ({e}); " \
+                                 "stop is recorded and will be retried"
+        with self._lock:
+            if name in self._jobs and out.get("status"):
+                self._jobs[name]["status"] = out["status"]
+        return out
+
+    def has_job(self, name: str) -> bool:
+        with self._lock:
+            return name in self._jobs
+
+    # -- pump: runs on the acting master's master loop ---------------------
+
+    def pump_once(self) -> None:
+        """Forward pending requests, drain completions, refresh job
+        status. All RPCs outside the lock; only the acting master pumps
+        (the standby's copy stays passive until adoption)."""
+        if not self.membership.is_acting_master:
+            return
+        now = time.time()
+        with self._lock:
+            for pool in self._pools.values():
+                self._requeue_stale_locked(pool, now)
+            pools = {n: (p["node"],
+                         [(rid, dict(r)) for rid, r in
+                          sorted(p["requests"].items())
+                          if r["status"] == _PENDING])
+                     for n, p in self._pools.items()}
+            jobs = [(n, j["node"]) for n, j in self._jobs.items()
+                    if not self._job_over(j)]
+            # stop-requested jobs whose node never confirmed: retry the
+            # stop (the job may still be burning its node's chip)
+            stop_retries = [
+                (n, j["node"]) for n, j in self._jobs.items()
+                if j.get("stop_requested") and j["node"] is not None
+                and not ((j.get("status") or {}).get("stopped")
+                         or (j.get("status") or {}).get("done")
+                         or (j.get("status") or {}).get("error"))]
+        for name, (node, pending) in pools.items():
+            if node is None:
+                self._recover_pool(name)
+                continue
+            for rid, req in pending:
+                self._forward(name, node, rid, req)
+            self._drain(name, node)
+        for name, node in jobs:
+            if node is None:
+                self._recover_job(name)
+                continue
+            try:
+                st = self._call(node, {"verb": "train_status",
+                                       "name": name}, timeout=10.0)
+            except (TransportError, ValueError, OSError):
+                continue
+            with self._lock:
+                if name in self._jobs:
+                    self._jobs[name]["status"] = st
+        for name, node in stop_retries:
+            try:
+                out = self._call(node, {"verb": "train_stop",
+                                        "name": name}, timeout=10.0)
+            except (TransportError, ValueError, OSError):
+                continue
+            with self._lock:
+                if name in self._jobs and out.get("status"):
+                    self._jobs[name]["status"] = out["status"]
+        self._update_fair_share()
+
+    # -- heterogeneous fair share (round-2 VERDICT item 4) -----------------
+
+    @staticmethod
+    def _avg_request_s(pool: dict[str, Any]) -> float:
+        s = pool["svc_samples"]
+        return sum(x for x, _ in s) / len(s) if s else 0.0
+
+    def allocation_view(self) -> dict[str, Any]:
+        """c1/c2-style arbitration report: measured per-unit seconds and
+        the fair worker-unit share for every live job — CNN query jobs
+        (avg seconds per query) and LM decode pools (avg seconds per
+        request, per-token breakdown included) — via the reference ratio
+        formula generalized over the job union
+        (`scheduler/fair.py:heterogeneous_shares`)."""
+        from idunno_tpu.scheduler.fair import heterogeneous_shares
+
+        n_workers = len(self.membership.members.alive_hosts())
+        sched = self.service.scheduler if self.service else None
+        cnn = {}
+        if sched is not None:
+            cnn = {m: sched.avg_query_time.get(m, 0.0)
+                   for m in sched.active_models()}
+        with self._lock:
+            lm = {n: self._avg_request_s(p)
+                  for n, p in self._pools.items()
+                  if p["node"] is not None}
+            tok = {n: (sum(s for s, _ in p["svc_samples"])
+                       / max(sum(t for _, t in p["svc_samples"]), 1))
+                   for n, p in self._pools.items() if p["svc_samples"]}
+            slots = {n: p["slots_now"] for n, p in self._pools.items()}
+        shares = heterogeneous_shares(cnn, lm, self.config.rate_factor,
+                                      n_workers)
+        jobs: dict[str, Any] = {}
+        for m, t in cnn.items():
+            jobs[f"cnn:{m}"] = {"avg_query_s": round(t, 4),
+                                "share": shares.get(f"cnn:{m}", 0)}
+        for n, t in lm.items():
+            jobs[f"lm:{n}"] = {"avg_request_s": round(t, 4),
+                               "avg_token_s": round(tok.get(n, 0.0), 5),
+                               "share": shares.get(f"lm:{n}", 0),
+                               "slots": slots.get(n)}
+        return {"rate_factor": self.config.rate_factor,
+                "n_workers": n_workers, "jobs": jobs}
+
+    def _update_fair_share(self) -> None:
+        """Apply the arbitration: feed each pool's measured per-request
+        seconds into the CNN scheduler (whose assign() then computes
+        shares over the job UNION, shrinking CNN worker counts while
+        pools run), and resize each pool's slots toward its own share of
+        the worker units. A resize rebuilds the pool (recompile), so it
+        needs the same target on two consecutive pumps (hysteresis) and
+        can be pinned off per pool with spec ``fixed_slots=True``."""
+        if self.service is None:
+            return
+        with self._lock:
+            rates = {n: self._avg_request_s(p)
+                     for n, p in self._pools.items()
+                     if p["node"] is not None}
+        self.service.scheduler.extra_jobs = {
+            f"lm:{n}": t for n, t in rates.items()}
+        if not rates:
+            return
+        view = self.allocation_view()
+        resize = []
+        with self._lock:
+            for name, pool in self._pools.items():
+                job = view["jobs"].get(f"lm:{name}")
+                if (job is None or pool["node"] is None
+                        or pool["spec"].get("fixed_slots")):
+                    continue
+                # slots_cap is the user's spec — the pool may shrink below
+                # it while other jobs run and grow back, never beyond
+                target = max(1, min(pool["slots_cap"], int(job["share"])))
+                if (target != pool["slots_now"]
+                        and target == pool["slots_target_prev"]):
+                    pool["spec"]["slots"] = target
+                    pool["slots_now"] = target
+                    self._orphan_pool_locked(name)
+                    resize.append(name)
+                pool["slots_target_prev"] = target
+        for name in resize:
+            self._recover_pool(name)
+
+    def _requeue_stale_locked(self, pool: dict[str, Any],
+                              now: float) -> None:
+        """Watchdog: an inflight request can wedge without its node dying
+        (the node's error list is a destructive read a failed poll can
+        consume; a drained lm_poll reply can be lost to a timeout).
+        Requeue anything inflight past request_timeout_s; FAIL it after
+        max_request_attempts forwards."""
+        for rid, req in pool["requests"].items():
+            if req["status"] != _INFLIGHT:
+                continue
+            if now - (req["t_forwarded"] or now) < self.request_timeout_s:
+                continue
+            if req["attempts"] >= self.max_request_attempts:
+                req["status"] = _FAILED
+                req["error"] = (f"no completion after {req['attempts']} "
+                                f"forwards x {self.request_timeout_s:.0f}s")
+                pool["failed_total"] += 1
+            else:
+                req["status"] = _PENDING
+                req["node_id"] = None
+
+    def _drain(self, name: str, node: str) -> None:
+        try:
+            out = self._call(node, {"verb": "lm_poll", "name": name},
+                             timeout=10.0)
+        except (TransportError, ValueError, OSError):
+            return
+        if not (out.get("completions") or out.get("errors")):
+            return
+        with self._lock:
+            pool = self._pools.get(name)
+            if pool is None or pool["node"] != node:
+                return                  # stopped or re-placed mid-drain
+            for e in out.get("errors", ()):
+                # node-side loop errors are request-anonymous; keep them
+                # for stats/debugging (the watchdog above unsticks any
+                # request they wedged)
+                if len(pool["node_errors"]) < 100:
+                    pool["node_errors"].append(str(e))
+            by_node_id = {r["node_id"]: r
+                          for r in pool["requests"].values()
+                          if r["status"] == _INFLIGHT}
+            now = time.time()
+            for c in out.get("completions", ()):
+                req = by_node_id.get(int(c["id"]))
+                if req is not None:
+                    req["status"] = _DONE
+                    req["tokens"] = [int(t) for t in c["tokens"]]
+                    req["prompt_len"] = int(c["prompt_len"])
+                    req["node_id"] = None
+                    pool["done_total"] += 1
+                    new_toks = len(req["tokens"]) - req["prompt_len"]
+                    pool["svc_samples"].append(
+                        (now - req["t_submitted"], max(new_toks, 1)))
+                    del pool["svc_samples"][:-32]    # rolling window
+
+    # -- recovery ----------------------------------------------------------
+
+    def _on_member_change(self, host: str, old, new) -> None:
+        if new is not MemberStatus.LEAVE:
+            return
+        if not self.membership.is_acting_master:
+            return
+        with self._lock:
+            dead_pools = [n for n, p in self._pools.items()
+                          if p["node"] == host]
+            for n in dead_pools:
+                self._orphan_pool_locked(n)
+            dead_jobs = [n for n, j in self._jobs.items()
+                         if j["node"] == host and not self._job_over(j)]
+            for n in dead_jobs:
+                self._jobs[n]["node"] = None
+        if not (dead_pools or dead_jobs):
+            return
+
+        # re-place off-thread: this callback runs on the membership monitor
+        # loop, and a pool rebuild (store fetch + device alloc) must not
+        # stall failure detection for other hosts. pump_once retries any
+        # recovery that fails here.
+        def _recover():
+            for n in dead_pools:
+                self._recover_pool(n)
+            for n in dead_jobs:
+                self._recover_job(n)
+
+        threading.Thread(target=_recover, daemon=True,
+                         name=f"{self.host}-lm-recover").start()
+
+    def _orphan_pool_locked(self, name: str) -> None:
+        pool = self._pools[name]
+        pool["node"] = None
+        for req in pool["requests"].values():
+            if req["status"] == _INFLIGHT:
+                req["status"] = _PENDING
+                req["node_id"] = None
+
+    def _recover_pool(self, name: str) -> None:
+        """Re-establish an orphaned pool on a survivor and resubmit every
+        unfinished request (token-exact: seeds were pinned at admission).
+
+        Serialized per pool: the membership-change thread, the adoption
+        thread and the pump can all reach here concurrently, and a second
+        ``lm_serve reload=True`` landing on the same node would replace
+        the first recovery's freshly built loop — stranding its
+        just-forwarded requests as inflight ids of a dead loop until the
+        watchdog times them out."""
+        with self._lock:
+            pool = self._pools.get(name)
+            if (pool is None or pool["node"] is not None
+                    or pool.get("_recovering")):
+                return
+            pool["_recovering"] = True
+            spec = dict(pool["spec"])
+        try:
+            try:
+                node = self._place()
+                self._call(node, dict(spec, verb="lm_serve", reload=True))
+            except (TransportError, ValueError, OSError):
+                return                  # pump retries next period
+            with self._lock:
+                pool = self._pools.get(name)
+                if pool is None or pool["node"] is not None:
+                    return
+                pool["node"] = node
+                pending = [(rid, dict(r)) for rid, r in
+                           sorted(pool["requests"].items())
+                           if r["status"] == _PENDING]
+            for rid, req in pending:
+                self._forward(name, node, rid, req)
+        finally:
+            with self._lock:
+                pool = self._pools.get(name)
+                if pool is not None:
+                    pool["_recovering"] = False
+
+    def _recover_job(self, name: str) -> None:
+        with self._lock:
+            job = self._jobs.get(name)
+            if (job is None or job["node"] is not None
+                    or job.get("_recovering")):
+                return
+            job["_recovering"] = True   # serialized like _recover_pool
+            spec = dict(job["spec"], resume=True)
+        try:
+            try:
+                node = self._place()
+                self._call(node, dict(spec, verb="train_start"))
+            except (TransportError, ValueError, OSError):
+                return
+            with self._lock:
+                job = self._jobs.get(name)
+                if job is not None and job["node"] is None:
+                    job["node"] = node
+        finally:
+            with self._lock:
+                job = self._jobs.get(name)
+                if job is not None:
+                    job["_recovering"] = False
+
+    # -- failover replication ---------------------------------------------
+
+    def to_wire(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "pools": {n: {"spec": dict(p["spec"]), "node": p["node"],
+                              "next_rid": p["next_rid"],
+                              "done_total": p["done_total"],
+                              "failed_total": p["failed_total"],
+                              "svc_samples": [list(s) for s
+                                              in p["svc_samples"]],
+                              "slots_now": p["slots_now"],
+                              "slots_cap": p["slots_cap"],
+                              "requests": {str(rid): dict(r) for rid, r
+                                           in p["requests"].items()}}
+                          for n, p in self._pools.items()},
+                "jobs": {n: {"spec": dict(j["spec"]), "node": j["node"],
+                             "stop_requested": bool(
+                                 j.get("stop_requested")),
+                             "status": dict(j["status"])
+                             if j["status"] else None}
+                         for n, j in self._jobs.items()},
+            }
+
+    def load_wire(self, snap: dict[str, Any]) -> None:
+        with self._lock:
+            self._pools = {
+                n: {"spec": dict(p["spec"]), "node": p["node"],
+                    "next_rid": int(p["next_rid"]),
+                    "done_total": int(p.get("done_total", 0)),
+                    "failed_total": int(p.get("failed_total", 0)),
+                    "node_errors": [],
+                    "svc_samples": [tuple(s) for s
+                                    in p.get("svc_samples", ())],
+                    "slots_now": int(p.get("slots_now",
+                                           p["spec"].get("slots", 4))),
+                    "slots_cap": int(p.get("slots_cap",
+                                           p["spec"].get("slots", 4))),
+                    "slots_target_prev": None,
+                    # defaults first: a snapshot from an older master may
+                    # predate the watchdog/measurement fields
+                    "requests": {int(rid): {"t_forwarded": None,
+                                            "attempts": 0,
+                                            "t_submitted": 0.0, **dict(r)}
+                                 for rid, r in p["requests"].items()}}
+                for n, p in snap.get("pools", {}).items()}
+            self._jobs = {
+                n: {"spec": dict(j["spec"]), "node": j["node"],
+                    "stop_requested": bool(j.get("stop_requested")),
+                    "status": dict(j["status"]) if j["status"] else None}
+                for n, j in snap.get("jobs", {}).items()}
+
+    def on_adopt(self) -> None:
+        """Called by the failover manager when this standby becomes the
+        coordinator. Completions the old master drained from a pool but
+        had not yet replicated are unrecoverable from the node (its outbox
+        hands ownership to the poller), so conservatively requeue EVERY
+        unfinished request — pinned seeds make the replay token-exact and
+        the journal keeps exactly one record per request. Pools/jobs on
+        dead nodes are re-placed; both paths also retry from the pump."""
+        alive = set(self.membership.members.alive_hosts())
+        with self._lock:
+            pool_names = list(self._pools)
+            for name in pool_names:
+                pool = self._pools[name]
+                if pool["node"] is not None and pool["node"] not in alive:
+                    pool["node"] = None
+                for req in pool["requests"].values():
+                    if req["status"] == _INFLIGHT:
+                        req["status"] = _PENDING
+                        req["node_id"] = None
+            job_names = []
+            for name, job in self._jobs.items():
+                if (job["node"] is not None and job["node"] not in alive
+                        and not self._job_over(job)):
+                    job["node"] = None
+                    job_names.append(name)
+        # rebuilds + resubmissions go off-thread: adopt() is called on the
+        # membership monitor loop, which must keep detecting failures (the
+        # same discipline as _on_member_change); the pump retries whatever
+        # fails here
+        def _recover():
+            for name in pool_names:
+                self._recover_pool(name)
+            for name in job_names:
+                self._recover_job(name)
+
+        threading.Thread(target=_recover, daemon=True,
+                         name=f"{self.host}-lm-adopt").start()

@@ -20,6 +20,7 @@ from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.serve.control import ControlService
 from idunno_tpu.serve.failover import FailoverManager
 from idunno_tpu.serve.inference_service import InferenceService
+from idunno_tpu.serve.lm_manager import LMPoolManager
 from idunno_tpu.serve.metrics import MetricsTracker
 from idunno_tpu.store.sdfs import FileStoreService
 from idunno_tpu.utils.logging import setup_node_logging
@@ -49,8 +50,11 @@ class Node:
                                           self.membership, engine,
                                           metrics=self.metrics,
                                           dataset_root=dataset_root)
-        self.failover = FailoverManager(host, config, transport,
+        self.lm_manager = LMPoolManager(host, config, transport,
                                         self.membership, self.inference)
+        self.failover = FailoverManager(host, config, transport,
+                                        self.membership, self.inference,
+                                        lm_manager=self.lm_manager)
         self.grep = LogGrepService(host, config, transport, self.membership,
                                    log_dir or data_dir)
         self.control = ControlService(self)
@@ -119,8 +123,18 @@ class Node:
         """Straggler re-dispatch + standby metadata replication, both 1 Hz
         (`:809-830, 971-987`)."""
         while not self._stop.is_set():
-            self.inference.monitor_stragglers_once()
-            self.failover.replicate_once()
+            # each duty isolated: one raising must not take down the
+            # others (a dead master loop = no straggler re-dispatch, no
+            # LM pump, no standby replication — silent loss of the
+            # cluster's guarantees)
+            for duty in (self.inference.monitor_stragglers_once,
+                         self.lm_manager.pump_once,
+                         self.failover.replicate_once):
+                try:
+                    duty()
+                except Exception:  # noqa: BLE001 - loop must stay alive
+                    self.log.exception("master duty %s failed",
+                                       getattr(duty, "__name__", duty))
             time.sleep(self.config.metadata_interval_s)
 
     def _worker_loop(self) -> None:

@@ -1,0 +1,181 @@
+"""Heterogeneous fair-time sharing: CNN query jobs and LM decode pools
+arbitrate the cluster's worker units from MEASURED per-unit rates
+(round-2 VERDICT item 4) — the reference's two-model ratio formula
+(`mp4_machinelearning.py:501-539`) generalized over the job-type union
+(`scheduler/fair.py:heterogeneous_shares`), applied on both sides:
+CNN queries get proportionally fewer workers while a pool runs, and the
+pool's decode slots resize toward its own share. Surfaced c1-style via
+the `stats` verb's ``allocation`` section and the shell's ``c1``.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idunno_tpu.comm.inproc import InProcNetwork
+from idunno_tpu.comm.message import Message
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.engine.generate import save_lm
+from idunno_tpu.models.transformer import TransformerLM
+from idunno_tpu.scheduler.fair import fair_shares, heterogeneous_shares
+from idunno_tpu.serve.node import Node
+from idunno_tpu.utils.types import MessageType
+
+from tests.conftest import TimedFakeEngine
+
+
+def test_heterogeneous_shares_proportional():
+    """Worker units divide proportionally to measured per-unit seconds
+    across job TYPES, exactly like the reference's two-model case."""
+    shares = heterogeneous_shares({"resnet18": 0.3}, {"chat": 0.9},
+                                  rate_factor=10, n_workers=8)
+    # 0.3 : 0.9 → 25% : 75% of 10 units
+    assert shares == {"cnn:resnet18": 2, "lm:chat": 8}
+
+    # a job with no history weighs as the mean of the others (the
+    # reference's ratio-1.0 no-data rule)
+    shares = heterogeneous_shares({"alexnet": 0.0}, {"chat": 0.5},
+                                  rate_factor=10, n_workers=8)
+    assert shares["cnn:alexnet"] == shares["lm:chat"]
+
+    # pure-CNN behaviour is unchanged (N=2 reference case)
+    assert fair_shares({"a": 1.0, "b": 1.0}, 10, 4) == {"a": 4, "b": 4}
+
+
+def test_extra_jobs_shrink_cnn_share():
+    """FairScheduler.assign computes shares over the job UNION: a
+    measured LM pool in extra_jobs shrinks a CNN query's worker count."""
+    from idunno_tpu.scheduler.fair import FairScheduler
+
+    cfg = ClusterConfig(hosts=("n0", "n1", "n2"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0")
+    workers = ["n0", "n1", "n2"]
+
+    alone = FairScheduler(cfg)
+    alone.avg_query_time = {"resnet18": 1.0}
+    t_alone = alone.assign("resnet18", 1, 0, 299, workers)
+
+    shared = FairScheduler(cfg)
+    shared.avg_query_time = {"resnet18": 1.0}
+    shared.extra_jobs = {"lm:chat": 15.0}     # measured: requests are slow
+    t_shared = shared.assign("resnet18", 1, 0, 299, workers)
+
+    assert len(t_alone) == 3                  # full cluster when alone
+    assert len(t_shared) == 1                 # 1/16 of 10 units → 1 worker
+    # the whole range is still covered, just by fewer workers
+    covered = sorted((t.start, t.end) for t in t_shared)
+    assert covered[0][0] == 0 and covered[-1][1] == 299
+
+
+@pytest.mark.slow
+def test_cluster_arbitration_end_to_end(tmp_path):
+    """One CNN job + one decode pool on a live 3-node cluster: measured
+    rates drive (a) the CNN query's worker count, (b) the pool's slot
+    resize, and (c) the c1/stats allocation report."""
+    cfg = ClusterConfig(hosts=("n0", "n1", "n2"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0",
+                        replication_factor=2, ping_interval_s=0.1,
+                        failure_timeout_s=1.0, metadata_interval_s=0.2,
+                        query_batch_size=400)
+    net = InProcNetwork()
+    nodes = {h: Node(h, cfg, net.transport(h), str(tmp_path / h),
+                     engine=TimedFakeEngine(1.0)) for h in cfg.hosts}
+    try:
+        for n in nodes.values():
+            n.start()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not all(
+                len(n.membership.members.alive_hosts()) == 3
+                for n in nodes.values()):
+            time.sleep(0.02)
+        master = nodes["n0"]
+
+        model = TransformerLM(vocab=32, dim=32, depth=1, num_heads=4)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        save_lm(master.store, "chat", model, params)
+
+        def call(payload):
+            out = master.control._handle("control", Message(
+                MessageType.INFERENCE, "client", payload))
+            assert out.type is MessageType.ACK, out.payload
+            return out.payload
+
+        call({"verb": "lm_serve", "placement": "auto", "name": "chat",
+              "slots": 4, "prompt_len": 4, "max_len": 16})
+        for _ in range(2):
+            call({"verb": "lm_submit", "name": "chat",
+                  "prompt": [1, 2, 3], "max_new": 6})
+        deadline = time.time() + 90.0
+        got = 0
+        while time.time() < deadline and got < 2:
+            got += len(call({"verb": "lm_poll",
+                             "name": "chat"})["completions"])
+            time.sleep(0.1)
+        assert got == 2, "LM requests never completed"
+        # measured per-request seconds now feed the CNN scheduler
+        deadline = time.time() + 10.0
+        while time.time() < deadline and \
+                "lm:chat" not in master.inference.scheduler.extra_jobs:
+            time.sleep(0.1)
+        lm_rate = master.inference.scheduler.extra_jobs.get("lm:chat")
+        assert lm_rate and lm_rate > 1.0, (
+            f"measured LM rate missing/implausible: {lm_rate}")
+
+        # CNN query 1: no CNN history yet (weighs as the mean) — runs and
+        # records a ~1 s measured query time
+        qnum1 = master.inference.inference("resnet18", 0, 99)[0]
+        deadline = time.time() + 30.0
+        while time.time() < deadline and not master.inference.query_done(
+                "resnet18", qnum1):
+            time.sleep(0.05)
+        assert master.inference.query_done("resnet18", qnum1)
+
+        # CNN query 2: measured ~1 s/query vs the pool's tens of seconds
+        # per request → the CNN job's fair share collapses to 1 worker
+        qnum2 = master.inference.inference("resnet18", 0, 99)[0]
+        tasks2 = master.inference.scheduler.book.tasks_for_query(
+            "resnet18", qnum2)
+        assert len({t.worker for t in tasks2}) == 1, tasks2
+
+        # the pool's own share clamps at the worker count (3 < cap 4):
+        # the manager resizes the pool's slots to match (hysteresis: two
+        # pump periods with the same target)
+        deadline = time.time() + 60.0
+        st = {}
+        while time.time() < deadline:
+            st = call({"verb": "lm_stats", "name": "chat"})["stats"]
+            if st.get("pool", {}).get("slots") == 3:
+                break
+            time.sleep(0.2)
+        assert st.get("pool", {}).get("slots") == 3, st
+
+        # arbitration surfaced c1-style: stats verb + shell c1
+        reply = call({"verb": "stats"})
+        alloc = reply.get("allocation")
+        assert alloc is not None, reply
+        jobs = alloc["jobs"]
+        assert "lm:chat" in jobs and jobs["lm:chat"]["share"] >= 1
+        assert jobs["lm:chat"]["avg_request_s"] > 0
+        assert jobs["lm:chat"]["avg_token_s"] > 0
+        # resized pool still serves: the managed path survives a rebuild
+        rid = call({"verb": "lm_submit", "name": "chat",
+                    "prompt": [5, 6, 7], "max_new": 4})["id"]
+        deadline = time.time() + 90.0
+        done = []
+        while time.time() < deadline and not done:
+            done = [c for c in call({"verb": "lm_poll",
+                                     "name": "chat"})["completions"]
+                    if c["id"] == rid]
+            time.sleep(0.1)
+        assert done, "post-resize request never completed"
+
+        from idunno_tpu.cli.shell import Shell
+        sh = Shell(master, out=lambda s: None)
+        c1 = sh.cmd_c1([])
+        assert "fair share" in c1 and "lm:chat" in c1, c1
+    finally:
+        for n in nodes.values():
+            n.stop()

@@ -5,6 +5,8 @@ cluster takes to start serving it. The reference needed 40-49 s (its
 workers reload weights from torch.hub per task); here the second job's
 first result lands in well under a second, recorded in ``FAIRSHARE.json``.
 """
+import pytest
+
 import json
 import os
 import time
@@ -14,6 +16,9 @@ from idunno_tpu.config import ClusterConfig
 from idunno_tpu.serve.node import Node
 
 from tests.conftest import TimedFakeEngine
+
+pytestmark = pytest.mark.slow   # wall-clock timing: run serially
+
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORK_S = 0.2

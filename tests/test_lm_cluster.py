@@ -1,0 +1,269 @@
+"""The LM serving tier under the cluster's core guarantees (round-2
+VERDICT item 3): coordinator placement, standby journal replication, and
+wall-clock recovery — a pool's node is SIGKILLed mid-stream and every
+submitted request still completes, token-exact for deterministic requests.
+
+The reference applies exactly these guarantees to its CNN tasks —
+placement + failed-worker reassignment (`mp4_machinelearning.py:706-760`),
+standby metadata (`:971-1011`) — and this suite holds the LM tier to the
+same bar on the threaded Node runtime with real wall clocks.
+
+Writes ``LM_RECOVERY.json`` (measured artifact — regenerated here, never
+hand-edited; see CLAUDE.md conventions).
+"""
+import pytest
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from idunno_tpu.comm.inproc import InProcNetwork
+from idunno_tpu.comm.message import Message
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.engine.generate import generate, save_lm
+from idunno_tpu.models.transformer import TransformerLM
+from idunno_tpu.serve.node import Node
+from idunno_tpu.utils.types import MessageType
+
+from tests.conftest import TimedFakeEngine
+
+pytestmark = pytest.mark.slow   # wall-clock timing: run serially
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cluster(tmp_path, net):
+    cfg = ClusterConfig(hosts=("n0", "n1", "n2"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0",
+                        replication_factor=2, ping_interval_s=0.1,
+                        failure_timeout_s=1.0, metadata_interval_s=0.2)
+    nodes = {h: Node(h, cfg, net.transport(h), str(tmp_path / h),
+                     engine=TimedFakeEngine(0.05)) for h in cfg.hosts}
+    for n in nodes.values():
+        n.start()
+    deadline = time.time() + 5.0
+    while time.time() < deadline and not all(
+            len(n.membership.members.alive_hosts()) == 3
+            for n in nodes.values()):
+        time.sleep(0.02)
+    return cfg, nodes
+
+
+def _call(node, payload):
+    out = node.control._handle("control", Message(
+        MessageType.INFERENCE, "client", payload))
+    assert out.type is MessageType.ACK, out.payload
+    return out.payload
+
+
+def _tiny_lm(store):
+    model = TransformerLM(vocab=32, dim=32, depth=1, num_heads=4)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    save_lm(store, "klm", model, params)
+    return model, params
+
+
+def test_pool_survives_node_kill_mid_stream(tmp_path):
+    """Kill -9 the decode pool's node with requests queued + in flight:
+    the coordinator re-establishes the pool on a survivor, resubmits every
+    unfinished request, and the stream finishes token-exact — greedy
+    requests match `generate`, and a sampled pair (same pinned seed) that
+    straddles the kill comes back identical."""
+    net = InProcNetwork()
+    cfg, nodes = _cluster(tmp_path, net)
+    try:
+        model, params = _tiny_lm(nodes["n0"].store)
+        master = nodes["n0"]
+
+        out = _call(master, {"verb": "lm_serve", "placement": "auto",
+                             "name": "klm", "slots": 2, "prompt_len": 4,
+                             "max_len": 16})
+        victim = out["node"]
+        # load-aware placement biases ties away from the control plane
+        assert victim == "n2", out
+
+        rng = np.random.default_rng(0)
+        want = {}
+
+        def submit_greedy():
+            prompt = [int(t) for t in rng.integers(0, 32, size=4)]
+            rid = _call(master, {"verb": "lm_submit", "name": "klm",
+                                 "prompt": prompt, "max_new": 6})["id"]
+            ref = generate(model, params, jnp.asarray([prompt], jnp.int32),
+                           prompt_len=4, max_new=6)
+            want[rid] = [int(t) for t in np.asarray(ref[0])]
+            return rid
+
+        def submit_sampled():
+            # same prompt + same pinned seed every time: replay must agree
+            return _call(master, {"verb": "lm_submit", "name": "klm",
+                                  "prompt": [1, 2, 3, 4], "max_new": 6,
+                                  "temperature": 0.8, "seed": 7})["id"]
+
+        for _ in range(4):
+            submit_greedy()
+        pair = [submit_sampled()]
+
+        done = {}
+
+        def drain(node):
+            for c in _call(node, {"verb": "lm_poll",
+                                  "name": "klm"})["completions"]:
+                done[c["id"]] = c["tokens"]
+
+        deadline = time.time() + 90.0
+        while time.time() < deadline and not done:
+            drain(master)
+            time.sleep(0.05)
+        assert done, "no completion before the kill (compile too slow?)"
+        n_done_at_kill = len(done)
+
+        # second wave submitted and the node killed IMMEDIATELY: these
+        # requests are still queued/in flight, so the kill is guaranteed
+        # mid-stream (no drain happens between submit and kill)
+        for _ in range(2):
+            submit_greedy()
+        pair.append(submit_sampled())
+
+        t_kill = time.time()
+        net.kill(victim)
+
+        # fresh budget: recovery re-places the pool on a survivor, which
+        # recompiles prefill/decode from scratch on the CPU mesh
+        deadline = time.time() + 120.0
+        while time.time() < deadline and len(done) < 8:
+            drain(master)
+            time.sleep(0.05)
+        t_all = time.time()
+        assert len(done) == 8, f"only {sorted(done)} of 8 completed"
+
+        for rid, toks in want.items():
+            assert done[rid] == toks, f"greedy request {rid} not exact"
+        assert done[pair[0]] == done[pair[1]], "sampled replay diverged"
+
+        st = _call(master, {"verb": "lm_stats", "name": "klm"})["stats"]
+        assert st["node"] in ("n0", "n1"), st
+        assert st["journal"]["done"] == 8, st
+
+        artifact = {
+            "experiment": "kill -9 the decode pool's node mid-stream "
+                          "(3-node threaded runtime, wall clock)",
+            "n_requests": 8,
+            "n_done_at_kill": n_done_at_kill,
+            "kill_to_all_complete_s": round(t_all - t_kill, 3),
+            "replacement_node": st["node"],
+            "config": {"ping_interval_s": cfg.ping_interval_s,
+                       "failure_timeout_s": cfg.failure_timeout_s,
+                       "metadata_interval_s": cfg.metadata_interval_s},
+            "token_exact": True,
+        }
+        with open(os.path.join(REPO, "LM_RECOVERY.json"), "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_coordinator_death_preserves_lm_journal(tmp_path):
+    """Kill -9 the coordinator with LM requests in flight: the standby
+    adopts the replicated pool registry + request journal, requeues every
+    unfinished request (pinned seeds → exact replay), and the client
+    finishes the stream against the new master."""
+    net = InProcNetwork()
+    cfg, nodes = _cluster(tmp_path, net)
+    try:
+        model, params = _tiny_lm(nodes["n0"].store)
+        out = _call(nodes["n0"], {"verb": "lm_serve", "placement": "auto",
+                                  "name": "klm", "slots": 2,
+                                  "prompt_len": 4, "max_len": 16})
+        assert out["node"] == "n2"
+
+        rng = np.random.default_rng(1)
+        want = {}
+        for i in range(5):
+            prompt = [int(t) for t in rng.integers(0, 32, size=4)]
+            rid = _call(nodes["n0"], {"verb": "lm_submit", "name": "klm",
+                                      "prompt": prompt,
+                                      "max_new": 5})["id"]
+            ref = generate(model, params, jnp.asarray([prompt], jnp.int32),
+                           prompt_len=4, max_new=5)
+            want[rid] = [int(t) for t in np.asarray(ref[0])]
+
+        # let the journal replicate to the standby (replication period is
+        # metadata_interval_s; one period + margin)
+        time.sleep(3 * cfg.metadata_interval_s)
+        net.kill("n0")
+
+        done = {}
+        deadline = time.time() + 90.0
+        while time.time() < deadline and len(done) < 5:
+            try:
+                for c in _call(nodes["n1"], {"verb": "lm_poll",
+                                             "name": "klm"})["completions"]:
+                    done[c["id"]] = c["tokens"]
+            except (AssertionError, ValueError):
+                pass              # adoption not finished yet
+            time.sleep(0.05)
+        assert len(done) == 5, f"only {sorted(done)} of 5 after failover"
+        for rid, toks in want.items():
+            assert done[rid] == toks, f"request {rid} not exact"
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_train_job_auto_resumes_on_node_death(tmp_path):
+    """A cluster-placed training job's node dies mid-run: the coordinator
+    restarts it on a survivor with resume=True and it continues from its
+    last store checkpoint (start_step > 0), finishing the full step
+    budget."""
+    from idunno_tpu.engine.data_lm import save_corpus
+
+    net = InProcNetwork()
+    cfg, nodes = _cluster(tmp_path, net)
+    try:
+        rng = np.random.default_rng(2)
+        save_corpus(nodes["n0"].store, "corpus/kill",
+                    rng.integers(0, 32, size=4000).astype(np.int32))
+        master = nodes["n0"]
+        out = _call(master, {"verb": "train_start", "placement": "auto",
+                             "name": "crashlm", "corpus": "corpus/kill",
+                             "model": {"vocab": 32, "dim": 16, "depth": 1,
+                                       "num_heads": 2},
+                             "steps": 4000, "batch_size": 4,
+                             "seq_len": 16, "checkpoint_every": 3})
+        victim = out["node"]
+        assert victim == "n2", out
+
+        deadline = time.time() + 120.0
+        st = {}
+        while time.time() < deadline:
+            st = _call(master, {"verb": "train_status", "name": "crashlm"})
+            if (st.get("checkpoint_version") is not None
+                    and st.get("step", 0) >= 4):
+                break
+            time.sleep(0.1)
+        assert st.get("checkpoint_version") is not None, st
+
+        net.kill(victim)
+
+        while time.time() < deadline:
+            st = _call(master, {"verb": "train_status", "name": "crashlm"})
+            if st.get("done"):
+                break
+            assert not st.get("error"), st
+            time.sleep(0.2)
+        assert st.get("done"), f"resumed job never finished: {st}"
+        assert st["node"] in ("n0", "n1"), st
+        assert st["start_step"] >= 3, f"restarted from scratch: {st}"
+        assert st["step"] == 4000, st
+    finally:
+        for n in nodes.values():
+            n.stop()

@@ -31,6 +31,9 @@ from idunno_tpu.comm.message import Message
 from idunno_tpu.comm.net import oneshot_call
 from idunno_tpu.utils.types import MessageType
 
+pytestmark = pytest.mark.slow   # wall-clock timing: run serially
+
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

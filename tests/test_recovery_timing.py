@@ -10,6 +10,8 @@ whose tasks are mid-execution when one worker is killed (transport-level
 kill -9); we record kill → detection and kill → query-complete latencies and
 write them to ``RECOVERY.json`` as the round's measured artifact.
 """
+import pytest
+
 import json
 import os
 import time
@@ -25,6 +27,9 @@ WORK_S = 1.5                      # per-task compute time (controlled)
 
 
 from tests.conftest import TimedFakeEngine
+
+pytestmark = pytest.mark.slow   # wall-clock timing: run serially
+
 
 
 def test_measured_recovery_after_worker_kill(tmp_path):

@@ -9,6 +9,8 @@ same semantics fall out of `InferenceService._eligible_workers` reading the
 live membership per submission; this test proves it end-to-end on real
 threads and records join → first-task-completed latency in ``SCALEOUT.json``.
 """
+import pytest
+
 import json
 import os
 import time
@@ -17,6 +19,9 @@ from idunno_tpu.comm.inproc import InProcNetwork
 from idunno_tpu.config import ClusterConfig
 from idunno_tpu.serve.node import Node
 from tests.conftest import TimedFakeEngine
+
+pytestmark = pytest.mark.slow   # wall-clock timing: run serially
+
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORK_S = 0.3                      # per-task compute time (controlled)
