@@ -1,0 +1,61 @@
+"""LM bench machinery (`idunno_tpu/utils/lm_bench.py`) on the CPU mesh.
+
+The numbers only mean something on TPU; these tests pin the RECORD SHAPE —
+every phase present, token accounting sane — so an unattended TPU capture
+can't silently emit a gutted record.
+"""
+import time
+
+import pytest
+
+from idunno_tpu.utils.lm_bench import lm_bench_config, run_lm_bench
+
+TINY = {
+    "BENCH_LM_DIM": "64", "BENCH_LM_DEPTH": "1", "BENCH_LM_HEADS": "2",
+    "BENCH_LM_VOCAB": "128", "BENCH_LM_SLOTS": "2", "BENCH_LM_PROMPT": "8",
+    "BENCH_LM_MAXNEW": "16", "BENCH_LM_MAXLEN": "64",
+    "BENCH_LM_DECODE_STEPS": "4", "BENCH_LM_PREFILL_BATCH": "2",
+    "BENCH_LM_PREFILL_SEQ": "32", "BENCH_LM_DRAFT_DIM": "32",
+    "BENCH_LM_DRAFT_DEPTH": "1",
+}
+
+
+@pytest.fixture
+def tiny_env(monkeypatch):
+    for k, v in TINY.items():
+        monkeypatch.setenv(k, v)
+
+
+def test_config_env_overrides(tiny_env):
+    cfg = lm_bench_config("cpu")
+    assert cfg["dim"] == 64 and cfg["slots"] == 2
+    assert cfg["decode_steps"] == 4
+
+
+def test_full_suite_record_shape(tiny_env):
+    rec = run_lm_bench("cpu", "cpu", 1, None,
+                       deadline=time.perf_counter() + 600, compact=False)
+    assert rec["n_params"] > 0 and rec["param_bytes"] > 0
+    assert rec["prefill"]["tokens_per_s"] > 0
+    assert rec["flash_attention"] == "n/a (cpu)"
+    assert rec["decode"]["tokens_per_s"] > 0
+    assert rec["decode"]["slots"] == 2
+    # speculative: constructed weights agree everywhere, so every round
+    # must commit more than 1 token per row on average
+    assert rec["speculative"]["avg_commit_per_round"] > 1.5
+    assert rec["speculative"]["tokens_per_s"] > 0
+    assert rec["int8_decode"]["tokens_per_s"] > 0
+
+
+def test_compact_skips_optional_phases(tiny_env):
+    rec = run_lm_bench("cpu", "cpu", 1, None,
+                       deadline=time.perf_counter() + 600, compact=True)
+    assert "speculative" not in rec and "int8_decode" not in rec
+    assert rec["decode"]["tokens_per_s"] > 0
+
+
+def test_deadline_skips_optional_phases(tiny_env):
+    rec = run_lm_bench("cpu", "cpu", 1, None,
+                       deadline=time.perf_counter() - 1, compact=False)
+    assert "speculative" not in rec and "int8_decode" not in rec
+    assert rec["decode"]["tokens_per_s"] > 0

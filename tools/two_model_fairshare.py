@@ -1,0 +1,167 @@
+"""The reference's signature experiment on this framework's hardware:
+TWO models served CONCURRENTLY with fair-time arbitration, on a real TPU
+(round-3 VERDICT missing #3; reference: `mp4_report_group1.pdf` p.1-2,
+ratio formula `mp4_machinelearning.py:504-514`).
+
+Runs a 3-node in-proc cluster on the visible chip (the reference used 10
+VMs; XLA serializes the nodes' dispatches onto the one TPU, which is
+exactly the fair-TIME-sharing regime the formula arbitrates), streams
+ResNet-18 queries, then starts an AlexNet stream mid-flight, and captures:
+
+  - measured avg seconds/query per model (the formula's inputs),
+  - each job's fair worker share + the c1 allocation view,
+  - time from the second job's submission to its FIRST completed result
+    (the reference measured 40-49 s for this, p.2 Fig 3),
+  - per-model throughput while both streams are live.
+
+Writes TWO_MODEL_FAIRSHARE.json (with the same self-verifying provenance
+block bench.py stamps) and prints it. Usage:
+
+    python tools/two_model_fairshare.py            # real TPU (tunnel up)
+    python tools/two_model_fairshare.py --cpu      # machinery dry-run
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="dry-run the machinery on CPU (no artifact claim)")
+    ap.add_argument("--images", type=int, default=400,
+                    help="images per query (reference: 400-image queries)")
+    ap.add_argument("--queries", type=int, default=6,
+                    help="queries per model stream")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "TWO_MODEL_FAIRSHARE.json"))
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from bench import provenance
+    from idunno_tpu.utils.compile_cache import enable_persistent_cache
+    enable_persistent_cache()
+
+    dev = jax.devices()[0]
+    if not args.cpu and dev.platform != "tpu":
+        print(json.dumps({"error": f"need a TPU, got {dev.platform}"}))
+        return 2
+
+    from idunno_tpu.comm.inproc import InProcNetwork
+    from idunno_tpu.config import ClusterConfig, EngineConfig
+    from idunno_tpu.serve.node import Node
+
+    cfg = ClusterConfig(hosts=("n0", "n1", "n2"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0",
+                        replication_factor=2, ping_interval_s=0.2,
+                        failure_timeout_s=2.0, metadata_interval_s=0.3,
+                        query_batch_size=args.images)
+    ecfg = EngineConfig(batch_size=args.batch, param_dtype="bfloat16")
+    net = InProcNetwork()
+    tmp = tempfile.mkdtemp(prefix="fairshare2m-")
+    nodes = {h: Node(h, cfg, net.transport(h), os.path.join(tmp, h),
+                     engine_config=ecfg) for h in cfg.hosts}
+    out: dict = {"platform": dev.platform,
+                 "device_kind": getattr(dev, "device_kind", dev.platform),
+                 "images_per_query": args.images, "batch": args.batch,
+                 "engine_param_dtype": "bfloat16"}
+    try:
+        for n in nodes.values():
+            n.start()
+        deadline = time.time() + 10.0
+        while time.time() < deadline and not all(
+                len(n.membership.members.alive_hosts()) == 3
+                for n in nodes.values()):
+            time.sleep(0.05)
+        master = nodes["n0"]
+        svc = master.inference
+
+        def run_query(model):
+            q = svc.inference(model, 0, args.images - 1)[0]
+            while not svc.query_done(model, q):
+                time.sleep(0.02)
+            return q
+
+        # warm both models (compile once per (model, batch) — persistent
+        # cache makes the 3 nodes share compiled programs across runs)
+        t0 = time.time()
+        run_query("resnet18")
+        out["warm_resnet18_s"] = round(time.time() - t0, 2)
+        t0 = time.time()
+        run_query("alexnet")
+        out["warm_alexnet_s"] = round(time.time() - t0, 2)
+
+        # -- job 1 stream alone: measured rate -----------------------------
+        t0 = time.time()
+        for _ in range(2):
+            run_query("resnet18")
+        out["resnet18_alone_s_per_query"] = round((time.time() - t0) / 2, 3)
+
+        # -- job 2 starts while job 1 has queries in flight -----------------
+        r_qs = [svc.inference("resnet18", 0, args.images - 1)[0]
+                for _ in range(args.queries)]
+        t_submit2 = time.time()
+        a_first = svc.inference("alexnet", 0, args.images - 1)[0]
+        while not svc.query_done("alexnet", a_first):
+            time.sleep(0.01)
+        out["second_job_first_result_s"] = round(time.time() - t_submit2, 3)
+        out["reference_second_job_first_result_s"] = "40-49 (p.2 Fig 3)"
+
+        # keep both streams live and measure concurrent throughput
+        t0 = time.time()
+        a_qs = [svc.inference("alexnet", 0, args.images - 1)[0]
+                for _ in range(args.queries - 1)]
+        # arbitration view captured while BOTH jobs are in flight (after
+        # the streams drain, active_models() is rightly empty)
+        out["allocation_live"] = master.lm_manager.allocation_view()
+        for q in r_qs:
+            while not svc.query_done("resnet18", q):
+                time.sleep(0.02)
+        for q in a_qs:
+            while not svc.query_done("alexnet", q):
+                time.sleep(0.02)
+        dt = time.time() - t0
+        total_imgs = (len(r_qs) + len(a_qs)) * args.images
+        out["concurrent_images_per_s"] = round(total_imgs / dt, 1)
+
+        # -- the arbitration capture (c1 allocation view) ------------------
+        out["avg_query_s"] = {
+            m: round(t, 4)
+            for m, t in svc.scheduler.avg_query_time.items()}
+        from idunno_tpu.scheduler.fair import fair_shares
+        out["fair_shares"] = fair_shares(
+            svc.scheduler.avg_query_time, cfg.rate_factor, 3)
+        # worker sets actually used by the LAST query of each stream
+        out["workers_last_query"] = {
+            "resnet18": sorted({t.worker for t in
+                                svc.scheduler.book.tasks_for_query(
+                                    "resnet18", r_qs[-1])}),
+            "alexnet": sorted({t.worker for t in
+                               svc.scheduler.book.tasks_for_query(
+                                   "alexnet", a_qs[-1])}),
+        }
+        out["provenance"] = provenance()
+        if not args.cpu:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+        print(json.dumps(out))
+        return 0
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
