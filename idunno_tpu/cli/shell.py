@@ -394,8 +394,9 @@ class Shell:
             return ("usage: lm-serve <name> <prompt_len> <max_len> "
                     "[slots= decode_steps= quantize=int8 eos_id=N "
                     "draft=<lm> draft_len=N place=1 reload=1]\n"
-                    "note: draft (speculative) pools are greedy-only — "
-                    "submits with temperature>0 are rejected")
+                    "note: draft (speculative) pools serve greedy "
+                    "requests token-exact and sampled requests "
+                    "distribution-exact (speculative sampling)")
         kv = self._kv(args[3:])
         payload = {k: int(kv.pop(k))
                    for k in ("slots", "decode_steps", "eos_id",

@@ -29,6 +29,7 @@ beyond-parity serving tier over the same engine/model machinery
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from dataclasses import dataclass
 from functools import partial
@@ -55,6 +56,7 @@ class Request:
     max_new: int
     temperature: float = 0.0
     seed: int | None = None
+    t_admit: float = 0.0       # monotonic stamp set at slot admission
 
 
 @dataclass
@@ -62,6 +64,13 @@ class Completion:
     id: int
     tokens: list[int]          # prompt + generated, true ragged length
     prompt_len: int
+    # SERVICE time: slot admission (prefill start) → retirement. Excludes
+    # queue wait here and at any upstream manager, so it measures the
+    # pool's per-request processing capacity — the load-independent signal
+    # the heterogeneous fair share needs (a backlogged pool must not look
+    # slower than an idle one; reference normalizes processing time,
+    # `mp4_machinelearning.py:656-674`).
+    service_s: float = 0.0
 
 
 def _set_cursors(cache: Any, cursors: jnp.ndarray) -> Any:
@@ -153,13 +162,81 @@ def _insert_cache(cache: Any, row_cache: Any, slot: jnp.ndarray) -> Any:
     return _splice_rows(cache, row_cache, slot)
 
 
+def spec_commit(proposals: jnp.ndarray, qdist: jnp.ndarray,
+                pdist: jnp.ndarray, tpred: jnp.ndarray,
+                sampled: jnp.ndarray, u: jnp.ndarray,
+                resid_keys: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Speculative-decoding acceptance + commit math, standalone so its
+    distribution guarantee is testable without a model.
+
+    Greedy rows (``sampled[r]`` False): accept the longest prefix where
+    the proposal equals the target argmax, bonus = target argmax — the
+    committed stream is exactly the target's greedy sequence.
+
+    Sampled rows: standard speculative SAMPLING (Leviathan et al. 2023 /
+    Chen et al. 2023 rejection scheme): proposal j is accepted iff
+    ``u_j < p_j(x_j) / q_j(x_j)``; at the first rejection the bonus
+    draws from the residual ``max(p_j - q_j, 0)`` (normalized), and when
+    every proposal is accepted it draws from the target's ``p_{γ+1}``.
+    The committed tokens are then distributed EXACTLY as sampling the
+    target one token at a time — the sampled analogue of the greedy
+    exactness contract (the residual construction makes
+    P[token] = q·min(1, p/q) + (1-α)·resid = p for every token).
+
+    Shapes: proposals [S, γ] int32; qdist [S, γ, V] draft probabilities;
+    pdist [S, γ+1, V] target probabilities; tpred [S, γ+1] target argmax;
+    sampled [S] bool; u [S, γ] uniforms; resid_keys [S, 2] per-row keys.
+    Returns (cand [S, γ+1] int32 candidate tokens, acc [S] int32 accepted
+    proposal count); callers commit ``cand[:, :acc+1]``.
+    """
+    s, gamma = proposals.shape
+    # acceptance tests per position
+    greedy_ok = proposals == tpred[:, :gamma]                # [S, γ]
+    p_at = jnp.take_along_axis(pdist[:, :gamma], proposals[..., None],
+                               axis=2)[..., 0]               # [S, γ]
+    q_at = jnp.take_along_axis(qdist, proposals[..., None],
+                               axis=2)[..., 0]               # [S, γ]
+    ratio = p_at / jnp.maximum(q_at, 1e-20)
+    sampled_ok = u < ratio
+    ok = jnp.where(sampled[:, None], sampled_ok, greedy_ok)
+    acc = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)  # [S] 0..γ
+
+    # bonus token at the first non-accepted position: residual sampling.
+    # qdist zero-padded at position γ makes the all-accepted case fall out
+    # of the same formula (residual = p_{γ+1} - 0 = the target dist).
+    q_pad = jnp.concatenate([qdist, jnp.zeros_like(qdist[:, :1])], axis=1)
+    p_acc = jnp.take_along_axis(
+        pdist, acc[:, None, None], axis=1)[:, 0]             # [S, V]
+    q_acc = jnp.take_along_axis(
+        q_pad, acc[:, None, None], axis=1)[:, 0]             # [S, V]
+    resid = jnp.maximum(p_acc - q_acc, 0.0)
+    mass = resid.sum(axis=1, keepdims=True)
+    # p == q exactly → zero residual, but then rejection has probability
+    # 0 under exact arithmetic; guard float round-off by falling back to p
+    resid = jnp.where(mass > 1e-12, resid, p_acc)
+    bonus_sampled = jax.vmap(
+        lambda k, r: jax.random.categorical(k, jnp.log(r + 1e-30)))(
+            resid_keys, resid).astype(jnp.int32)             # [S]
+    bonus_greedy = jnp.take_along_axis(tpred, acc[:, None], axis=1)[:, 0]
+    bonus = jnp.where(sampled, bonus_sampled, bonus_greedy)  # [S]
+
+    jidx = jnp.arange(gamma + 1)[None, :]
+    props_pad = jnp.concatenate(
+        [proposals, jnp.zeros((s, 1), jnp.int32)], axis=1)
+    cand = jnp.where(jidx < acc[:, None], props_pad,
+                     jnp.where(jidx == acc[:, None], bonus[:, None], 0))
+    return cand, acc
+
+
 class DecodeServer:
     """Continuous-batching decode pool over a dense `TransformerLM`.
 
     ``slots`` concurrent sequences, each ≤ ``max_len`` total tokens;
     prompts are padded to the static ``prompt_len`` bucket (true lengths
-    tracked exactly). Greedy decoding (matches `generate(temperature=0)`
-    token-for-token — the tests' exactness oracle).
+    tracked exactly). Greedy requests match `generate(temperature=0)`
+    token-for-token (the tests' exactness oracle); sampled requests draw
+    per-request seeded streams (and on speculative pools, the rejection
+    scheme keeps them distribution-exact vs the target).
 
     Usage::
 
@@ -242,8 +319,10 @@ class DecodeServer:
         self._prefill_model = model
 
         # speculative decoding: a cheap draft proposes draft_len tokens per
-        # round, the target verifies them all in ONE chunked apply; output
-        # is EXACTLY the target's own greedy sequence (greedy-only)
+        # round, the target verifies them all in ONE chunked apply; greedy
+        # rows commit EXACTLY the target's own greedy sequence, sampled
+        # rows commit tokens distributed exactly as target sampling
+        # (rejection scheme — `spec_commit`)
         self.draft_len = draft_len
         self._draft_model = self._draft_params = None
         if draft is not None:
@@ -371,13 +450,16 @@ class DecodeServer:
     def _build_spec_round(self, gamma: int):
         """One speculative round, all rows, one compiled program:
 
-          1. the draft runs ``gamma`` single-token steps → proposals;
+          1. the draft runs ``gamma`` single-token steps → proposals
+             (greedy for temperature-0 rows; sampled from its own
+             temperature-scaled distribution for sampled rows);
           2. the target verifies committed-last + all proposals in ONE
              chunked per-row apply (γ+1 positions);
-          3. each row commits the longest proposal prefix the target
-             agrees with, plus the target's own next token — so every
-             round advances 1..γ+1 tokens and the committed stream is
-             EXACTLY the target's greedy sequence.
+          3. `spec_commit` accepts per row: greedy rows commit the longest
+             argmax-matching prefix plus the target's own next token
+             (stream EXACTLY the target's greedy sequence); sampled rows
+             run the standard rejection scheme, committing tokens whose
+             DISTRIBUTION is exactly the target's sampling distribution.
 
         Rejected positions leave stale K/V in both caches strictly past
         the new cursors; they are overwritten when those positions are
@@ -386,7 +468,7 @@ class DecodeServer:
         ddec = self._per_row_decode(self._draft_model, self.max_len)
 
         def run(params, dparams, tokens, cache, dcache, cursors,
-                remaining):
+                remaining, temps, keys):
             params = dequantize_tree(params)
             dparams = dequantize_tree(dparams)
             active = remaining > 0
@@ -394,21 +476,38 @@ class DecodeServer:
             rows = jnp.arange(s)
             prev = jnp.take_along_axis(tokens, cursors[:, None],
                                        axis=1)[:, 0]        # [S]
+            sampled = temps > 0.0                            # [S]
+            safe_t = jnp.maximum(temps, 1e-6)[:, None]
+            # per-row subkeys: γ draft draws + γ accept uniforms +
+            # 1 residual/bonus draw + 1 carried-forward key
+            subs = jax.vmap(lambda k: jax.random.split(k, 2 * gamma + 2))(
+                keys)                                        # [S, 2γ+2, 2]
+            draft_keys = subs[:, :gamma]
+            accept_keys = subs[:, gamma:2 * gamma]
+            resid_keys = subs[:, 2 * gamma]
+            new_keys = subs[:, 2 * gamma + 1]
 
-            # -- 1. draft: gamma greedy proposals ------------------------
+            # -- 1. draft: gamma proposals + their full distributions ----
             def dbody(j, carry):
-                dcache, dcur, tok, props = carry
+                dcache, dcur, tok, props, qdist = carry
                 dcache = _set_cursors(dcache, dcur)
                 logits, mutated = ddec.apply(
                     {"params": dparams, "cache": dcache},
                     tok[:, None], mutable=["cache"])
-                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                l = logits[:, 0].astype(jnp.float32)         # [S, V]
+                q = jax.nn.softmax(l / safe_t, axis=-1)
+                greedy = jnp.argmax(l, axis=-1).astype(jnp.int32)
+                draw = jax.vmap(jax.random.categorical)(
+                    draft_keys[:, j], l / safe_t).astype(jnp.int32)
+                nxt = jnp.where(sampled, draw, greedy)
                 return (mutated["cache"], dcur + 1, nxt,
-                        props.at[:, j].set(nxt))
+                        props.at[:, j].set(nxt),
+                        qdist.at[:, j].set(q))
 
             props0 = jnp.zeros((s, gamma), jnp.int32)
-            dcache, _, _, proposals = jax.lax.fori_loop(
-                0, gamma, dbody, (dcache, cursors, prev, props0))
+            qdist0 = jnp.zeros((s, gamma, self.model.vocab), jnp.float32)
+            dcache, _, _, proposals, qdist = jax.lax.fori_loop(
+                0, gamma, dbody, (dcache, cursors, prev, props0, qdist0))
 
             # -- 2. target: verify the whole chunk in one apply ----------
             cache = _set_cursors(cache, cursors)
@@ -416,18 +515,16 @@ class DecodeServer:
             logits, mutated = dec.apply(
                 {"params": params, "cache": cache}, tin, mutable=["cache"])
             cache = mutated["cache"]
+            logits = logits.astype(jnp.float32)
             tpred = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S,γ+1]
+            pdist = jax.nn.softmax(logits / safe_t[..., None], axis=-1)
 
-            # -- 3. acceptance + commit ----------------------------------
-            match = proposals == tpred[:, :gamma]            # [S, γ]
-            acc = jnp.cumprod(match.astype(jnp.int32),
-                              axis=1).sum(axis=1)            # [S] 0..γ
+            # -- 3. acceptance + commit (`spec_commit`) ------------------
+            u = jax.vmap(lambda ks: jax.vmap(jax.random.uniform)(ks))(
+                accept_keys)                                 # [S, γ]
+            cand, acc = spec_commit(proposals, qdist, pdist, tpred,
+                                    sampled, u, resid_keys)
             jidx = jnp.arange(gamma + 1)[None, :]
-            bonus = jnp.take_along_axis(tpred, acc[:, None], axis=1)
-            props_pad = jnp.concatenate(
-                [proposals, jnp.zeros((s, 1), jnp.int32)], axis=1)
-            cand = jnp.where(jidx < acc[:, None], props_pad,
-                             jnp.where(jidx == acc[:, None], bonus, 0))
             commit = jnp.minimum(acc + 1, remaining)         # [S] ≥1 active
             if self.eos_id is not None:
                 hit = (cand == self.eos_id) & (jidx < commit[:, None])
@@ -445,10 +542,11 @@ class DecodeServer:
                 jnp.where(keep, cand, old))
             cursors = jnp.where(active, cursors + commit, cursors)
             remaining = jnp.where(active, rem_after, remaining)
-            return tokens, cache, dcache, cursors, remaining
+            keys_out = jnp.where(active[:, None], new_keys, keys)
+            return tokens, cache, dcache, cursors, remaining, keys_out
 
         if jax.devices()[0].platform == "tpu":
-            return jax.jit(run, donate_argnums=(2, 3, 4, 5, 6))
+            return jax.jit(run, donate_argnums=(2, 3, 4, 5, 6, 8))
         return jax.jit(run)
 
     # -- client surface ---------------------------------------------------
@@ -482,9 +580,6 @@ class DecodeServer:
             raise ValueError("max_new must be >= 1")
         if temperature < 0.0:
             raise ValueError(f"temperature {temperature} must be >= 0")
-        if temperature > 0.0 and self._draft_model is not None:
-            raise ValueError("speculative pools are greedy-only "
-                             "(temperature must be 0)")
 
     def submit(self, tokens: list[int], max_new: int, *,
                temperature: float = 0.0, seed: int | None = None) -> int:
@@ -528,7 +623,8 @@ class DecodeServer:
             row = np.asarray(self._tokens[slot])[:total]
             self._done.append(Completion(
                 id=req.id, tokens=[int(t) for t in row],
-                prompt_len=len(req.tokens)))
+                prompt_len=len(req.tokens),
+                service_s=time.monotonic() - req.t_admit))
             self._stats["completed"] += 1
             self._stats["tokens_generated"] += total - len(req.tokens)
 
@@ -537,6 +633,7 @@ class DecodeServer:
         while free and self._queue:
             slot = free.pop(0)
             req = self._queue.popleft()
+            req.t_admit = time.monotonic()
             true_len = len(req.tokens)
             bucket = next(b for b in self.prompt_buckets if b >= true_len)
             prompt = np.zeros((1, bucket), np.int32)
@@ -583,10 +680,11 @@ class DecodeServer:
         if self._live:
             if self._draft_model is not None:
                 (self._tokens, self._cache, self._draft_cache,
-                 self._cursors, self._remaining) = self._decode_spec(
+                 self._cursors, self._remaining,
+                 self._keys) = self._decode_spec(
                     self.params, self._draft_params, self._tokens,
                     self._cache, self._draft_cache, self._cursors,
-                    self._remaining)
+                    self._remaining, self._temps, self._keys)
             else:
                 (self._tokens, self._cache, self._cursors,
                  self._remaining, self._keys) = self._decode(

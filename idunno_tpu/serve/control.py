@@ -254,7 +254,8 @@ class ControlService:
         if verb == "lm_poll":
             loop = self._lm_loop(p["name"])
             out = {"completions": [
-                {"id": c.id, "tokens": c.tokens, "prompt_len": c.prompt_len}
+                {"id": c.id, "tokens": c.tokens, "prompt_len": c.prompt_len,
+                 "service_s": round(c.service_s, 6)}
                 for c in loop.poll()]}
             errs = loop.errors()
             if errs:

@@ -120,7 +120,8 @@ class LMServingLoop:
                     for c in done:
                         self._outbox.append(Completion(
                             id=self._id_map.pop(c.id, c.id),
-                            tokens=c.tokens, prompt_len=c.prompt_len))
+                            tokens=c.tokens, prompt_len=c.prompt_len,
+                            service_s=c.service_s))
             if live == 0:
                 self._wake.wait(timeout=0.5)
                 self._wake.clear()

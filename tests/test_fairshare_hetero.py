@@ -80,8 +80,12 @@ def test_cluster_arbitration_end_to_end(tmp_path):
                         failure_timeout_s=1.0, metadata_interval_s=0.2,
                         query_batch_size=400)
     net = InProcNetwork()
+    # CNN queries are made deliberately CHEAP (0.02 s) relative to the
+    # pool's measured per-request SERVICE time (real decode of the tiny LM,
+    # ≥ hundreds of ms with tracing) — the fair-share signal is processing
+    # time, not sojourn, so the cost gap must be real, not queue-induced
     nodes = {h: Node(h, cfg, net.transport(h), str(tmp_path / h),
-                     engine=TimedFakeEngine(1.0)) for h in cfg.hosts}
+                     engine=TimedFakeEngine(0.02)) for h in cfg.hosts}
     try:
         for n in nodes.values():
             n.start()
@@ -107,7 +111,7 @@ def test_cluster_arbitration_end_to_end(tmp_path):
               "slots": 4, "prompt_len": 4, "max_len": 16})
         for _ in range(2):
             call({"verb": "lm_submit", "name": "chat",
-                  "prompt": [1, 2, 3], "max_new": 6})
+                  "prompt": [1, 2, 3], "max_new": 12})
         deadline = time.time() + 90.0
         got = 0
         while time.time() < deadline and got < 2:
@@ -121,11 +125,11 @@ def test_cluster_arbitration_end_to_end(tmp_path):
                 "lm:chat" not in master.inference.scheduler.extra_jobs:
             time.sleep(0.1)
         lm_rate = master.inference.scheduler.extra_jobs.get("lm:chat")
-        assert lm_rate and lm_rate > 1.0, (
-            f"measured LM rate missing/implausible: {lm_rate}")
+        assert lm_rate and lm_rate > 0.05, (
+            f"measured LM service rate missing/implausible: {lm_rate}")
 
         # CNN query 1: no CNN history yet (weighs as the mean) — runs and
-        # records a ~1 s measured query time
+        # records a ~0.02 s measured query time
         qnum1 = master.inference.inference("resnet18", 0, 99)[0]
         deadline = time.time() + 30.0
         while time.time() < deadline and not master.inference.query_done(
@@ -133,24 +137,47 @@ def test_cluster_arbitration_end_to_end(tmp_path):
             time.sleep(0.05)
         assert master.inference.query_done("resnet18", qnum1)
 
-        # CNN query 2: measured ~1 s/query vs the pool's tens of seconds
-        # per request → the CNN job's fair share collapses to 1 worker
+        # CNN query 2: measured ~0.02 s/query vs the pool's much larger
+        # measured per-request service time → the CNN job's fair share
+        # collapses to 1 worker
         qnum2 = master.inference.inference("resnet18", 0, 99)[0]
         tasks2 = master.inference.scheduler.book.tasks_for_query(
             "resnet18", qnum2)
         assert len({t.worker for t in tasks2}) == 1, tasks2
 
-        # the pool's own share clamps at the worker count (3 < cap 4):
-        # the manager resizes the pool's slots to match (hysteresis: two
-        # pump periods with the same target)
-        deadline = time.time() + 60.0
-        st = {}
-        while time.time() < deadline:
-            st = call({"verb": "lm_stats", "name": "chat"})["stats"]
-            if st.get("pool", {}).get("slots") == 3:
-                break
-            time.sleep(0.2)
-        assert st.get("pool", {}).get("slots") == 3, st
+        # while the CNN job COMPETES, the pool's fair fraction is 3 of 4
+        # units → 3 of its 4 specced slots; the manager resizes (in place,
+        # same node) once the hysteresis sees the target twice. A lone
+        # pool keeps full capacity (ADVICE r3), so the CNN stream must
+        # stay live while we watch for the shrink.
+        import threading as _threading
+        node_before = call({"verb": "lm_stats", "name": "chat"})["stats"]
+        node_before = node_before["node"]
+        stream_stop = _threading.Event()
+
+        def _cnn_stream():
+            while not stream_stop.is_set():
+                q = master.inference.inference("resnet18", 0, 99)[0]
+                while (not master.inference.query_done("resnet18", q)
+                       and not stream_stop.is_set()):
+                    time.sleep(0.02)
+
+        streamer = _threading.Thread(target=_cnn_stream, daemon=True)
+        streamer.start()
+        try:
+            deadline = time.time() + 60.0
+            st = {}
+            while time.time() < deadline:
+                st = call({"verb": "lm_stats", "name": "chat"})["stats"]
+                if st.get("pool", {}).get("slots") == 3:
+                    break
+                time.sleep(0.2)
+            assert st.get("pool", {}).get("slots") == 3, st
+            # the rebuild happened IN PLACE: same node, no re-placement
+            assert st.get("node") == node_before, st
+        finally:
+            stream_stop.set()
+            streamer.join(timeout=10.0)
 
         # arbitration surfaced c1-style: stats verb + shell c1
         reply = call({"verb": "stats"})

@@ -278,8 +278,6 @@ def test_speculative_validation(lm):
     model, params = lm
     srv = DecodeServer(model, params, slots=1, prompt_len=4, max_len=12,
                        draft=(model, params), draft_len=3)
-    with pytest.raises(ValueError, match="greedy-only"):
-        srv.submit([1], max_new=2, temperature=0.5)
     with pytest.raises(ValueError, match="headroom"):
         srv.submit([1, 2], max_new=7)     # 2+7+4 > 12
     srv.submit([1, 2], max_new=6)         # 2+6+4 = 12 fits
@@ -324,3 +322,114 @@ def test_submit_validation(lm):
         srv.submit([1], max_new=0)
     with pytest.raises(ValueError, match="temperature"):
         srv.submit([1], max_new=1, temperature=-0.5)
+
+
+def test_service_time_excludes_queue_wait(lm):
+    """The fair-share signal must be load-independent (round-3 VERDICT
+    weak #4): a completion's ``service_s`` covers slot admission →
+    retirement only, so requests that sat in a backlog queue report the
+    same per-request cost as requests served from an idle pool."""
+    import time as _time
+
+    model, params = lm
+    srv = DecodeServer(model, params, slots=1, prompt_len=4, max_len=24)
+    srv.submit([1, 2], max_new=6)              # warm-up: pays the compiles
+    warm = srv.run_until_drained()[0]
+    assert warm.service_s > 0
+
+    # 3 identical requests into ONE slot: a deliberate backlog — requests
+    # 2 and 3 queue behind request 1
+    t0 = _time.monotonic()
+    for _ in range(3):
+        srv.submit([1, 2, 3], max_new=8)
+    done = srv.run_until_drained()
+    wall = _time.monotonic() - t0
+    assert len(done) == 3
+    for c in done:
+        assert c.service_s > 0
+        # sojourn-style accounting would charge the LAST request nearly
+        # the whole wall clock; service time stays a per-request cost
+        assert c.service_s < 0.62 * wall, (c.service_s, wall)
+    # identical work → near-identical measured service
+    svc = sorted(c.service_s for c in done)
+    assert svc[-1] < 3.0 * svc[0], svc
+
+
+def test_spec_commit_distribution_exact():
+    """The fundamental speculative-sampling invariant (Leviathan/Chen):
+    whatever the draft distribution q, the FIRST committed token is
+    distributed exactly as the target distribution p. Monte-Carlo over the
+    pure `spec_commit` math with a deliberately skewed q."""
+    import jax
+    import jax.numpy as jnp
+
+    from idunno_tpu.engine.serve_lm import spec_commit
+
+    vocab, gamma, trials = 5, 3, 20_000
+    p = jnp.asarray([0.05, 0.45, 0.10, 0.25, 0.15])
+    q = jnp.asarray([0.50, 0.05, 0.20, 0.05, 0.20])    # very unlike p
+
+    def one_trial(key):
+        ks = jax.random.split(key, 2 * gamma + 1)
+        props = jnp.stack([jax.random.categorical(ks[j], jnp.log(q))
+                           for j in range(gamma)]).astype(jnp.int32)[None]
+        qd = jnp.broadcast_to(q, (1, gamma, vocab))
+        pd = jnp.broadcast_to(p, (1, gamma + 1, vocab))
+        tpred = jnp.argmax(pd, axis=-1).astype(jnp.int32)
+        u = jnp.stack([jax.random.uniform(ks[gamma + j])
+                       for j in range(gamma)])[None]
+        cand, _ = spec_commit(props, qd, pd, tpred,
+                              jnp.asarray([True]), u, ks[-1:][0][None])
+        return cand[0, 0]                 # first committed token
+
+    toks = jax.jit(jax.vmap(one_trial))(
+        jax.random.split(jax.random.PRNGKey(0), trials))
+    emp = np.bincount(np.asarray(toks), minlength=vocab) / trials
+    # 20k trials: binomial std ≤ ~0.0035 per bucket; 4 sigma ≈ 0.015
+    assert np.abs(emp - np.asarray(p)).max() < 0.02, (emp, p)
+
+
+def test_spec_commit_greedy_rows_unchanged():
+    """temperature-0 rows through the same code path commit exactly the
+    argmax-match prefix + target argmax bonus, independent of u/keys."""
+    import jax
+    import jax.numpy as jnp
+
+    from idunno_tpu.engine.serve_lm import spec_commit
+
+    vocab, gamma = 4, 2
+    props = jnp.asarray([[2, 1]], jnp.int32)
+    qd = jnp.full((1, gamma, vocab), 0.25)
+    # target argmaxes: pos0 → 2 (match), pos1 → 3 (mismatch), pos2 → 0
+    pd = jnp.asarray([[[0, 0, 1, 0], [0, 0, 0, 1],
+                       [1, 0, 0, 0]]], jnp.float32)
+    tpred = jnp.argmax(pd, axis=-1).astype(jnp.int32)
+    u = jnp.ones((1, gamma))              # would reject every sampled test
+    cand, acc = spec_commit(props, qd, pd, tpred,
+                            jnp.asarray([False]), u,
+                            jax.random.PRNGKey(0)[None])
+    assert int(acc[0]) == 1               # prefix: pos0 matched, pos1 not
+    assert cand[0, :2].tolist() == [2, 3]  # proposal, then target argmax
+
+
+def test_speculative_sampled_requests_complete(lm):
+    """Sampled traffic on a speculative pool: completes, in-vocab, seeded
+    reproducibly; a co-resident greedy request stays token-exact."""
+    model, params = lm
+    prompt = [3, 1, 4]
+
+    def run():
+        srv = DecodeServer(model, params, slots=2, prompt_len=4,
+                           max_len=40, draft=(model, params), draft_len=3)
+        rid_s = srv.submit(prompt, max_new=10, temperature=0.9, seed=123)
+        rid_g = srv.submit(prompt, max_new=10)
+        done = {c.id: c for c in srv.run_until_drained()}
+        return done[rid_s], done[rid_g]
+
+    s1, g1 = run()
+    s2, g2 = run()
+    assert g1.tokens == expected(model, params, prompt, 10)
+    assert g2.tokens == g1.tokens
+    assert len(s1.tokens) == len(prompt) + 10
+    assert all(0 <= t < VOCAB for t in s1.tokens)
+    assert s1.tokens == s2.tokens         # pinned seed → reproducible
