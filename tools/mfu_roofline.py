@@ -1,0 +1,138 @@
+"""Per-layer roofline for the bench models on a v5e chip (round-3 VERDICT
+item 5: if MFU < 15%, explain it at the chip level, not with knobs).
+
+For every conv/fc layer of the benched model this computes, at a given
+batch size: FLOPs, HBM bytes moved (activations in + out + weights, bf16),
+arithmetic intensity, the compute-bound and bandwidth-bound time lower
+bounds, and an MXU-utilization ceiling from layer shape — the systolic
+array is 128x128, so a conv whose input-channel contraction dimension is
+C_in*k*k < 128 or whose output-channel dimension < 128 cannot fill it
+(ResNet-18's whole 64-channel stage-1 runs at most at 64/128 = 50% of
+peak by shape alone; AlexNet's 3-channel 11x11 stem at 363/128-rounding).
+
+The printed summary is the analytic argument for RESULTS.md; a
+BENCH_TRACE=1 capture corroborates it with measured per-fusion times.
+
+Chip model (public figures): v5e ≈ 197 TFLOP/s dense bf16, ≈ 819 GB/s HBM.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+PEAK_FLOPS = 197e12
+HBM_GBPS = 819e9
+MXU = 128  # systolic array dimension (contraction x output lanes)
+
+
+def conv_layer(name, h, w, cin, cout, k, stride, pad=None):
+    if pad is None:
+        pad = k // 2
+    oh = (h + 2 * pad - k) // stride + 1
+    ow = (w + 2 * pad - k) // stride + 1
+    return {"name": name, "oh": oh, "ow": ow, "cin": cin, "cout": cout,
+            "k": k, "in_hw": (h, w)}
+
+
+def resnet18_layers():
+    out = [conv_layer("conv1", 224, 224, 3, 64, 7, 2, 3)]
+    h = w = 56
+    cin = 64
+    for stage, planes in enumerate((64, 128, 256, 512)):
+        for block in range(2):
+            stride = 2 if stage > 0 and block == 0 else 1
+            out.append(conv_layer(f"s{stage}b{block}c0", h, w, cin,
+                                  planes, 3, stride))
+            h, w = out[-1]["oh"], out[-1]["ow"]
+            out.append(conv_layer(f"s{stage}b{block}c1", h, w, planes,
+                                  planes, 3, 1))
+            if stride != 1 or cin != planes:
+                out.append(conv_layer(f"s{stage}b{block}ds",
+                                      h * stride, w * stride, cin,
+                                      planes, 1, stride, 0))
+            cin = planes
+    out.append({"name": "fc", "oh": 1, "ow": 1, "cin": 512, "cout": 1000,
+                "k": 1, "in_hw": (1, 1)})
+    return out
+
+
+def alexnet_layers():
+    return [
+        conv_layer("conv1", 224, 224, 3, 64, 11, 4, 2),
+        conv_layer("conv2", 27, 27, 64, 192, 5, 1, 2),
+        conv_layer("conv3", 13, 13, 192, 384, 3, 1, 1),
+        conv_layer("conv4", 13, 13, 384, 256, 3, 1, 1),
+        conv_layer("conv5", 13, 13, 256, 256, 3, 1, 1),
+        {"name": "fc1", "oh": 1, "ow": 1, "cin": 9216, "cout": 4096,
+         "k": 1, "in_hw": (1, 1)},
+        {"name": "fc2", "oh": 1, "ow": 1, "cin": 4096, "cout": 4096,
+         "k": 1, "in_hw": (1, 1)},
+        {"name": "fc3", "oh": 1, "ow": 1, "cin": 4096, "cout": 1000,
+         "k": 1, "in_hw": (1, 1)},
+    ]
+
+
+def analyze(layers, batch):
+    rows, t_comp_total, t_bw_total, flops_total = [], 0.0, 0.0, 0.0
+    t_shape_total = 0.0
+    for l in layers:
+        contraction = l["cin"] * l["k"] * l["k"]
+        flops = 2.0 * batch * l["oh"] * l["ow"] * l["cout"] * contraction
+        act_in = batch * l["in_hw"][0] * l["in_hw"][1] * l["cin"] * 2.0
+        act_out = batch * l["oh"] * l["ow"] * l["cout"] * 2.0
+        weights = contraction * l["cout"] * 2.0
+        bytes_ = act_in + act_out + weights
+        # shape ceiling: both the contraction dim and the output-channel
+        # dim tile onto the 128-wide MXU; a dim below 128 leaves lanes idle
+        fill = min(1.0, contraction / MXU) * min(1.0, l["cout"] / MXU)
+        # matmul rows = batch*oh*ow spatial positions; fine at any batch
+        t_comp = flops / PEAK_FLOPS
+        t_shape = flops / (PEAK_FLOPS * max(fill, 1e-9))
+        t_bw = bytes_ / HBM_GBPS
+        rows.append({
+            "layer": l["name"],
+            "gflops": round(flops / 1e9, 2),
+            "mbytes": round(bytes_ / 1e6, 1),
+            "intensity_flops_per_byte": round(flops / bytes_, 1),
+            "mxu_fill": round(fill, 3),
+            "bound": ("bw" if t_bw > t_shape else "mxu-shape"
+                      if fill < 0.99 else "compute"),
+            "t_us_compute": round(t_comp * 1e6, 1),
+            "t_us_shape_ceiling": round(t_shape * 1e6, 1),
+            "t_us_bandwidth": round(t_bw * 1e6, 1),
+        })
+        flops_total += flops
+        t_comp_total += t_comp
+        t_bw_total += t_bw
+        t_shape_total += max(t_shape, t_bw)
+    mfu_ceiling = t_comp_total / t_shape_total
+    return {"batch": batch,
+            "total_gflops": round(flops_total / 1e9, 1),
+            "ideal_time_us": round(t_comp_total * 1e6, 1),
+            "achievable_time_us": round(t_shape_total * 1e6, 1),
+            "mfu_ceiling_from_shape_and_bw": round(mfu_ceiling, 3),
+            "implied_images_per_s_at_ceiling": round(
+                batch / t_shape_total, 0),
+            "layers": rows}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18",
+                    choices=["resnet18", "alexnet"])
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--full", action="store_true",
+                    help="print per-layer rows, not just the summary")
+    args = ap.parse_args()
+    layers = (resnet18_layers() if args.model == "resnet18"
+              else alexnet_layers())
+    rep = analyze(layers, args.batch)
+    if not args.full:
+        rep = {k: v for k, v in rep.items() if k != "layers"}
+    print(json.dumps(rep, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
