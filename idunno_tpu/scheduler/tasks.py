@@ -31,8 +31,9 @@ class Task:
     # the query's dataset root travels WITH the task so failure/straggler
     # re-dispatch (and post-failover resumption) reruns it on the same data
     dataset: str | None = None
-    # straggler-monitor moves only — capped by max_task_retries so a job
-    # that deterministically FAILS (worker survives, task never finishes)
+    # suspected-task moves (straggler monitor + worker engine-error
+    # reports) — capped by max_task_retries so a job that
+    # deterministically FAILS (worker survives, task never finishes)
     # can't re-dispatch forever
     retries: int = 0
     # every move (straggler + crash/transport) — capped by the much larger
@@ -81,9 +82,11 @@ class TaskBook:
                  count_retry: bool = False) -> Task:
         """Move an in-flight task to another worker (failure/straggler
         re-dispatch, `:706-760`). ``count_retry`` increments the
-        retry-cap counter — set ONLY by the straggler monitor: moves caused
-        by worker crashes or dispatch transport failures are infrastructure
-        churn and must not consume the budget meant for jobs that
+        retry-cap counter — set only for SUSPECTED-TASK moves (the
+        straggler monitor and worker engine-error reports, both via
+        `InferenceService._redispatch_or_fail`): moves caused by worker
+        crashes or dispatch transport failures are infrastructure churn
+        and must not consume the budget meant for jobs that
         deterministically fail wherever they run."""
         with self._lock:
             task.worker = new_worker

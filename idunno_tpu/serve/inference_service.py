@@ -55,6 +55,9 @@ class Job:
     start: int
     end: int
     dataset: str | None
+    # dispatch stamp echoed in error reports so a stale report about an
+    # OLD assignment can't be mistaken for the current one
+    assigned: float = 0.0
 
 
 class InferenceServiceError(Exception):
@@ -85,6 +88,10 @@ class InferenceService:
         # / "store" / "random") — random init must never pass as real
         # classifications
         self._weights_seen: dict[str, set[str]] = {}
+        # per-model engine-failure reports: proof a model EXECUTED (and
+        # failed) somewhere — it is not cold-compiling, so the straggler
+        # monitor's first-compile grace must not shield it
+        self._task_errors: dict[str, int] = {}
         self._results_lock = threading.RLock()
 
         # worker state
@@ -210,6 +217,7 @@ class InferenceService:
             p = msg.payload
             with self._jobs_lock:
                 self._jobs.append(Job(model=p["model"], qnum=int(p["qnum"]),
+                                      assigned=float(p.get("assigned", 0.0)),
                                       start=int(p["start"]),
                                       end=int(p["end"]),
                                       dataset=p.get("dataset")))
@@ -245,7 +253,8 @@ class InferenceService:
         msg = Message(MessageType.JOB, self.host,
                       {"model": task.model, "qnum": task.qnum,
                        "start": task.start, "end": task.end,
-                       "dataset": task.dataset})
+                       "dataset": task.dataset,
+                       "assigned": task.t_assigned})
         # On send failure, reassign on the spot rather than waiting for the
         # failure detector — with a cumulative exclusion set so several
         # simultaneously-dead workers can't ping-pong the dispatch forever.
@@ -267,10 +276,37 @@ class InferenceService:
                     task, self.scheduler.rng.choice(alive), self.clock())
 
     def _handle_result(self, service: str, msg: Message) -> Message | None:
-        """Acting master accumulates results + metrics (`:623-704`)."""
+        """Acting master accumulates results + metrics (`:623-704`);
+        error reports from workers re-dispatch the task immediately."""
         p = msg.payload
         model, qnum = p["model"], int(p["qnum"])
         start, end = int(p["start"]), int(p["end"])
+        if p.get("error"):
+            if not self.membership.is_acting_master:
+                # keep the report queued worker-side for the real master
+                return Message(MessageType.ERROR, self.host,
+                               {"error": f"{self.host} not acting master"})
+            assigned = float(p.get("assigned", 0.0))
+            task = next(
+                (t for t in self.scheduler.book.in_flight(msg.sender)
+                 if t.model == model and t.qnum == qnum
+                 and t.start == start and t.end == end
+                 # the echoed dispatch stamp ties the report to THIS
+                 # assignment: a stale report (queued while partitioned)
+                 # about an older assignment of the same range to the
+                 # same worker must not burn the current attempt's budget
+                 and abs(t.t_assigned - assigned) < 1e-6), None)
+            if task is None:              # stale (already moved/finished)
+                return Message(MessageType.ACK, self.host,
+                               {"duplicate": True})
+            # evidence of life for the model: it executed and FAILED, so
+            # the cold-compile straggler grace no longer applies to it
+            # (master-local; a failover resets it, costing at most one
+            # grace period)
+            self._task_errors[model] = self._task_errors.get(model, 0) + 1
+            self._redispatch_or_fail(
+                task, f"engine error on {msg.sender}: {p['error']}")
+            return Message(MessageType.ACK, self.host)
         task = self.scheduler.book.mark_finished(model, qnum, start, end,
                                                  self.clock())
         if task is None:
@@ -305,27 +341,58 @@ class InferenceService:
         for task in self.scheduler.reassign_failed(host, alive):
             self._dispatch(task)
 
+    # a model with NO completed task cluster-wide yet is probably
+    # compiling on every worker at once (first TPU compile of a shape is
+    # ~40-80 s, well past straggler_timeout_s): give its never-moved tasks
+    # this grace so the monitor doesn't bounce the first query between
+    # equally-cold workers and burn its retry cap on compiles. One grace
+    # per task (reassign resets t_assigned, so per-move grace would
+    # multiply time-to-FAILED for a wedged-but-not-failing engine to
+    # many minutes); after the first result, error report, or move, the
+    # plain straggler timeout applies.
+    first_compile_grace_s = 150.0
+
     def monitor_stragglers_once(self) -> int:
-        """Re-dispatch tasks stuck past the straggler timeout; returns how
-        many moved. A task past the retry cap is marked permanently FAILED
-        (deterministic failures must not bounce between workers forever);
-        pollers see it via `query_failed`."""
+        """Re-dispatch tasks stuck past the straggler timeout (stretched
+        to ``first_compile_grace_s`` for never-moved tasks of models with
+        no completed task yet — every worker is cold-compiling, not
+        stuck); returns how many moved. A task past the retry cap is
+        marked permanently FAILED (deterministic failures must not bounce
+        between workers forever); pollers see it via `query_failed`."""
         if not self.membership.is_acting_master:
             return 0
         alive = self._eligible_workers()
         moved = 0
+        now = self.clock()
         for task in self.scheduler.stragglers():
-            if task.retries >= self.config.max_task_retries:
-                self.scheduler.book.mark_failed(task, self.clock())
-                import logging
-                logging.getLogger("idunno.serving").error(
-                    "task %s#%s [%s, %s] FAILED after %d re-dispatches "
-                    "(last worker %s)", task.model, task.qnum, task.start,
-                    task.end, task.retries, task.worker)
-                continue
-            self._dispatch(self.scheduler.redispatch_straggler(task, alive))
-            moved += 1
+            # cumulative counters, not the windowed average: a warm model
+            # idle past the metrics window must NOT regain compile grace,
+            # and a model with reported engine FAILURES isn't compiling
+            if (task.moves == 0 and task.retries == 0
+                    and self.metrics.finished_images(task.model) == 0
+                    and not self._task_errors.get(task.model)
+                    and now - task.t_assigned <= self.first_compile_grace_s):
+                continue      # cold model, every worker compiling: wait
+            if self._redispatch_or_fail(task, "straggler"):
+                moved += 1
         return moved
+
+    def _redispatch_or_fail(self, task: Task, why: str) -> bool:
+        """Shared failure semantics for the straggler monitor and worker
+        error reports: move the task (consuming its retry budget) or,
+        past ``max_task_retries``, mark it permanently FAILED. Returns
+        True when the task moved."""
+        if task.retries >= self.config.max_task_retries:
+            self.scheduler.book.mark_failed(task, self.clock())
+            import logging
+            logging.getLogger("idunno.serving").error(
+                "task %s#%s [%s, %s] FAILED after %d re-dispatches "
+                "(last worker %s; %s)", task.model, task.qnum, task.start,
+                task.end, task.retries, task.worker, why)
+            return False
+        self._dispatch(self.scheduler.redispatch_straggler(
+            task, self._eligible_workers()))
+        return True
 
     # ------------------------------------------------------------------ #
     # worker side
@@ -359,14 +426,22 @@ class InferenceService:
                 dataset_root=job.dataset or self.dataset_root)
         except Exception as e:  # noqa: BLE001 - a bad job must not kill
             # the worker: an engine failure (unfetchable dataset, bad model
-            # name, device error) is logged and the task is left unfinished
-            # — the master's straggler monitor re-dispatches it elsewhere
-            # while this worker keeps serving its queue.
+            # name, device error) is REPORTED to the master, which
+            # re-dispatches immediately (no straggler-timeout wait) and
+            # counts it as evidence the model isn't merely compiling
+            # (the cold-model grace must not shield deterministic
+            # failures). The worker keeps serving its queue.
             import logging
             logging.getLogger("idunno.serving").warning(
-                "job %s#%s [%s, %s] failed on %s (%s: %s); leaving for "
-                "straggler re-dispatch", job.model, job.qnum, job.start,
+                "job %s#%s [%s, %s] failed on %s (%s: %s); reporting to "
+                "master for re-dispatch", job.model, job.qnum, job.start,
                 job.end, self.host, type(e).__name__, e)
+            self._deliver_result(Message(
+                MessageType.RESULT, self.host,
+                {"model": job.model, "qnum": job.qnum,
+                 "start": job.start, "end": job.end,
+                 "assigned": job.assigned,
+                 "error": f"{type(e).__name__}: {e}"}))
             return
         elapsed = getattr(res, "elapsed_s", None)
         if elapsed is None:

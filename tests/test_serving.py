@@ -382,3 +382,62 @@ def test_control_rpc_verbs(tmp_path):
     finally:
         for n in nodes.values():
             n.stop()
+
+
+def test_cold_model_gets_compile_grace_before_straggler_moves(cluster):
+    """First query of a model on a cold cluster: every worker is compiling
+    (~40-80 s on TPU), which looks identical to a straggler. The monitor
+    must wait first_compile_grace_s before moving tasks of a model with
+    ZERO completed results — then move them once the grace expires."""
+    cfg, net, clock, members, services, engines = cluster
+    master = services["n0"]
+    qnum = master.submit_query("resnet", 0, 99)
+    # nobody executes anything: all workers 'compiling'
+    assert not master.query_done("resnet", qnum)
+    clock.advance(cfg.straggler_timeout_s + 1)
+    assert master.monitor_stragglers_once() == 0      # inside grace: wait
+    clock.advance(master.first_compile_grace_s)
+    assert master.monitor_stragglers_once() >= 1      # grace over: move
+    run_jobs(services)
+    assert master.query_done("resnet", qnum)
+
+    # a WARM model (history exists) gets no grace, even after sitting
+    # idle longer than the metrics window (cumulative counter, not the
+    # windowed average)
+    clock.advance(master.metrics.window_s + 1)
+    qnum2 = master.submit_query("resnet", 100, 199)
+    victim = next(t.worker for t in master.scheduler.book.in_flight()
+                  if t.qnum == qnum2)
+    with services[victim]._jobs_lock:
+        services[victim]._jobs.clear()                # wedge one worker
+    for h in cfg.hosts:
+        if h != victim:
+            services[h].process_jobs_once()
+    clock.advance(cfg.straggler_timeout_s + 1)
+    assert master.monitor_stragglers_once() >= 1      # no grace when warm
+    run_jobs(services)
+    assert master.query_done("resnet", qnum2)
+
+
+def test_engine_failure_redispatches_immediately(cluster):
+    """A worker whose engine RAISES reports the failure to the master,
+    which re-dispatches the range at once — no straggler-timeout wait —
+    and the error report disarms the cold-model compile grace."""
+    cfg, net, clock, members, services, engines = cluster
+    master = services["n0"]
+    victim = "n2"
+
+    class Failing:
+        def infer(self, name, start, end, dataset_root=None):
+            raise RuntimeError("device error")
+
+    services[victim].engine = Failing()
+    qnum = master.submit_query("resnet", 0, 99)
+    had_victim_task = bool(master.scheduler.book.in_flight(victim))
+    run_jobs(services)            # victim errors + reports; others work
+    run_jobs(services)            # re-dispatched chunk executes
+    assert master.query_done("resnet", qnum)
+    assert {r[0] for r in master.results("resnet", qnum)} == \
+        expected_names(0, 99)
+    if had_victim_task:
+        assert master._task_errors.get("resnet", 0) >= 1

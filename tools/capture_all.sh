@@ -1,0 +1,51 @@
+#!/bin/bash
+# One-shot TPU capture runner for a tunnel window (round-4 items 1b/3/5/8).
+# Priority order: headline bench first (it also embeds the compact LM
+# record and refreshes BENCH_LAST_GOOD.json), then the full LM suite, then
+# the two-model fair-share experiment, then the secondary model records,
+# then a traced run for the MFU roofline. Every step is timeout-guarded so
+# a mid-window tunnel drop only loses that step. Run from the repo root:
+#
+#   bash tools/capture_all.sh            # logs to capture.log
+#
+# Afterwards: inspect the refreshed BENCH_LAST_GOOD*.json /
+# TWO_MODEL_FAIRSHARE.json and commit them together.
+set -u
+cd "$(dirname "$0")/.."
+LOG=capture.log
+echo "=== capture run $(date -u +%FT%TZ) ===" | tee -a "$LOG"
+
+probe() {
+  timeout 90 python -c "
+import jax; d = jax.devices(); assert d[0].platform == 'tpu', d
+print('tpu ok:', d[0].device_kind)" >>"$LOG" 2>&1
+}
+
+step() {
+  name=$1; budget=$2; shift 2
+  echo "--- $name ($(date -u +%H:%M:%S))" | tee -a "$LOG"
+  if ! probe; then
+    echo "tunnel down; skipping $name" | tee -a "$LOG"
+    return 1
+  fi
+  timeout "$budget" env "$@" python bench.py >>"$LOG" 2>&1
+  echo "rc=$? $name" | tee -a "$LOG"
+}
+
+step "headline resnet18 bf16 + compact LM" 700 BENCH_TIME_BUDGET_S=600
+step "full LM suite" 700 BENCH_SUITE=lm BENCH_TIME_BUDGET_S=600
+
+echo "--- two-model fair-share ($(date -u +%H:%M:%S))" | tee -a "$LOG"
+if probe; then
+  timeout 900 python tools/two_model_fairshare.py >>"$LOG" 2>&1
+  echo "rc=$? two_model_fairshare" | tee -a "$LOG"
+fi
+
+step "resnet50 record" 700 BENCH_MODEL=resnet50 BENCH_TIME_BUDGET_S=600
+step "alexnet record" 700 BENCH_MODEL=alexnet BENCH_TIME_BUDGET_S=600
+step "traced resnet18 (roofline evidence)" 500 \
+  BENCH_TRACE=1 BENCH_SWEEP=1024 BENCH_ITERS=2 BENCH_LM=0 \
+  BENCH_TIME_BUDGET_S=400
+
+echo "=== capture done $(date -u +%FT%TZ); see $LOG ===" | tee -a "$LOG"
+ls -la BENCH_LAST_GOOD*.json TWO_MODEL_FAIRSHARE.json 2>/dev/null | tee -a "$LOG"
