@@ -62,11 +62,12 @@ HELP = """\
   train-status <name> | train-stop <name>
   lm-serve <name> <prompt_len> <max_len> [k=v ...]  continuous-batching pool
        (slots decode_steps quantize=int8 eos_id=N draft=<lm> draft_len=N;
-        draft pools are GREEDY-ONLY — temperature>0 submits are rejected;
+        draft pools: greedy token-exact, sampled distribution-exact;
         place=1 = cluster-managed: master-placed, requests journaled to
         the standby, pool+requests recovered if its node dies)
-  lm-submit <name> <max_new> [temperature= seed=] <tok> [tok ...]
-       queue a prompt -> request id (temperature 0=greedy, >0 sampled)
+  lm-submit <name> <max_new> [temperature= top_p= seed=] <tok> [tok ...]
+       queue a prompt -> request id (temperature 0=greedy, >0 sampled;
+       top_p<1 = nucleus)
   lm-poll <name> | lm-stats <name> | lm-stop <name>
        fetch completions / occupancy+token counters / stop"""
 
@@ -424,13 +425,15 @@ class Shell:
 
     def cmd_lm_submit(self, args: list[str]) -> str:
         if len(args) < 3:
-            return ("usage: lm-submit <name> <max_new> [temperature= seed=] "
-                    "<tok> [tok ...]")
+            return ("usage: lm-submit <name> <max_new> "
+                    "[temperature= top_p= seed=] <tok> [tok ...]")
         kv = self._kv([a for a in args[2:] if "=" in a])
         toks = [int(t) for t in args[2:] if "=" not in t]
         payload = {}
         if "temperature" in kv:
             payload["temperature"] = float(kv.pop("temperature"))
+        if "top_p" in kv:
+            payload["top_p"] = float(kv.pop("top_p"))
         if "seed" in kv:
             payload["seed"] = int(kv.pop("seed"))
         if kv:

@@ -90,19 +90,13 @@ def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
             scaled = logits / temperature
             if top_p < 1.0:
                 # nucleus: mask everything outside the smallest prefix of
-                # the sorted distribution whose mass reaches top_p
-                probs = jax.nn.softmax(scaled, axis=-1)
-                sorted_p = jnp.sort(probs, axis=-1)[:, ::-1]
-                cum = jnp.cumsum(sorted_p, axis=-1)
-                # per row: the prob of the LAST token inside the nucleus;
-                # clamp the target to the achievable total mass so float32
-                # cumsum shortfall near 1.0 can't collapse the nucleus to
-                # the argmax token (argmax of all-False is 0)
-                target = jnp.minimum(top_p, cum[:, -1:])
-                k_idx = jnp.argmax(cum >= target, axis=-1)
-                cutoff = jnp.take_along_axis(sorted_p, k_idx[:, None],
-                                             axis=-1)
-                scaled = jnp.where(probs >= cutoff, scaled, -jnp.inf)
+                # the sorted distribution whose mass reaches top_p — the
+                # shared construction (`ops.sampling.nucleus_probs`, also
+                # the serving pool's), applied here as a -inf mask so the
+                # categorical draw below is unchanged
+                from idunno_tpu.ops.sampling import nucleus_probs
+                keep = nucleus_probs(scaled, jnp.full((b,), top_p)) > 0.0
+                scaled = jnp.where(keep, scaled, -jnp.inf)
             rng, sub = jax.random.split(rng)
             nxt = jax.random.categorical(sub, scaled, axis=-1)
         else:

@@ -42,6 +42,7 @@ import numpy as np
 from idunno_tpu.engine.generate import decode_model, init_cache
 from idunno_tpu.models.transformer import TransformerLM
 from idunno_tpu.ops.quantize import dequantize_tree, quantize_tree
+from idunno_tpu.ops.sampling import nucleus_probs
 
 
 @dataclass
@@ -55,6 +56,7 @@ class Request:
     tokens: list[int]
     max_new: int
     temperature: float = 0.0
+    top_p: float = 1.0
     seed: int | None = None
     t_admit: float = 0.0       # monotonic stamp set at slot admission
 
@@ -102,22 +104,26 @@ def _prefill(model: TransformerLM, params: Any, prompt: jnp.ndarray,
 
 
 def _next_token(logits: jnp.ndarray, temp: jnp.ndarray,
-                key: jnp.ndarray) -> jnp.ndarray:
-    """Greedy (temp == 0) or temperature-sampled next token; shared by the
-    prefill pick and the batched decode step (vmapped there)."""
+                key: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """Greedy (temp == 0) or temperature+nucleus-sampled next token;
+    shared by the prefill pick and the batched decode step (vmapped
+    there, so every array is one row's)."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temp, 1e-6)
-    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    probs = nucleus_probs(scaled, top_p)
+    sampled = jax.random.categorical(
+        key, jnp.log(probs + 1e-30), axis=-1).astype(jnp.int32)
     return jnp.where(temp > 0.0, sampled, greedy)
 
 
 @jax.jit
 def _pick_first(logits: jnp.ndarray, temp: jnp.ndarray,
-                key: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+                key: jnp.ndarray,
+                top_p: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """First generated token from the prefill logits; returns (token,
     advanced key) so the decode stream continues from a fresh subkey."""
     sub, nxt_key = jax.random.split(key)
-    return _next_token(logits, temp, sub), nxt_key
+    return _next_token(logits, temp, sub, top_p), nxt_key
 
 
 def _splice_rows(cache: Any, row_cache: Any, slot: jnp.ndarray) -> Any:
@@ -364,6 +370,7 @@ class DecodeServer:
         self._cursors = zeros((slots,), jnp.int32)
         self._remaining = zeros((slots,), jnp.int32)
         self._temps = zeros((slots,), jnp.float32)
+        self._top_ps = zeros((slots,), jnp.float32) + 1.0
         self._keys = zeros((slots, 2), jnp.uint32)       # per-row rng
         self._draft_cache = None
         if self._draft_model is not None:
@@ -403,7 +410,8 @@ class DecodeServer:
     def _build_decode(self, n_steps: int):
         dec = self._dec
 
-        def run(params, tokens, cache, cursors, remaining, temps, keys):
+        def run(params, tokens, cache, cursors, remaining, temps,
+                top_ps, keys):
             params = dequantize_tree(params)   # int8 stays HBM-resident
 
             def body(_, carry):
@@ -418,8 +426,21 @@ class DecodeServer:
                 # per-row key advance + greedy/sampled pick (row streams
                 # stay independent of co-resident rows and of admissions)
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
-                nxt = jax.vmap(_next_token)(logits[:, 0], temps,
-                                            split[:, 0])
+                l = logits[:, 0]
+                scaled = l / jnp.maximum(temps, 1e-6)[:, None]
+                # the full-vocab sort+cumsum only runs when some live row
+                # actually asked for a nucleus; the shift-invariance of
+                # categorical's gumbel argmax makes log(softmax) = scaled
+                # up to a per-row constant, so both branches consume the
+                # SAME keys identically for top_p = 1 rows
+                sample_logits = jax.lax.cond(
+                    jnp.any((temps > 0.0) & (top_ps < 1.0)),
+                    lambda: jnp.log(nucleus_probs(scaled, top_ps) + 1e-30),
+                    lambda: jax.nn.log_softmax(scaled, axis=-1))
+                drawn = jax.vmap(jax.random.categorical)(
+                    split[:, 0], sample_logits).astype(jnp.int32)
+                nxt = jnp.where(temps > 0.0, drawn,
+                                jnp.argmax(l, axis=-1).astype(jnp.int32))
                 keys = split[:, 1]
                 wpos = jnp.clip(cursors + 1, 0, self.max_len - 1)
                 old = jnp.take_along_axis(tokens, wpos[:, None], axis=1)[:, 0]
@@ -442,9 +463,9 @@ class DecodeServer:
         # the KV cache is by far the largest buffer and every step returns
         # a fresh one — donation lets XLA update it in place instead of
         # copying it per dispatch. (CPU doesn't implement donation and
-        # would warn.) temps is read-only and not donated.
+        # would warn.) temps/top_ps are read-only and not donated.
         if jax.devices()[0].platform == "tpu":
-            return jax.jit(run, donate_argnums=(1, 2, 3, 4, 6))
+            return jax.jit(run, donate_argnums=(1, 2, 3, 4, 7))
         return jax.jit(run)
 
     def _build_spec_round(self, gamma: int):
@@ -459,7 +480,10 @@ class DecodeServer:
              argmax-matching prefix plus the target's own next token
              (stream EXACTLY the target's greedy sequence); sampled rows
              run the standard rejection scheme, committing tokens whose
-             DISTRIBUTION is exactly the target's sampling distribution.
+             DISTRIBUTION is exactly the target's sampling distribution —
+             including under nucleus sampling: q and p are both the
+             FILTERED distributions, so the same residual math yields
+             exactly the target's nucleus-sampled stream.
 
         Rejected positions leave stale K/V in both caches strictly past
         the new cursors; they are overwritten when those positions are
@@ -468,7 +492,7 @@ class DecodeServer:
         ddec = self._per_row_decode(self._draft_model, self.max_len)
 
         def run(params, dparams, tokens, cache, dcache, cursors,
-                remaining, temps, keys):
+                remaining, temps, top_ps, keys):
             params = dequantize_tree(params)
             dparams = dequantize_tree(dparams)
             active = remaining > 0
@@ -477,6 +501,7 @@ class DecodeServer:
             prev = jnp.take_along_axis(tokens, cursors[:, None],
                                        axis=1)[:, 0]        # [S]
             sampled = temps > 0.0                            # [S]
+            any_nucleus = jnp.any(sampled & (top_ps < 1.0))
             safe_t = jnp.maximum(temps, 1e-6)[:, None]
             # per-row subkeys: γ draft draws + γ accept uniforms +
             # 1 residual/bonus draw + 1 carried-forward key
@@ -495,10 +520,14 @@ class DecodeServer:
                     {"params": dparams, "cache": dcache},
                     tok[:, None], mutable=["cache"])
                 l = logits[:, 0].astype(jnp.float32)         # [S, V]
-                q = jax.nn.softmax(l / safe_t, axis=-1)
+                q = jax.lax.cond(
+                    any_nucleus,
+                    lambda: nucleus_probs(l / safe_t, top_ps),
+                    lambda: jax.nn.softmax(l / safe_t, axis=-1))
                 greedy = jnp.argmax(l, axis=-1).astype(jnp.int32)
                 draw = jax.vmap(jax.random.categorical)(
-                    draft_keys[:, j], l / safe_t).astype(jnp.int32)
+                    draft_keys[:, j],
+                    jnp.log(q + 1e-30)).astype(jnp.int32)
                 nxt = jnp.where(sampled, draw, greedy)
                 return (mutated["cache"], dcur + 1, nxt,
                         props.at[:, j].set(nxt),
@@ -517,7 +546,12 @@ class DecodeServer:
             cache = mutated["cache"]
             logits = logits.astype(jnp.float32)
             tpred = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S,γ+1]
-            pdist = jax.nn.softmax(logits / safe_t[..., None], axis=-1)
+            pdist = jax.lax.cond(
+                any_nucleus,
+                lambda: nucleus_probs(logits / safe_t[..., None],
+                                      top_ps[:, None]),
+                lambda: jax.nn.softmax(logits / safe_t[..., None],
+                                       axis=-1))
 
             # -- 3. acceptance + commit (`spec_commit`) ------------------
             u = jax.vmap(lambda ks: jax.vmap(jax.random.uniform)(ks))(
@@ -546,13 +580,13 @@ class DecodeServer:
             return tokens, cache, dcache, cursors, remaining, keys_out
 
         if jax.devices()[0].platform == "tpu":
-            return jax.jit(run, donate_argnums=(2, 3, 4, 5, 6, 8))
+            return jax.jit(run, donate_argnums=(2, 3, 4, 5, 6, 9))
         return jax.jit(run)
 
     # -- client surface ---------------------------------------------------
 
     def validate(self, tokens: list[int], max_new: int,
-                 temperature: float = 0.0) -> None:
+                 temperature: float = 0.0, top_p: float = 1.0) -> None:
         """Raise ValueError if the request can't fit this server's static
         buckets; shared by every submission front-end (the RPC serving
         loop validates on the caller's thread with this)."""
@@ -580,18 +614,23 @@ class DecodeServer:
             raise ValueError("max_new must be >= 1")
         if temperature < 0.0:
             raise ValueError(f"temperature {temperature} must be >= 0")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p {top_p} must be in (0, 1]")
 
     def submit(self, tokens: list[int], max_new: int, *,
-               temperature: float = 0.0, seed: int | None = None) -> int:
+               temperature: float = 0.0, top_p: float = 1.0,
+               seed: int | None = None) -> int:
         """Queue a prompt; returns the request id. ``temperature`` 0 =
         greedy; > 0 samples with a per-request stream seeded by ``seed``
-        (default: the request id)."""
-        self.validate(tokens, max_new, temperature)
+        (default: the request id); ``top_p`` < 1 restricts sampling to
+        the nucleus, exactly as in `engine.generate`."""
+        self.validate(tokens, max_new, temperature, top_p)
         rid = self._next_id
         self._next_id += 1
         self._queue.append(Request(id=rid, tokens=list(tokens),
                                    max_new=max_new,
-                                   temperature=temperature, seed=seed))
+                                   temperature=temperature, top_p=top_p,
+                                   seed=seed))
         return rid
 
     def poll(self) -> list[Completion]:
@@ -642,9 +681,10 @@ class DecodeServer:
                 self._prefill_model, self.params, jnp.asarray(prompt),
                 jnp.int32(true_len), bucket)
             temp = jnp.float32(req.temperature)
+            topp = jnp.float32(req.top_p)
             seed = req.id if req.seed is None else req.seed
             first, key = _pick_first(last_logits, temp,
-                                     jax.random.PRNGKey(seed))
+                                     jax.random.PRNGKey(seed), topp)
             self._tokens, self._cache = _insert(
                 self._tokens, self._cache, row_cache, jnp.asarray(prompt),
                 first, jnp.int32(true_len), jnp.int32(slot), bucket)
@@ -657,6 +697,7 @@ class DecodeServer:
                                                   jnp.int32(slot))
             self._cursors = self._cursors.at[slot].set(true_len)
             self._temps = self._temps.at[slot].set(temp)
+            self._top_ps = self._top_ps.at[slot].set(topp)
             self._keys = self._keys.at[slot].set(key)
             rem = req.max_new - 1
             if self.eos_id is not None and int(first) == self.eos_id:
@@ -684,12 +725,14 @@ class DecodeServer:
                  self._keys) = self._decode_spec(
                     self.params, self._draft_params, self._tokens,
                     self._cache, self._draft_cache, self._cursors,
-                    self._remaining, self._temps, self._keys)
+                    self._remaining, self._temps, self._top_ps,
+                    self._keys)
             else:
                 (self._tokens, self._cache, self._cursors,
                  self._remaining, self._keys) = self._decode(
                     self.params, self._tokens, self._cache, self._cursors,
-                    self._remaining, self._temps, self._keys)
+                    self._remaining, self._temps, self._top_ps,
+                    self._keys)
             self._stats["dispatches"] += 1
             self._retire_finished()
         return len(self._live) + len(self._queue)

@@ -1,0 +1,32 @@
+"""Shared sampling transforms for the generation tiers.
+
+One implementation of nucleus (top-p) filtering serves both one-shot
+`engine.generate` and the continuous-batching pool / speculative-sampling
+path (`engine.serve_lm`) — the pool's distribution-exactness contract
+depends on the two tiers filtering identically, so the construction lives
+here once. Reference has no sampling at all (`alexnet_resnet.py` serves
+argmax classifications only).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def nucleus_probs(scaled_logits: jnp.ndarray,
+                  top_p: jnp.ndarray) -> jnp.ndarray:
+    """Temperature-scaled logits → nucleus-filtered, renormalized
+    probabilities over the LAST axis (any leading shape; ``top_p``
+    broadcasts over it). top_p >= 1 is the identity. The nucleus is the
+    smallest sorted-probability prefix whose mass reaches top_p, with the
+    target clamped to the achievable float32 cumsum total so round-off
+    near 1.0 can't collapse the nucleus to the argmax token."""
+    probs = jax.nn.softmax(scaled_logits, axis=-1)
+    sorted_p = jnp.flip(jnp.sort(probs, axis=-1), axis=-1)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    target = jnp.minimum(top_p[..., None], cum[..., -1:])
+    k_idx = jnp.argmax(cum >= target, axis=-1)
+    cutoff = jnp.take_along_axis(sorted_p, k_idx[..., None], axis=-1)
+    keep = (probs >= cutoff) | (top_p[..., None] >= 1.0)
+    filt = jnp.where(keep, probs, 0.0)
+    return filt / filt.sum(axis=-1, keepdims=True)

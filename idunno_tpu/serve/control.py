@@ -248,6 +248,7 @@ class ControlService:
             rid = self._lm_loop(p["name"]).submit(
                 [int(t) for t in p["prompt"]], int(p["max_new"]),
                 temperature=float(p.get("temperature", 0.0)),
+                top_p=float(p.get("top_p", 1.0)),
                 seed=(int(p["seed"]) if p.get("seed") is not None
                       else None))
             return {"id": rid}
@@ -357,6 +358,7 @@ class ControlService:
             if verb == "lm_submit":
                 rid = mgr.submit(name, [int(t) for t in p["prompt"]],
                                  int(p["max_new"]),
+                                 top_p=float(p.get("top_p", 1.0)),
                                  temperature=float(
                                      p.get("temperature", 0.0)),
                                  seed=(int(p["seed"])

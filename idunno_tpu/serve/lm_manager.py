@@ -200,7 +200,8 @@ class LMPoolManager:
         return {"node": node, "slots": out.get("slots")}
 
     def submit(self, name: str, prompt: list[int], max_new: int,
-               temperature: float = 0.0, seed: int | None = None) -> int:
+               temperature: float = 0.0, top_p: float = 1.0,
+               seed: int | None = None) -> int:
         """Journal a request (seed pinned NOW — replay after any failure
         must be token-exact even for sampled requests), then forward it to
         the pool's node. Forward failures leave it pending; the pump
@@ -215,6 +216,7 @@ class LMPoolManager:
             req = {"prompt": [int(t) for t in prompt],
                    "max_new": int(max_new),
                    "temperature": float(temperature),
+                   "top_p": float(top_p),
                    "seed": int(seed) if seed is not None else rid,
                    "status": _PENDING, "node_id": None,
                    "tokens": None, "prompt_len": None, "delivered": False,
@@ -232,7 +234,8 @@ class LMPoolManager:
             out = self._call(node, {
                 "verb": "lm_submit", "name": name,
                 "prompt": req["prompt"], "max_new": req["max_new"],
-                "temperature": req["temperature"], "seed": req["seed"]})
+                "temperature": req["temperature"],
+                "top_p": req.get("top_p", 1.0), "seed": req["seed"]})
         except (TransportError, OSError):
             return                      # stays pending; pump will retry
         except ValueError as e:
@@ -884,7 +887,7 @@ class LMPoolManager:
                     # defaults first: a snapshot from an older master may
                     # predate the watchdog/measurement fields
                     "requests": {int(rid): {"t_forwarded": None,
-                                            "attempts": 0,
+                                            "attempts": 0, "top_p": 1.0,
                                             "t_submitted": 0.0, **dict(r)}
                                  for rid, r in p["requests"].items()}}
                 for n, p in snap.get("pools", {}).items()}

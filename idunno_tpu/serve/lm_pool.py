@@ -26,8 +26,9 @@ class LMServingLoop:
     def __init__(self, server: DecodeServer, name: str = "lm") -> None:
         self.server = server
         self._lock = threading.Lock()
-        # (id, toks, max_new, temperature, seed)
-        self._inbox: list[tuple[int, list[int], int, float, int | None]] = []
+        # (id, toks, max_new, temperature, top_p, seed)
+        self._inbox: list[
+            tuple[int, list[int], int, float, float, int | None]] = []
         self._outbox: list[Completion] = []
         self._next_id = 0
         self._id_map: dict[int, int] = {}     # server-side id → public id
@@ -41,13 +42,14 @@ class LMServingLoop:
     # -- any thread -------------------------------------------------------
 
     def submit(self, tokens: list[int], max_new: int, *,
-               temperature: float = 0.0, seed: int | None = None) -> int:
+               temperature: float = 0.0, top_p: float = 1.0,
+               seed: int | None = None) -> int:
         """Validate + queue a prompt; returns the public request id.
         Raises once the pool is stopped — a submit racing `stop()` must
         error loudly, not return an id that never completes."""
         # validate eagerly on the caller's thread so the RPC gets the error
         # (the loop thread has nowhere to raise to)
-        self.server.validate(tokens, max_new, temperature)
+        self.server.validate(tokens, max_new, temperature, top_p)
         with self._lock:
             # checked under the lock: stop() sets the flag BEFORE its own
             # locked inbox drain, so an append here either precedes the
@@ -57,7 +59,7 @@ class LMServingLoop:
             rid = self._next_id
             self._next_id += 1
             self._inbox.append((rid, list(tokens), max_new,
-                                temperature, seed))
+                                temperature, top_p, seed))
         self._wake.set()
         return rid
 
@@ -98,9 +100,9 @@ class LMServingLoop:
     def _drain_inbox(self) -> None:
         with self._lock:
             batch, self._inbox = self._inbox, []
-        for rid, tokens, max_new, temperature, seed in batch:
+        for rid, tokens, max_new, temperature, top_p, seed in batch:
             sid = self.server.submit(tokens, max_new,
-                                     temperature=temperature,
+                                     temperature=temperature, top_p=top_p,
                                      seed=rid if seed is None else seed)
             self._id_map[sid] = rid
 

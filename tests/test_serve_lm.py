@@ -433,3 +433,108 @@ def test_speculative_sampled_requests_complete(lm):
     assert len(s1.tokens) == len(prompt) + 10
     assert all(0 <= t < VOCAB for t in s1.tokens)
     assert s1.tokens == s2.tokens         # pinned seed → reproducible
+
+
+def test_nucleus_probs_masks_tail():
+    """`nucleus_probs` keeps exactly the smallest prefix of sorted mass
+    reaching top_p and renormalizes; top_p=1 is the identity."""
+    import jax.numpy as jnp
+
+    from idunno_tpu.engine.serve_lm import nucleus_probs
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    out = np.asarray(nucleus_probs(logits, jnp.asarray([0.6])))[0]
+    # nucleus = {0.5, 0.3} (0.5 alone < 0.6) → renormalized 0.625/0.375
+    assert np.allclose(out, [0.625, 0.375, 0.0, 0.0], atol=1e-6)
+    ident = np.asarray(nucleus_probs(logits, jnp.asarray([1.0])))[0]
+    assert np.allclose(ident, [0.5, 0.3, 0.15, 0.05], atol=1e-6)
+
+
+def test_pool_top_p_sampling(lm):
+    """top_p in the pool: reproducible per seed, differs from top_p=1 on
+    the same seed (the nucleus genuinely filters), greedy unaffected."""
+    model, params = lm
+    prompt = [5, 11, 17]
+
+    def serve(top_p):
+        srv = DecodeServer(model, params, slots=2, prompt_len=4,
+                           max_len=24)
+        rid = srv.submit(prompt, max_new=10, temperature=1.5,
+                         top_p=top_p, seed=42)
+        g = srv.submit(prompt, max_new=10)
+        done = {c.id: c for c in srv.run_until_drained()}
+        return done[rid].tokens, done[g].tokens
+
+    a1, g1 = serve(0.3)
+    a2, g2 = serve(0.3)
+    b1, _ = serve(1.0)
+    assert a1 == a2                     # seeded nucleus stream reproducible
+    assert g1 == g2 == expected(model, params, prompt, 10)
+    assert a1 != b1                     # the filter changed the stream
+    with pytest.raises(ValueError, match="top_p"):
+        serve(0.0)
+    with pytest.raises(ValueError, match="top_p"):
+        serve(1.5)
+
+
+def test_speculative_top_p_requests_complete(lm):
+    """Nucleus-sampled requests on a speculative pool: q and p are both
+    the filtered distributions, so the rejection math carries over —
+    requests complete, are seed-reproducible, and greedy co-residents
+    stay token-exact."""
+    model, params = lm
+    prompt = [3, 1, 4]
+
+    def run():
+        srv = DecodeServer(model, params, slots=2, prompt_len=4,
+                           max_len=40, draft=(model, params), draft_len=3)
+        rid_s = srv.submit(prompt, max_new=10, temperature=0.9,
+                           top_p=0.8, seed=7)
+        rid_g = srv.submit(prompt, max_new=10)
+        done = {c.id: c for c in srv.run_until_drained()}
+        return done[rid_s], done[rid_g]
+
+    s1, g1 = run()
+    s2, g2 = run()
+    assert g1.tokens == g2.tokens == expected(model, params, prompt, 10)
+    assert s1.tokens == s2.tokens
+    assert len(s1.tokens) == len(prompt) + 10
+    assert all(0 <= t < VOCAB for t in s1.tokens)
+
+
+def test_spec_commit_distribution_exact_with_nucleus():
+    """Distribution exactness under nucleus sampling: with q and p both
+    nucleus-FILTERED, the first committed token is distributed exactly as
+    the filtered target distribution."""
+    import jax
+    import jax.numpy as jnp
+
+    from idunno_tpu.engine.serve_lm import nucleus_probs, spec_commit
+
+    vocab, gamma, trials = 5, 2, 20_000
+    p_raw = jnp.log(jnp.asarray([0.05, 0.45, 0.10, 0.25, 0.15]))
+    q_raw = jnp.log(jnp.asarray([0.50, 0.05, 0.20, 0.05, 0.20]))
+    top_p = jnp.asarray([0.75])
+    pf = nucleus_probs(p_raw[None], top_p)[0]   # filtered target
+    qf = nucleus_probs(q_raw[None], top_p)[0]   # filtered draft
+
+    def one_trial(key):
+        ks = jax.random.split(key, 2 * gamma + 1)
+        props = jnp.stack([
+            jax.random.categorical(ks[j], jnp.log(qf + 1e-30))
+            for j in range(gamma)]).astype(jnp.int32)[None]
+        qd = jnp.broadcast_to(qf, (1, gamma, vocab))
+        pd = jnp.broadcast_to(pf, (1, gamma + 1, vocab))
+        tpred = jnp.argmax(pd, axis=-1).astype(jnp.int32)
+        u = jnp.stack([jax.random.uniform(ks[gamma + j])
+                       for j in range(gamma)])[None]
+        cand, _ = spec_commit(props, qd, pd, tpred,
+                              jnp.asarray([True]), u, ks[-1:][0][None])
+        return cand[0, 0]
+
+    toks = jax.jit(jax.vmap(one_trial))(
+        jax.random.split(jax.random.PRNGKey(1), trials))
+    emp = np.bincount(np.asarray(toks), minlength=vocab) / trials
+    assert np.abs(emp - np.asarray(pf)).max() < 0.02, (emp, pf)
+    # tokens outside the nucleus are NEVER committed as the first token
+    assert emp[np.asarray(pf) == 0].max() == 0.0
