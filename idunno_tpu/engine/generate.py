@@ -207,8 +207,8 @@ def beam_search(model: TransformerLM, params: Any, prompt: jnp.ndarray,
 # JSON header + flax bytes), so a save is atomic and any historical version
 # pairs its architecture with its own weights.
 
-_LM_CONFIG_FIELDS = ("vocab", "dim", "depth", "num_heads", "causal",
-                     "ffn_every", "remat")
+_LM_CONFIG_FIELDS = ("vocab", "dim", "depth", "num_heads",
+                     "num_kv_heads", "causal", "ffn_every", "remat")
 
 
 def lm_store_name(name: str) -> str:

@@ -86,6 +86,7 @@ def _submodules(model: TransformerLM):
     subtrees (flax @compact naming is module-local, so a standalone apply
     over the extracted subtree is exact)."""
     block = Block(dim=model.dim, num_heads=model.num_heads,
+                  num_kv_heads=model.num_kv_heads,
                   causal=model.causal, attn_fn=model.attn_fn,
                   dtype=model.dtype, param_dtype=model.param_dtype)
     embed = nn.Embed(model.vocab, model.dim, dtype=model.dtype,

@@ -434,7 +434,8 @@ class DecodeServer:
                 # up to a per-row constant, so both branches consume the
                 # SAME keys identically for top_p = 1 rows
                 sample_logits = jax.lax.cond(
-                    jnp.any((temps > 0.0) & (top_ps < 1.0)),
+                    jnp.any((remaining > 0) & (temps > 0.0)
+                            & (top_ps < 1.0)),
                     lambda: jnp.log(nucleus_probs(scaled, top_ps) + 1e-30),
                     lambda: jax.nn.log_softmax(scaled, axis=-1))
                 drawn = jax.vmap(jax.random.categorical)(
@@ -501,7 +502,7 @@ class DecodeServer:
             prev = jnp.take_along_axis(tokens, cursors[:, None],
                                        axis=1)[:, 0]        # [S]
             sampled = temps > 0.0                            # [S]
-            any_nucleus = jnp.any(sampled & (top_ps < 1.0))
+            any_nucleus = jnp.any(active & sampled & (top_ps < 1.0))
             safe_t = jnp.maximum(temps, 1e-6)[:, None]
             # per-row subkeys: γ draft draws + γ accept uniforms +
             # 1 residual/bonus draw + 1 carried-forward key

@@ -97,10 +97,17 @@ class MultiHeadAttention(nn.Module):
     """Pluggable-kernel attention; ``decode=True`` switches to single-token
     autoregressive serving with a KV cache in the flax "cache" collection
     (zero-init via `init`, threaded through `apply(..., mutable=["cache"])`
-    by `idunno_tpu.engine.generate`)."""
+    by `idunno_tpu.engine.generate`).
+
+    ``num_kv_heads`` < num_heads is grouped-query attention (GQA): groups
+    of query heads share one K/V head, shrinking the decode KV cache —
+    the dominant HBM tenant of long-context serving — by the group factor
+    while the MXU compute shape is unchanged. num_kv_heads == num_heads
+    (default) is exact MHA; num_kv_heads == 1 is MQA."""
 
     dim: int
     num_heads: int
+    num_kv_heads: int | None = None
     causal: bool = True
     attn_fn: AttnFn = full_attention
     use_rope: bool = True
@@ -110,19 +117,40 @@ class MultiHeadAttention(nn.Module):
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
+    @property
+    def _kv_heads(self) -> int:
+        kv = (self.num_heads if self.num_kv_heads is None
+              else self.num_kv_heads)
+        if kv < 1:
+            raise ValueError(f"num_kv_heads {kv} must be >= 1 "
+                             "(1 = MQA; None = MHA)")
+        if self.num_heads % kv:
+            raise ValueError(f"num_heads {self.num_heads} must be a "
+                             f"multiple of num_kv_heads {kv}")
+        return kv
+
     @nn.compact
     def __call__(self, x):
         b, t, _ = x.shape
         head_dim = self.dim // self.num_heads
+        kv_heads = self._kv_heads
         dense = partial(nn.DenseGeneral, dtype=self.dtype,
                         param_dtype=self.param_dtype)
         q = dense(features=(self.num_heads, head_dim), name="q")(x)
-        k = dense(features=(self.num_heads, head_dim), name="k")(x)
-        v = dense(features=(self.num_heads, head_dim), name="v")(x)
+        k = dense(features=(kv_heads, head_dim), name="k")(x)
+        v = dense(features=(kv_heads, head_dim), name="v")(x)
         if self.decode:
             return self._decode_step(q, k, v)
         if self.use_rope:
             q, k = rope(q), rope(k)
+        if kv_heads != self.num_heads:
+            # the training/prefill forward repeats K/V up to the query
+            # heads so every attn_fn (full/flash/ring/ulysses) runs
+            # unchanged — the GQA saving is the CACHE, which only the
+            # decode path holds
+            rep = self.num_heads // kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         out = self.attn_fn(q, k, v, causal=self.causal)
         return nn.DenseGeneral(features=self.dim, axis=(-2, -1),
                                dtype=self.dtype,
@@ -154,10 +182,11 @@ class MultiHeadAttention(nn.Module):
                              "(autoregressive serving of a bidirectional "
                              "model would silently change its semantics)")
         b, t, h, d = q.shape
+        kv_heads = k.shape[2]          # < h under GQA: the cache saving
         ck = self.variable("cache", "cached_k", jnp.zeros,
-                           (b, self.max_decode_len, h, d), k.dtype)
+                           (b, self.max_decode_len, kv_heads, d), k.dtype)
         cv = self.variable("cache", "cached_v", jnp.zeros,
-                           (b, self.max_decode_len, h, d), v.dtype)
+                           (b, self.max_decode_len, kv_heads, d), v.dtype)
         if self.decode_per_row:
             cur = self.variable("cache", "cursors",
                                 lambda: jnp.zeros((b,), jnp.int32))
@@ -182,7 +211,7 @@ class MultiHeadAttention(nn.Module):
             # [B, 1, t, T]: row r's chunk position j attends slots ≤ i[r]+j
             mask = (jnp.arange(self.max_decode_len)[None, None, :]
                     <= pos_bt[:, :, None])[:, None, :, :]
-            poison = overflow[:, None, None, None]
+            poison = overflow[:, None, None, None, None]
         else:
             cur = self.variable("cache", "cursor",
                                 lambda: jnp.zeros((), jnp.int32))
@@ -201,13 +230,21 @@ class MultiHeadAttention(nn.Module):
             mask = (jnp.arange(self.max_decode_len)[None, :]
                     <= (i + jnp.arange(t))[:, None])[None, None, :, :]
             poison = overflow
-        scores = jnp.einsum("bqhd,bthd->bhqt", q.astype(jnp.float32),
+        # grouped attention against the (possibly narrower) cache: query
+        # heads reshape to [.., kv_heads, group, d] so the einsum reads
+        # the small cache straight from HBM — no repeat materialization.
+        # group == 1 is exact MHA (identical contraction).
+        group = h // kv_heads
+        q5 = q.reshape(b, t, kv_heads, group, d)
+        scores = jnp.einsum("bqhgd,bthd->bhgqt", q5.astype(jnp.float32),
                             new_k.astype(jnp.float32)) / (d ** 0.5)
+        mask = mask[:, :, None]          # broadcast over the group axis
         scores = jnp.where(poison, jnp.nan, scores)
         scores = jnp.where(mask, scores, -jnp.inf)
         weights = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhqt,bthd->bqhd", weights,
+        out = jnp.einsum("bhgqt,bthd->bqhgd", weights,
                          new_v.astype(jnp.float32)).astype(self.dtype)
+        out = out.reshape(b, t, h, d)
         return nn.DenseGeneral(features=self.dim, axis=(-2, -1),
                                dtype=self.dtype,
                                param_dtype=self.param_dtype,
@@ -221,6 +258,7 @@ class Block(nn.Module):
 
     dim: int
     num_heads: int
+    num_kv_heads: int | None = None
     mlp_ratio: int = 4
     causal: bool = True
     attn_fn: AttnFn = full_attention
@@ -237,7 +275,8 @@ class Block(nn.Module):
         ln = partial(nn.LayerNorm, dtype=self.dtype,
                      param_dtype=self.param_dtype)
         x = x + MultiHeadAttention(
-            self.dim, self.num_heads, causal=self.causal,
+            self.dim, self.num_heads, num_kv_heads=self.num_kv_heads,
+            causal=self.causal,
             attn_fn=self.attn_fn, use_rope=self.use_rope,
             decode=self.decode, max_decode_len=self.max_decode_len,
             decode_per_row=self.decode_per_row,
@@ -267,6 +306,7 @@ class TransformerLM(nn.Module):
     dim: int = 128
     depth: int = 2
     num_heads: int = 4
+    num_kv_heads: int | None = None   # < num_heads = GQA; None = MHA
     causal: bool = True
     attn_fn: AttnFn = full_attention
     ffn_factory: FfnFactory | None = None
@@ -291,7 +331,9 @@ class TransformerLM(nn.Module):
         for i in range(self.depth):
             use_ffn = (self.ffn_factory is not None
                        and (self.depth - 1 - i) % self.ffn_every == 0)
-            x = block_cls(self.dim, self.num_heads, causal=self.causal,
+            x = block_cls(self.dim, self.num_heads,
+                          num_kv_heads=self.num_kv_heads,
+                          causal=self.causal,
                           attn_fn=self.attn_fn,
                           ffn_factory=self.ffn_factory if use_ffn else None,
                           decode=self.decode,

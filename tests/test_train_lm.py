@@ -264,3 +264,33 @@ def test_lm_mixed_precision_training():
     assert all(np.isfinite(losses)) and losses[-1] < losses[0] * 0.9
     assert all(np.asarray(p).dtype == np.float32
                for p in jax.tree.leaves(state.params))
+
+
+def test_lm_pipeline_supports_gqa(eight_devices):
+    """A grouped-query LM pipelines too: the per-stage Block rebuild must
+    carry num_kv_heads (a mismatch would bind (dim, kv, hd) stage params
+    against a full-head Block declaration and crash in flax)."""
+    from jax.sharding import Mesh
+    from idunno_tpu.engine.pipeline_lm import (
+        create_pipelined_lm_train_state, jit_pipelined_lm_train_step,
+        shard_pipelined_state)
+    from idunno_tpu.parallel.pipeline import STAGE_AXIS
+
+    p, depth, b, t = 2, 2, 4, 16
+    mesh = Mesh(np.asarray(eight_devices[:p]), (STAGE_AXIS,))
+    model = TransformerLM(vocab=64, dim=32, depth=depth, num_heads=4,
+                          num_kv_heads=2)
+    tx = optax.adam(1e-2)
+    toks = _tokens(11, b=b, t=t)
+
+    state_d = create_lm_train_state(model, jax.random.PRNGKey(0), t, tx)
+    step_d = jax.jit(make_lm_train_step(model, tx))
+    state_p = create_pipelined_lm_train_state(
+        model, jax.random.PRNGKey(0), t, tx, num_stages=p)
+    state_p = shard_pipelined_state(state_p, mesh)
+    step_p = jit_pipelined_lm_train_step(model, mesh, tx,
+                                         num_microbatches=2)
+    state_d, m_d = step_d(state_d, toks)
+    state_p, m_p = step_p(state_p, toks)
+    np.testing.assert_allclose(float(m_p["loss"]), float(m_d["loss"]),
+                               rtol=2e-4, atol=2e-4)
