@@ -24,6 +24,12 @@ codebase, so it carries its own measured surface (round-3 VERDICT weak #3):
   int8      — the same steady-state decode with int8 weight-only residency
               (`ops/quantize.py`): decode re-reads every weight per step, so
               residency is the lever.
+  gqa       — the same decode with `num_kv_heads` < heads (grouped-query
+              attention): the KV cache shrinks by the group factor; the
+              record carries both models' param counts so the weight-side
+              saving is separable from the cache saving.
+  flash_bwd — the custom-VJP Pallas backward kernels compiled + timed
+              (TPU only, and only when the forward built).
 
 Every knob is env-overridable (BENCH_LM_*); `bench.py` embeds the compact
 record in the default run and serves the full suite as ``BENCH_SUITE=lm``.
@@ -62,6 +68,9 @@ def lm_bench_config(platform: str) -> dict:
         "draft_dim": _env_int("BENCH_LM_DRAFT_DIM", 256 if tpu else 64),
         "draft_depth": _env_int("BENCH_LM_DRAFT_DEPTH", 2 if tpu else 1),
         "draft_len": _env_int("BENCH_LM_DRAFT_LEN", 4),
+        # full-suite GQA comparison point: same model with this many K/V
+        # heads (must divide heads; 0 disables the point)
+        "gqa_kv_heads": _env_int("BENCH_LM_GQA_KV_HEADS", 4 if tpu else 1),
     }
 
 
@@ -94,9 +103,9 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
                  compact: bool = False) -> dict:
     """One measured LM record. ``deadline`` is a perf_counter() stamp after
     which optional phases are skipped (each phase is a fresh compile through
-    a slow tunnel). ``compact`` drops the speculative + int8 phases (the
-    unattended default run embeds the compact record; BENCH_SUITE=lm runs
-    everything)."""
+    a slow tunnel). ``compact`` drops the speculative, int8 and gqa phases
+    (the unattended default run embeds the compact record; BENCH_SUITE=lm
+    runs everything)."""
     from idunno_tpu.engine.serve_lm import DecodeServer
     from idunno_tpu.models.transformer import TransformerLM, make_attn_fn
 
@@ -190,15 +199,24 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
                                 "error": f"{type(e).__name__}: {e}"}
 
     # -- steady-state decode ----------------------------------------------
-    srv = DecodeServer(model, params, slots=cfg["slots"],
-                       prompt_len=cfg["prompt_len"], max_len=cfg["max_len"],
-                       decode_steps=cfg["decode_steps"])
-    warm = srv.submit([1, 2, 3], max_new=cfg["decode_steps"] + 1)
-    t0 = time.perf_counter()
-    srv.run_until_drained()
-    out["decode_compile_s"] = round(time.perf_counter() - t0, 2)
-    assert warm == 0
-    tok_s, k = _steady_decode_tok_s(srv, cfg)
+    def measure_pool(m, p, **server_kw):
+        """Build a pool, pay its compiles on a warm-up request, then
+        measure steady-state decode tokens/sec — the shared protocol for
+        the plain/int8/GQA points. Returns (tok/s, timed dispatches,
+        compile seconds)."""
+        srv = DecodeServer(m, p, slots=cfg["slots"],
+                           prompt_len=cfg["prompt_len"],
+                           max_len=cfg["max_len"],
+                           decode_steps=cfg["decode_steps"], **server_kw)
+        srv.submit([1, 2, 3], max_new=cfg["decode_steps"] + 1)
+        t0 = time.perf_counter()
+        srv.run_until_drained()
+        c_s = time.perf_counter() - t0
+        ts, kk = _steady_decode_tok_s(srv, cfg)
+        return ts, kk, c_s
+
+    tok_s, k, compile_s = measure_pool(model, params)
+    out["decode_compile_s"] = round(compile_s, 2)
     out["decode"] = {
         "tokens_per_s": round(tok_s, 1),
         "slots": cfg["slots"], "decode_steps": cfg["decode_steps"],
@@ -210,7 +228,6 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
     }
     if peak_bf16:
         out["decode"]["mfu"] = round(tok_s * 2.0 * n_params / peak_bf16, 4)
-    del srv
 
     # -- speculative best-case + int8 residency (full suite only) ---------
     if not compact and time.perf_counter() < deadline:
@@ -264,20 +281,43 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
 
     if not compact and time.perf_counter() < deadline:
         try:
-            q8 = DecodeServer(model, params, slots=cfg["slots"],
-                              prompt_len=cfg["prompt_len"],
-                              max_len=cfg["max_len"],
-                              decode_steps=cfg["decode_steps"],
-                              quantize="int8")
-            q8.submit([1, 2, 3], max_new=cfg["decode_steps"] + 1)
-            q8.run_until_drained()                       # compile
-            tok8, _ = _steady_decode_tok_s(q8, cfg)
+            tok8, _, _ = measure_pool(model, params, quantize="int8")
             out["int8_decode"] = {
                 "tokens_per_s": round(tok8, 1),
                 "vs_bf16": round(tok8 / tok_s, 2),
             }
-            del q8
         except Exception as e:  # noqa: BLE001
             out["int8_decode"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # GQA decode point LAST (a new phase must never eat the budget of the
+    # previously-established int8 surface): same architecture with fewer
+    # K/V heads. The cache shrinks by the group factor; the K/V
+    # projections also shrink (params_* fields expose the weight-side
+    # confound), so vs_mha bundles cache bandwidth + weight streaming.
+    kvh = cfg["gqa_kv_heads"]
+    if (not compact and kvh and kvh != cfg["heads"]
+            and cfg["heads"] % kvh == 0
+            and time.perf_counter() < deadline):
+        try:
+            gq_model = TransformerLM(
+                vocab=cfg["vocab"], dim=cfg["dim"], depth=cfg["depth"],
+                num_heads=cfg["heads"], num_kv_heads=kvh,
+                causal=True, dtype=dt, param_dtype=dt)
+            gq_params = gq_model.init(
+                jax.random.PRNGKey(2),
+                jnp.zeros((1, 8), jnp.int32))["params"]
+            gq_n, _ = _count_params(gq_params)
+            tokg, _, _ = measure_pool(gq_model, gq_params)
+            out["gqa_decode"] = {
+                "kv_heads": kvh, "heads": cfg["heads"],
+                "tokens_per_s": round(tokg, 1),
+                "vs_mha": round(tokg / tok_s, 2),
+                "params_mha": n_params, "params_gqa": gq_n,
+                "kv_cache_bytes_per_slot": int(
+                    2 * cfg["max_len"] * kvh
+                    * (cfg["dim"] // cfg["heads"]) * 2 * cfg["depth"]),
+            }
+        except Exception as e:  # noqa: BLE001
+            out["gqa_decode"] = {"error": f"{type(e).__name__}: {e}"}
 
     return out

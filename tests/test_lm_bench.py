@@ -16,7 +16,7 @@ TINY = {
     "BENCH_LM_MAXNEW": "16", "BENCH_LM_MAXLEN": "64",
     "BENCH_LM_DECODE_STEPS": "4", "BENCH_LM_PREFILL_BATCH": "2",
     "BENCH_LM_PREFILL_SEQ": "32", "BENCH_LM_DRAFT_DIM": "32",
-    "BENCH_LM_DRAFT_DEPTH": "1",
+    "BENCH_LM_DRAFT_DEPTH": "1", "BENCH_LM_GQA_KV_HEADS": "1",
 }
 
 
@@ -45,12 +45,15 @@ def test_full_suite_record_shape(tiny_env):
     assert rec["speculative"]["avg_commit_per_round"] > 1.5
     assert rec["speculative"]["tokens_per_s"] > 0
     assert rec["int8_decode"]["tokens_per_s"] > 0
+    assert rec["gqa_decode"]["tokens_per_s"] > 0
+    assert rec["gqa_decode"]["kv_heads"] == 1
 
 
 def test_compact_skips_optional_phases(tiny_env):
     rec = run_lm_bench("cpu", "cpu", 1, None,
                        deadline=time.perf_counter() + 600, compact=True)
     assert "speculative" not in rec and "int8_decode" not in rec
+    assert "gqa_decode" not in rec
     assert rec["decode"]["tokens_per_s"] > 0
 
 
