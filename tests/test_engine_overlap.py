@@ -9,6 +9,8 @@ emulated sequential load-everything-then-infer path by ≥1.5×.
 """
 import time
 
+import pytest
+
 import numpy as np
 
 from idunno_tpu.config import EngineConfig
@@ -16,7 +18,12 @@ from idunno_tpu.engine.inference import InferenceEngine
 from idunno_tpu.parallel.mesh import local_mesh
 
 
+@pytest.mark.slow
 def test_infer_overlaps_decode_with_compute(eight_devices, monkeypatch):
+    """Wall-clock ratio assertion (1.2x overlap win): a TIMING test — it
+    belongs to the serial `slow` suite, where it is reliable; under an
+    xdist parallel lane the injected per-chunk delay is measured on a
+    loaded box and the ratio flakes (long-standing known flake)."""
     bs, k = 32, 8
     eng = InferenceEngine(
         EngineConfig(batch_size=bs, image_size=64, resize_size=64),

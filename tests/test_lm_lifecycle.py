@@ -207,7 +207,7 @@ def test_continuous_batching_served_over_control_rpc(stores):
             t.join()
 
         done = {}
-        deadline = time.time() + 60.0
+        deadline = time.time() + 180.0
         while time.time() < deadline and len(done) < len(prompts):
             out = call({"verb": "lm_poll", "name": "pool"})
             assert out.type is MessageType.ACK, out.payload
@@ -275,7 +275,7 @@ def test_speculative_pool_over_rpc(stores):
                     "prompt": prompt, "max_new": 8})
         assert out.type is MessageType.ACK, out.payload
         rid, got = out.payload["id"], None
-        deadline = time.time() + 60.0
+        deadline = time.time() + 180.0
         while time.time() < deadline and got is None:
             for c in call({"verb": "lm_poll",
                            "name": "spec-target"}).payload["completions"]:
@@ -329,7 +329,7 @@ def test_train_job_over_rpc_then_serve(stores):
         assert out.type is MessageType.ACK, out.payload
 
         st = {}
-        deadline = time.time() + 120.0
+        deadline = time.time() + 300.0
         while time.time() < deadline:
             out = call({"verb": "train_status", "name": "rpclm"})
             assert out.type is MessageType.ACK, out.payload
@@ -354,7 +354,7 @@ def test_train_job_over_rpc_then_serve(stores):
         assert out.type is MessageType.ACK, out.payload
         rid = out.payload["id"]
         got = None
-        deadline = time.time() + 60.0
+        deadline = time.time() + 180.0
         while time.time() < deadline and got is None:
             out = call({"verb": "lm_poll", "name": "rpclm"})
             for c in out.payload["completions"]:
@@ -387,7 +387,7 @@ def test_train_job_stop_and_resume(stores):
     job = LMTrainJob(stores["n1"], "stoplm", corpus="corpus/stop",
                      model_config=cfg, steps=10_000, batch_size=4,
                      seq_len=16, checkpoint_every=3)
-    deadline = time.time() + 120.0
+    deadline = time.time() + 300.0
     while time.time() < deadline and job.status()["step"] < 4:
         time.sleep(0.05)
     assert job.status()["step"] >= 4, job.status()
