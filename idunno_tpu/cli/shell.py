@@ -393,7 +393,8 @@ class Shell:
     def cmd_lm_serve(self, args: list[str]) -> str:
         if len(args) < 3:
             return ("usage: lm-serve <name> <prompt_len> <max_len> "
-                    "[slots= decode_steps= quantize=int8 eos_id=N "
+                    "[slots= decode_steps= quantize=int8 "
+                    "kv_cache_dtype=int8 eos_id=N "
                     "draft=<lm> draft_len=N place=1 reload=1]\n"
                     "note: draft (speculative) pools serve greedy "
                     "requests token-exact and sampled requests "
@@ -404,6 +405,8 @@ class Shell:
                              "draft_len") if k in kv}
         if "quantize" in kv:
             payload["quantize"] = kv.pop("quantize")
+        if "kv_cache_dtype" in kv:
+            payload["kv_cache_dtype"] = kv.pop("kv_cache_dtype")
         if "draft" in kv:
             payload["draft"] = kv.pop("draft")
         if "place" in kv and kv.pop("place") not in ("0", "false", ""):

@@ -208,7 +208,8 @@ def beam_search(model: TransformerLM, params: Any, prompt: jnp.ndarray,
 # pairs its architecture with its own weights.
 
 _LM_CONFIG_FIELDS = ("vocab", "dim", "depth", "num_heads",
-                     "num_kv_heads", "causal", "ffn_every", "remat")
+                     "num_kv_heads", "causal", "ffn_every",
+                     "kv_cache_dtype", "remat")
 
 
 def lm_store_name(name: str) -> str:

@@ -130,12 +130,14 @@ def _splice_rows(cache: Any, row_cache: Any, slot: jnp.ndarray) -> Any:
     """Write a batch-1 prefill cache's K/V rows into row ``slot`` of a
     pool cache. The two trees' structures differ only at the cursor leaves
     (scalar "cursor" in the prefill cache vs caller-owned [S] "cursors"
-    in the pool) — K/V leaves match by path, everything else untouched."""
+    in the pool) — K/V (and, for int8 caches, their scale) leaves match
+    by path, everything else untouched."""
     src = {jax.tree_util.keystr(p): leaf for p, leaf
            in jax.tree_util.tree_flatten_with_path(row_cache)[0]}
 
     def splice(path, dst):
-        if getattr(path[-1], "key", None) not in ("cached_k", "cached_v"):
+        if getattr(path[-1], "key", None) not in (
+                "cached_k", "cached_v", "k_scale", "v_scale"):
             return dst
         kv = src[jax.tree_util.keystr(path)]          # [1, P, h, d]
         dst_row = jax.lax.dynamic_update_slice(

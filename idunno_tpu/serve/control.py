@@ -202,6 +202,12 @@ class ControlService:
             # build (store fetch + device-state allocation) and the old
             # loop's stop() run outside it, behind a reservation
             # placeholder, so other verbs never stall behind a slow serve
+            # validate BEFORE touching the registry: a reload request with
+            # a bad option must fail without stopping the live loop
+            if p.get("kv_cache_dtype") not in (None, "native", "int8"):
+                raise ValueError(
+                    f"kv_cache_dtype {p['kv_cache_dtype']!r}: "
+                    "want native|int8")
             placeholder = _Starting()
             with self._reg_lock:
                 old = self._lm_loops.get(name)
@@ -213,6 +219,12 @@ class ControlService:
                 if old is not None:
                     old.stop()
                 model, params = load_lm(node.store, name)
+                if p.get("kv_cache_dtype"):
+                    # serve-time override: e.g. int8 KV residency for a
+                    # model stored with a native cache (weights unchanged)
+                    import dataclasses as _dc
+                    model = _dc.replace(
+                        model, kv_cache_dtype=p["kv_cache_dtype"])
                 draft = None
                 if p.get("draft"):
                     # speculative decoding: the draft is another

@@ -497,3 +497,104 @@ def test_train_checkpoint_restore_generate(stores):
     out_rolled = generate(model, rolled["params"], prompt, prompt_len=4,
                           max_new=6)
     assert (np.asarray(out_rolled) != np.asarray(out_live)).any()
+
+
+def test_int8_kv_cache_pool_over_rpc(stores):
+    """`lm_serve kv_cache_dtype=int8` on a store-persisted NATIVE-cache
+    model: the serve-time override swaps the cache layout without
+    touching the stored weights, and completions match the int8-cache
+    generate stream."""
+    import dataclasses
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from idunno_tpu.engine.generate import generate, save_lm
+    from idunno_tpu.models.transformer import TransformerLM
+    from idunno_tpu.serve.control import ControlService
+    from idunno_tpu.comm.message import Message
+    from idunno_tpu.utils.types import MessageType
+
+    model = TransformerLM(vocab=32, dim=32, depth=1, num_heads=4)
+    params = model.init(jax.random.PRNGKey(5),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    save_lm(stores["n0"], "kv8", model, params)
+
+    node = type("NodeStub", (), {})()
+    node.host, node.store = "n1", stores["n1"]
+    node.transport = stores["n1"].transport
+    ctl = ControlService(node)
+
+    def call(payload):
+        return ctl._handle("control", Message(
+            MessageType.INFERENCE, "client", payload))
+
+    try:
+        out = call({"verb": "lm_serve", "name": "kv8", "slots": 2,
+                    "prompt_len": 4, "max_len": 16,
+                    "kv_cache_dtype": "int8"})
+        assert out.type is MessageType.ACK, out.payload
+        prompt = [3, 9, 14]
+        rid = call({"verb": "lm_submit", "name": "kv8",
+                    "prompt": prompt, "max_new": 6}).payload["id"]
+        got = None
+        deadline = time.time() + 180.0
+        while time.time() < deadline and got is None:
+            for c in call({"verb": "lm_poll",
+                           "name": "kv8"}).payload["completions"]:
+                if c["id"] == rid:
+                    got = c
+            time.sleep(0.05)
+        assert got is not None
+        m8 = dataclasses.replace(model, kv_cache_dtype="int8")
+        want = generate(m8, params, jnp.asarray([prompt], jnp.int32),
+                        prompt_len=3, max_new=6)
+        assert got["tokens"] == [int(t) for t in np.asarray(want[0])]
+    finally:
+        ctl.close()
+
+
+def test_bad_kv_cache_dtype_does_not_kill_live_pool(stores):
+    """A typo'd `kv_cache_dtype` on a reload must be rejected BEFORE the
+    old serving loop is stopped — a live pool must never be destroyed by
+    a bad option."""
+    import jax
+    import jax.numpy as jnp
+
+    from idunno_tpu.engine.generate import save_lm
+    from idunno_tpu.models.transformer import TransformerLM
+    from idunno_tpu.serve.control import ControlService
+    from idunno_tpu.comm.message import Message
+    from idunno_tpu.utils.types import MessageType
+
+    model = TransformerLM(vocab=32, dim=32, depth=1, num_heads=4)
+    params = model.init(jax.random.PRNGKey(6),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    save_lm(stores["n0"], "kvbad", model, params)
+
+    node = type("NodeStub", (), {})()
+    node.host, node.store = "n1", stores["n1"]
+    node.transport = stores["n1"].transport
+    ctl = ControlService(node)
+
+    def call(payload):
+        return ctl._handle("control", Message(
+            MessageType.INFERENCE, "client", payload))
+
+    try:
+        out = call({"verb": "lm_serve", "name": "kvbad", "slots": 1,
+                    "prompt_len": 4, "max_len": 12})
+        assert out.type is MessageType.ACK, out.payload
+        out = call({"verb": "lm_serve", "name": "kvbad", "slots": 1,
+                    "prompt_len": 4, "max_len": 12, "reload": True,
+                    "kv_cache_dtype": "int8x"})
+        assert out.type is MessageType.ERROR
+        assert "kv_cache_dtype" in out.payload["error"]
+        # the ORIGINAL loop still serves
+        out = call({"verb": "lm_submit", "name": "kvbad",
+                    "prompt": [1, 2], "max_new": 2})
+        assert out.type is MessageType.ACK, out.payload
+    finally:
+        ctl.close()

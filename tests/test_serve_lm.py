@@ -538,3 +538,43 @@ def test_spec_commit_distribution_exact_with_nucleus():
     assert np.abs(emp - np.asarray(pf)).max() < 0.02, (emp, pf)
     # tokens outside the nucleus are NEVER committed as the first token
     assert emp[np.asarray(pf) == 0].max() == 0.0
+
+
+def test_int8_kv_cache_pool_matches_its_own_generate(lm):
+    """kv_cache_dtype="int8": the cache stores int8 values + per-(row,
+    position, head) scales at a quarter of the float32 footprint. The
+    pool and one-shot generate share the quantized math, so the pool
+    stays token-exact vs generate ON THE SAME MODEL; drift vs the
+    native-cache model is bounded (lossy by design, opt-in)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from idunno_tpu.engine.generate import init_cache
+
+    model, params = lm
+    m8 = dataclasses.replace(model, kv_cache_dtype="int8")
+
+    cache = init_cache(m8, 2, 16)
+    leaf = cache["block0"]["attn"]["cached_k"]
+    assert leaf.dtype == jnp.int8
+    assert cache["block0"]["attn"]["k_scale"].shape == (2, 16, 4)
+
+    prompt = [5, 11, 17]
+    want8 = expected(m8, params, prompt, 10)       # int8-cache generate
+    srv = DecodeServer(m8, params, slots=2, prompt_len=4, max_len=24)
+    a = srv.submit(prompt, max_new=10)
+    b = srv.submit([2, 7], max_new=6)
+    done = {c.id: c for c in srv.run_until_drained()}
+    assert done[a].tokens == want8                 # pool == its generate
+    assert done[b].tokens == expected(m8, params, [2, 7], 6)
+
+    # bounded drift vs the native cache (tiny model: logit error well
+    # under 2% of the logit range)
+    import numpy as np
+
+    from idunno_tpu.engine.generate import stepwise_logits
+    toks = jax.random.randint(jax.random.PRNGKey(3), (2, 10), 0, VOCAB)
+    l8 = np.asarray(stepwise_logits(m8, params, toks))
+    lf = np.asarray(model.apply({"params": params}, toks))
+    assert np.abs(l8 - lf).max() < 0.02 * (lf.max() - lf.min() + 1e-9) + 0.05
