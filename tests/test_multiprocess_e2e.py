@@ -70,26 +70,31 @@ def _control(port: int, verb: str, timeout: float = 30.0, **kw) -> dict:
     return out.payload
 
 
-def test_cluster_multiprocess_kill9(tmp_path):
-    base = _free_port_base()
-    hosts = ["n0", "n1", "n2"]
+import contextlib
+
+
+@contextlib.contextmanager
+def _boot_cluster(tmp_path, hosts, **cfg_overrides):
+    """Spawn one `python -m idunno_tpu` OS process per host against a
+    shared JSON config, wait for full membership convergence, yield the
+    per-host control-TCP port map, and tear the processes down."""
+    base = _free_port_base(n=len(hosts))
     cfg = {
-        "hosts": hosts, "coordinator": "n0", "standby_coordinator": "n1",
-        "introducer": "n0",
+        "hosts": hosts, "coordinator": hosts[0],
+        "standby_coordinator": hosts[1], "introducer": hosts[0],
         "ports": {"membership": base, "store": base + 5,
                   "inference": base + 10, "result": base + 15,
                   "metadata": base + 20, "grep": base + 25},
         "ping_interval_s": 0.2, "failure_timeout_s": 2.0,
-        "replication_factor": 2, "straggler_timeout_s": 8.0,
-        "query_batch_size": 192, "query_interval_s": 0.0,
-        "metadata_interval_s": 0.5,
+        "replication_factor": 2, "query_batch_size": 64,
+        "query_interval_s": 0.0, "metadata_interval_s": 0.5,
         "engine": {"batch_size": 8, "image_size": 64, "resize_size": 64},
+        **cfg_overrides,
     }
     cfg_path = tmp_path / "cluster.json"
     cfg_path.write_text(json.dumps(cfg))
-    # control RPC goes to the node's single TCP listener (the "store" port)
+    # control RPC goes to each node's single TCP listener (the store port)
     tcp = {h: base + 5 + 100 * i for i, h in enumerate(hosts)}
-
     procs: dict[str, subprocess.Popen] = {}
     try:
         for h in hosts:
@@ -99,19 +104,35 @@ def test_cluster_multiprocess_kill9(tmp_path):
                  "--data-dir", str(tmp_path / h)],
                 cwd=REPO, env=_env_cpu(),
                 stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
-
-        # -- join: all three RUNNING in the coordinator's view ------------
         deadline = time.time() + 120
         while True:
             try:
-                st = _control(tcp["n0"], "status", timeout=5.0)
-                if (sorted(st["members"]) == hosts and
-                        all(v == "RUNNING" for v in st["members"].values())):
+                st = _control(tcp[hosts[0]], "status", timeout=5.0)
+                if (sorted(st["members"]) == sorted(hosts) and
+                        all(v == "RUNNING"
+                            for v in st["members"].values())):
                     break
             except (AssertionError, OSError):
                 pass
             assert time.time() < deadline, "cluster never converged"
             time.sleep(0.5)
+        yield tcp, procs
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_cluster_multiprocess_kill9(tmp_path):
+    hosts = ["n0", "n1", "n2"]
+    with _boot_cluster(tmp_path, hosts, straggler_timeout_s=8.0,
+                       query_batch_size=192) as (tcp, procs):
+        st = _control(tcp["n0"], "status", timeout=5.0)
         assert st["acting_master"] == "n0"
 
         # -- SDFS through two different nodes -----------------------------
@@ -150,15 +171,6 @@ def test_cluster_multiprocess_kill9(tmp_path):
         # the dead worker is marked LEAVE in the survivors' view
         st = _control(tcp["n0"], "status")
         assert st["members"]["n2"] == "LEAVE"
-    finally:
-        for p in procs.values():
-            if p.poll() is None:
-                p.terminate()
-        for p in procs.values():
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
 
 
 def test_jax_distributed_two_process_mesh(tmp_path):
@@ -199,3 +211,71 @@ def test_jax_distributed_two_process_mesh(tmp_path):
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-2000:]
         assert "OK" in out
+
+
+def test_lm_pool_over_real_sockets(tmp_path):
+    """The LM serving tier across REAL OS processes and TCP sockets — the
+    in-proc cluster tests cannot catch wire-format issues (JSON round
+    trips of prompts/seeds/top_p/service_s, binary LM blobs through the
+    store). One node serves a store-persisted LM; this test process
+    drives lm_serve/lm_submit/lm_poll/lm_stats/lm_stop over the control
+    RPC and checks token-exactness against a local generate."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from idunno_tpu.engine.generate import generate, save_lm
+    from idunno_tpu.models.transformer import TransformerLM
+
+    # the LM blob, built in THIS process with a pinned seed
+    model = TransformerLM(vocab=48, dim=32, depth=1, num_heads=4)
+    params = model.init(jax.random.PRNGKey(9),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    class _FileStore:
+        def put_bytes(self, name, blob):
+            (tmp_path / "lm.blob").write_bytes(blob)
+            return 1
+
+    save_lm(_FileStore(), "chat", model, params)
+
+    with _boot_cluster(tmp_path, ["n0", "n1"]) as (tcp, procs):
+        # publish the LM blob into the replicated store (shared fs: the
+        # node reads the local file this test wrote)
+        put = _control(tcp["n1"], "put",
+                       local=str(tmp_path / "lm.blob"), name="lm/chat")
+        assert put["version"] == 1
+
+        out = _control(tcp["n0"], "lm_serve", name="chat", slots=2,
+                       prompt_len=4, max_len=16, timeout=120.0)
+        assert out.get("slots") == 2
+
+        prompt = [7, 3, 11]
+        greedy = _control(tcp["n0"], "lm_submit", name="chat",
+                          prompt=prompt, max_new=6)["id"]
+        sampled = _control(tcp["n0"], "lm_submit", name="chat",
+                           prompt=prompt, max_new=6, temperature=0.9,
+                           top_p=0.8, seed=123)["id"]
+        done = {}
+        deadline = time.time() + 180
+        while time.time() < deadline and len(done) < 2:
+            reply = _control(tcp["n0"], "lm_poll", name="chat")
+            # fail FAST with the server's own error text, not a silent
+            # 180 s spin ending in an empty-dict assertion
+            assert not reply.get("errors"), reply["errors"]
+            for c in reply["completions"]:
+                done[c["id"]] = c
+            time.sleep(0.1)
+        assert set(done) == {greedy, sampled}, done
+
+        want = generate(model, params, jnp.asarray([prompt], jnp.int32),
+                        prompt_len=3, max_new=6)
+        assert done[greedy]["tokens"] == [int(t) for t in
+                                          np.asarray(want[0])]
+        assert done[greedy]["service_s"] > 0          # wire field intact
+        assert len(done[sampled]["tokens"]) == 3 + 6
+        assert all(0 <= t < 48 for t in done[sampled]["tokens"])
+
+        st = _control(tcp["n0"], "lm_stats", name="chat")["stats"]
+        assert st["completed"] == 2
+        assert _control(tcp["n0"], "lm_stop", name="chat")["stopped"]
