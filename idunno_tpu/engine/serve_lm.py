@@ -103,6 +103,26 @@ def _prefill(model: TransformerLM, params: Any, prompt: jnp.ndarray,
     return mutated["cache"], last
 
 
+def _safe_log(probs: jnp.ndarray) -> jnp.ndarray:
+    """log with EXACT -inf outside the support — a filtered-out token
+    must have probability zero, not e^-69 (matches generate's -inf
+    nucleus masking)."""
+    return jnp.where(probs > 0.0, jnp.log(jnp.maximum(probs, 1e-38)),
+                     -jnp.inf)
+
+
+def _row_sample_logits(scaled: jnp.ndarray,
+                       top_p: jnp.ndarray) -> jnp.ndarray:
+    """Per-row sampling logits: nucleus-filtered for top_p < 1 rows,
+    plain log-softmax otherwise. The per-ROW select (not a batch-level
+    branch) keeps every row's formula a function of its own request
+    alone, so a journal replay without its former co-residents redraws
+    the SAME stream bit-for-bit."""
+    plain = jax.nn.log_softmax(scaled, axis=-1)
+    filtered = _safe_log(nucleus_probs(scaled, top_p))
+    return jnp.where(top_p[..., None] < 1.0, filtered, plain)
+
+
 def _next_token(logits: jnp.ndarray, temp: jnp.ndarray,
                 key: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
     """Greedy (temp == 0) or temperature+nucleus-sampled next token;
@@ -110,9 +130,8 @@ def _next_token(logits: jnp.ndarray, temp: jnp.ndarray,
     there, so every array is one row's)."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temp, 1e-6)
-    probs = nucleus_probs(scaled, top_p)
     sampled = jax.random.categorical(
-        key, jnp.log(probs + 1e-30), axis=-1).astype(jnp.int32)
+        key, _row_sample_logits(scaled, top_p), axis=-1).astype(jnp.int32)
     return jnp.where(temp > 0.0, sampled, greedy)
 
 
@@ -223,7 +242,9 @@ def spec_commit(proposals: jnp.ndarray, qdist: jnp.ndarray,
     # 0 under exact arithmetic; guard float round-off by falling back to p
     resid = jnp.where(mass > 1e-12, resid, p_acc)
     bonus_sampled = jax.vmap(
-        lambda k, r: jax.random.categorical(k, jnp.log(r + 1e-30)))(
+        lambda k, r: jax.random.categorical(
+            k, jnp.where(r > 0.0, jnp.log(jnp.maximum(r, 1e-38)),
+                         -jnp.inf)))(
             resid_keys, resid).astype(jnp.int32)             # [S]
     bonus_greedy = jnp.take_along_axis(tpred, acc[:, None], axis=1)[:, 0]
     bonus = jnp.where(sampled, bonus_sampled, bonus_greedy)  # [S]
@@ -431,14 +452,15 @@ class DecodeServer:
                 l = logits[:, 0]
                 scaled = l / jnp.maximum(temps, 1e-6)[:, None]
                 # the full-vocab sort+cumsum only runs when some live row
-                # actually asked for a nucleus; the shift-invariance of
-                # categorical's gumbel argmax makes log(softmax) = scaled
-                # up to a per-row constant, so both branches consume the
-                # SAME keys identically for top_p = 1 rows
+                # actually asked for a nucleus; inside that branch the
+                # PER-ROW select gives top_p = 1 rows the identical plain
+                # log-softmax the other branch computes, so no row's
+                # stream ever depends on its co-residents (token-exact
+                # journal replay)
                 sample_logits = jax.lax.cond(
                     jnp.any((remaining > 0) & (temps > 0.0)
                             & (top_ps < 1.0)),
-                    lambda: jnp.log(nucleus_probs(scaled, top_ps) + 1e-30),
+                    lambda: _row_sample_logits(scaled, top_ps),
                     lambda: jax.nn.log_softmax(scaled, axis=-1))
                 drawn = jax.vmap(jax.random.categorical)(
                     split[:, 0], sample_logits).astype(jnp.int32)
@@ -523,14 +545,20 @@ class DecodeServer:
                     {"params": dparams, "cache": dcache},
                     tok[:, None], mutable=["cache"])
                 l = logits[:, 0].astype(jnp.float32)         # [S, V]
+                # per-row select inside the fast-path cond: a top_p = 1
+                # row's distribution is the plain softmax in BOTH
+                # branches, so no row depends on its co-residents
                 q = jax.lax.cond(
                     any_nucleus,
-                    lambda: nucleus_probs(l / safe_t, top_ps),
+                    lambda: jnp.where(
+                        top_ps[:, None] < 1.0,
+                        nucleus_probs(l / safe_t, top_ps),
+                        jax.nn.softmax(l / safe_t, axis=-1)),
                     lambda: jax.nn.softmax(l / safe_t, axis=-1))
                 greedy = jnp.argmax(l, axis=-1).astype(jnp.int32)
                 draw = jax.vmap(jax.random.categorical)(
                     draft_keys[:, j],
-                    jnp.log(q + 1e-30)).astype(jnp.int32)
+                    _safe_log(q)).astype(jnp.int32)
                 nxt = jnp.where(sampled, draw, greedy)
                 return (mutated["cache"], dcur + 1, nxt,
                         props.at[:, j].set(nxt),
@@ -551,8 +579,11 @@ class DecodeServer:
             tpred = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S,γ+1]
             pdist = jax.lax.cond(
                 any_nucleus,
-                lambda: nucleus_probs(logits / safe_t[..., None],
-                                      top_ps[:, None]),
+                lambda: jnp.where(
+                    top_ps[:, None, None] < 1.0,
+                    nucleus_probs(logits / safe_t[..., None],
+                                  top_ps[:, None]),
+                    jax.nn.softmax(logits / safe_t[..., None], axis=-1)),
                 lambda: jax.nn.softmax(logits / safe_t[..., None],
                                        axis=-1))
 

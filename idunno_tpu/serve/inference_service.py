@@ -361,7 +361,7 @@ class InferenceService:
         between workers forever); pollers see it via `query_failed`."""
         if not self.membership.is_acting_master:
             return 0
-        alive = self._eligible_workers()
+        alive = self._eligible_workers()     # one snapshot for the pass
         moved = 0
         now = self.clock()
         for task in self.scheduler.stragglers():
@@ -373,11 +373,12 @@ class InferenceService:
                     and not self._task_errors.get(task.model)
                     and now - task.t_assigned <= self.first_compile_grace_s):
                 continue      # cold model, every worker compiling: wait
-            if self._redispatch_or_fail(task, "straggler"):
+            if self._redispatch_or_fail(task, "straggler", alive=alive):
                 moved += 1
         return moved
 
-    def _redispatch_or_fail(self, task: Task, why: str) -> bool:
+    def _redispatch_or_fail(self, task: Task, why: str,
+                            alive: list[str] | None = None) -> bool:
         """Shared failure semantics for the straggler monitor and worker
         error reports: move the task (consuming its retry budget) or,
         past ``max_task_retries``, mark it permanently FAILED. Returns
@@ -391,7 +392,8 @@ class InferenceService:
                 task.end, task.retries, task.worker, why)
             return False
         self._dispatch(self.scheduler.redispatch_straggler(
-            task, self._eligible_workers()))
+            task, alive if alive is not None
+            else self._eligible_workers()))
         return True
 
     # ------------------------------------------------------------------ #

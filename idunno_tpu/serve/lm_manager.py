@@ -620,10 +620,13 @@ class LMPoolManager:
                     if pool is not None and pool["node"] == node:
                         self._orphan_pool_locked(name)
                 return                  # pump re-places on a survivor
-            if out.get("already"):
-                # a racing build holds the name's _Starting reservation;
-                # nothing was rebuilt — keep the old slot count everywhere
-                # and let a later pump retry
+            if out.get("already") or out.get("stopped"):
+                # 'already': a racing build holds the name's _Starting
+                # reservation; 'stopped': an lm_stop won the race mid-
+                # build and the fresh loop was immediately torn down. In
+                # both cases nothing is serving the NEW slot count — keep
+                # the old bookkeeping everywhere and let a later pump
+                # (or the stop) settle it
                 return
             with self._lock:
                 pool = self._pools.get(name)
@@ -669,6 +672,10 @@ class LMPoolManager:
         tok_s = (sum(x for x, _ in s) / max(sum(t for _, t in s), 1)
                  if s else 0.0)
         per_req_s = self._avg_request_s(pool)
+        # no completions yet = no measured rate to stretch with, but the
+        # FIRST requests are exactly the ones paying the from-scratch
+        # compile — grant the build allowance instead of the bare base
+        first_req_grace = 0.0 if s else self.build_rpc_timeout_s
         n_inflight = sum(1 for r in pool["requests"].values()
                          if r["status"] == _INFLIGHT)
         slots = max(int(pool.get("slots_now", 1)), 1)
@@ -676,8 +683,9 @@ class LMPoolManager:
         for rid, req in pool["requests"].items():
             if req["status"] != _INFLIGHT:
                 continue
-            eff = self.request_timeout_s + self.request_timeout_slack * (
-                req["max_new"] * tok_s + backlog_wait)
+            eff = (self.request_timeout_s + first_req_grace
+                   + self.request_timeout_slack * (
+                       req["max_new"] * tok_s + backlog_wait))
             if now - (req["t_forwarded"] or now) < eff:
                 continue
             if req["attempts"] >= self.max_request_attempts:

@@ -75,3 +75,25 @@ def test_model_dispatch_selects_matching_flops():
     # AlexNet must never be charged ResNet FLOPs again (~2.5x MFU inflation)
     assert model_forward_flops("alexnet") < 0.5 * model_forward_flops(
         "resnet18")
+
+
+def test_roofline_geometry_matches_bench_flops():
+    """tools/mfu_roofline.py re-encodes the layer geometry that bench.py's
+    analytic FLOPs functions sum; the two must never drift (the roofline
+    ceiling explains the bench MFU, so they share a denominator). The
+    roofline ignores elementwise/pool FLOPs exactly like bench.py, so the
+    totals must agree to the dtype-noise level."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "mfu_roofline",
+        Path(__file__).resolve().parent.parent / "tools" / "mfu_roofline.py")
+    roof = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(roof)
+
+    r18 = roof.analyze(roof.resnet18_layers(), batch=1)
+    assert r18["total_flops"] == resnet_forward_flops(224), \
+        r18["total_flops"]
+    alex = roof.analyze(roof.alexnet_layers(), batch=1)
+    assert alex["total_flops"] == alexnet_forward_flops(224), \
+        alex["total_flops"]

@@ -108,6 +108,7 @@ def analyze(layers, batch):
         t_shape_total += max(t_shape, t_bw)
     mfu_ceiling = t_comp_total / t_shape_total
     return {"batch": batch,
+            "total_flops": flops_total,    # unrounded: cross-checked
             "total_gflops": round(flops_total / 1e9, 1),
             "ideal_time_us": round(t_comp_total * 1e6, 1),
             "achievable_time_us": round(t_shape_total * 1e6, 1),
