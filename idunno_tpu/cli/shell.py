@@ -459,6 +459,19 @@ class Shell:
         if len(args) != 1:
             return "usage: lm-stats <name>"
         s = self._control("lm_stats", name=args[0])["stats"]
+
+        def config_line(stats: dict) -> str:
+            cfg = stats.get("config")
+            if not cfg:
+                return ""
+            return (f"\n  serving: {cfg['dim']}d x {cfg['depth']}L "
+                    f"heads={cfg['heads']}/{cfg['kv_heads']}kv "
+                    f"kv_cache={cfg['kv_cache_dtype']} "
+                    f"weights={cfg['quantize']} "
+                    f"decode_steps={cfg['decode_steps']}"
+                    + (f" draft_len={cfg['speculative_draft_len']}"
+                       if cfg["speculative_draft_len"] else ""))
+
         if "journal" in s:              # cluster-managed pool
             j = s["journal"]
             head = (f"{args[0]}: node={s['node']} "
@@ -469,13 +482,14 @@ class Shell:
                 return head + f" (pool: {s.get('pool_error', 'n/a')})"
             return (head + f" | live={p['live']}/{p['slots']} "
                     f"completed={p['completed']} "
-                    f"tokens_generated={p['tokens_generated']}")
+                    f"tokens_generated={p['tokens_generated']}"
+                    + config_line(p))
         return (f"{args[0]}: live={s['live']}/{s['slots']} "
                 f"queued={s['queued']} inbox={s['inbox']} "
                 f"unpolled={s['unpolled']} admitted={s['admitted']} "
                 f"completed={s['completed']} "
                 f"tokens_generated={s['tokens_generated']} "
-                f"dispatches={s['dispatches']}")
+                f"dispatches={s['dispatches']}" + config_line(s))
 
     def cmd_lm_stop(self, args: list[str]) -> str:
         if len(args) != 1:

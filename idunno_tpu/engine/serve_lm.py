@@ -333,6 +333,7 @@ class DecodeServer:
             params = quantize_tree(params)
         elif quantize != "none":
             raise ValueError(f"quantize={quantize!r}: want none|int8")
+        self.quantize = quantize
         self.model = model
         self.params = params
         self.slots = slots
@@ -678,9 +679,25 @@ class DecodeServer:
     def stats(self) -> dict:
         """Serving counters: decode dispatches (``decode_steps`` tokens per
         live row each), requests admitted/completed, generated-token total,
-        plus current occupancy."""
+        current occupancy, and the pool's serving configuration (what an
+        operator reading `lm_stats` needs to know the pool is actually
+        running — GQA width, cache dtype, weight quantization, draft)."""
+        m = self.model
+        config = {
+            "vocab": m.vocab, "dim": m.dim, "depth": m.depth,
+            "heads": m.num_heads,
+            "kv_heads": m.num_kv_heads or m.num_heads,
+            "kv_cache_dtype": m.kv_cache_dtype,
+            "quantize": self.quantize,
+            "decode_steps": self.decode_steps,
+            "prompt_len": self.prompt_len, "max_len": self.max_len,
+            "speculative_draft_len": (self.draft_len
+                                      if self._draft_model is not None
+                                      else None),
+        }
         return dict(self._stats, live=len(self._live),
-                    queued=len(self._queue), slots=self.slots)
+                    queued=len(self._queue), slots=self.slots,
+                    config=config)
 
     # -- serving loop -----------------------------------------------------
 

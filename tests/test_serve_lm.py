@@ -578,3 +578,25 @@ def test_int8_kv_cache_pool_matches_its_own_generate(lm):
     l8 = np.asarray(stepwise_logits(m8, params, toks))
     lf = np.asarray(model.apply({"params": params}, toks))
     assert np.abs(l8 - lf).max() < 0.02 * (lf.max() - lf.min() + 1e-9) + 0.05
+
+
+def test_stats_reports_serving_config(lm):
+    """`lm_stats` must tell an operator what the pool is actually running
+    (GQA width, cache dtype, weight quantization, speculative draft)."""
+    import dataclasses
+
+    model, params = lm
+    m = dataclasses.replace(model, num_kv_heads=2, kv_cache_dtype="int8")
+    srv = DecodeServer(m, params, slots=2, prompt_len=4, max_len=16,
+                       quantize="int8")
+    cfg = srv.stats()["config"]
+    assert cfg["kv_heads"] == 2 and cfg["heads"] == 4
+    assert cfg["kv_cache_dtype"] == "int8"
+    assert cfg["quantize"] == "int8"
+    assert cfg["speculative_draft_len"] is None
+
+    spec = DecodeServer(model, params, slots=1, prompt_len=4, max_len=20,
+                        draft=(model, params), draft_len=3)
+    cfg = spec.stats()["config"]
+    assert cfg["speculative_draft_len"] == 3
+    assert cfg["quantize"] == "none"
