@@ -252,8 +252,11 @@ def emit(value, unit="images/sec", vs_baseline=None, error=None, **details):
         line["error"] = error
     if details:
         line["details"] = details
+    # BENCH_NO_CACHE=1: diagnostic runs (e.g. the traced roofline capture's
+    # single-point sweep) must not clobber the full-sweep last-good record
     if (value is not None and error is None
-            and details.get("platform") == "tpu"):
+            and details.get("platform") == "tpu"
+            and os.environ.get("BENCH_NO_CACHE") != "1"):
         try:
             with open(_LAST_GOOD, "w") as f:
                 json.dump(dict(line, provenance=provenance(),
