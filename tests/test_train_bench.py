@@ -1,0 +1,62 @@
+"""Train bench machinery (`idunno_tpu/utils/train_bench.py`) on the CPU mesh.
+
+Same contract as `test_lm_bench.py`: the numbers only mean something on TPU;
+these tests pin the RECORD SHAPE — every phase present (incl. the FSDP point,
+which the single-chip TPU run skips but the 8-device CPU mesh exercises),
+throughput accounting sane — so an unattended TPU capture can't silently
+emit a gutted record.
+"""
+import time
+
+import pytest
+
+from idunno_tpu.utils.train_bench import run_train_bench, train_bench_config
+
+TINY = {
+    "BENCH_TRAIN_DIM": "32", "BENCH_TRAIN_DEPTH": "1",
+    "BENCH_TRAIN_HEADS": "2", "BENCH_TRAIN_VOCAB": "64",
+    "BENCH_TRAIN_SEQ": "16", "BENCH_TRAIN_BATCH": "8",
+    "BENCH_TRAIN_ITERS": "2",
+    "BENCH_TRAIN_CNN_BATCH": "8", "BENCH_TRAIN_CNN_IMAGE": "32",
+}
+
+
+@pytest.fixture
+def tiny_env(monkeypatch):
+    for k, v in TINY.items():
+        monkeypatch.setenv(k, v)
+
+
+def test_config_env_overrides(tiny_env):
+    cfg = train_bench_config("cpu")
+    assert cfg["dim"] == 32 and cfg["seq"] == 16
+    assert cfg["cnn_batch"] == 8
+
+
+def test_full_record_shape(tiny_env):
+    rec = run_train_bench("cpu", "cpu", 8, None,
+                          deadline=time.perf_counter() + 600,
+                          cnn_flops_per_image=3.6e9)
+    assert rec["n_params"] > 0
+    assert rec["flash_attention"] == "n/a (cpu)"
+    lm = rec["lm"]
+    assert lm["tokens_per_s"] > 0
+    assert lm["batch"] * lm["seq"] == 8 * 16
+    assert lm["flops_per_token_gf"] > 0
+    assert "mfu" not in lm                      # no peak off-TPU
+    assert rec["accum"]["accum_steps"] == 2
+    assert rec["accum"]["tokens_per_s"] > 0
+    # conftest forces an 8-device CPU mesh -> the FSDP point must run
+    assert rec["fsdp"]["tokens_per_s"] > 0
+    cnn = rec["cnn"]
+    assert cnn["model"] == "resnet18"
+    assert cnn["images_per_s"] > 0
+    assert cnn["batch"] == 8 and cnn["image_size"] == 32
+
+
+def test_deadline_skips_optional_phases(tiny_env):
+    rec = run_train_bench("cpu", "cpu", 8, None,
+                          deadline=time.perf_counter() - 1,
+                          cnn_flops_per_image=3.6e9)
+    assert rec["lm"]["tokens_per_s"] > 0        # core point always runs
+    assert "accum" not in rec and "fsdp" not in rec and "cnn" not in rec

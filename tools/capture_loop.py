@@ -51,6 +51,10 @@ STEPS = [
      {"BENCH_MODEL": "alexnet", "BENCH_TIME_BUDGET_S": "600"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_alexnet.json"),
+    ("train_suite",
+     {"BENCH_SUITE": "train", "BENCH_TIME_BUDGET_S": "600"},
+     [sys.executable, "bench.py"],
+     "BENCH_LAST_GOOD_train.json"),
     # BENCH_NO_CACHE: this degraded single-point run must not clobber the
     # headline BENCH_LAST_GOOD.json captured by headline_resnet18 above
     ("traced_resnet18",
