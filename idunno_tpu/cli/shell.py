@@ -69,7 +69,9 @@ HELP = """\
        queue a prompt -> request id (temperature 0=greedy, >0 sampled;
        top_p<1 = nucleus)
   lm-poll <name> | lm-stats <name> | lm-stop <name>
-       fetch completions / occupancy+token counters / stop"""
+       fetch completions / occupancy+token counters / stop
+  lm-cancel <name> <id>   best-effort cancel (live rows return partials)
+  lm-tail <name>          stream view: live rows' tokens so far"""
 
 
 class Shell:
@@ -103,6 +105,8 @@ class Shell:
             "lm-poll": self.cmd_lm_poll,
             "lm-stats": self.cmd_lm_stats,
             "lm-stop": self.cmd_lm_stop,
+            "lm-cancel": self.cmd_lm_cancel,
+            "lm-tail": self.cmd_lm_tail,
         }
 
     # -- driver -----------------------------------------------------------
@@ -450,10 +454,31 @@ class Shell:
             return "usage: lm-poll <name>"
         out = self._control("lm_poll", name=args[0])
         rows = [f"#{c['id']}: {' '.join(str(t) for t in c['tokens'])} "
-                f"(prompt_len={c['prompt_len']})"
+                f"(prompt_len={c['prompt_len']}"
+                + (", CANCELLED" if c.get("cancelled") else "") + ")"
                 for c in out["completions"]]
+        rows.extend(f"#{rid}: CANCELLED"
+                    for rid in out.get("cancelled", []))
         rows.extend(f"ERROR: {e}" for e in out.get("errors", []))
         return "\n".join(rows) or "(no completions yet)"
+
+    def cmd_lm_cancel(self, args: list[str]) -> str:
+        if len(args) != 2:
+            return "usage: lm-cancel <name> <id>"
+        out = self._control("lm_cancel", name=args[0], id=int(args[1]))
+        return (f"cancelled #{args[1]}" if out["cancelled"]
+                else f"#{args[1]} not cancellable (done or unknown)")
+
+    def cmd_lm_tail(self, args: list[str]) -> str:
+        if len(args) != 1:
+            return "usage: lm-tail <name>"
+        out = self._control("lm_partial", name=args[0])
+        rows = [f"#{r['id']}: {' '.join(str(t) for t in r['tokens'])} "
+                f"({len(r['tokens']) - r['prompt_len']} generated)"
+                for r in out["partial"]]
+        if out.get("error"):
+            rows.append(f"ERROR: {out['error']}")
+        return "\n".join(rows) or "(no live rows)"
 
     def cmd_lm_stats(self, args: list[str]) -> str:
         if len(args) != 1:
@@ -476,7 +501,9 @@ class Shell:
             j = s["journal"]
             head = (f"{args[0]}: node={s['node']} "
                     f"pending={j['pending']} inflight={j['inflight']} "
-                    f"done={j['done']} failed={j['failed']}")
+                    f"done={j['done']} failed={j['failed']}"
+                    + (f" cancelled={j['cancelled']}"
+                       if j.get("cancelled") else ""))
             p = s.get("pool")
             if not p:
                 return head + f" (pool: {s.get('pool_error', 'n/a')})"

@@ -73,6 +73,9 @@ class Completion:
     # slower than an idle one; reference normalizes processing time,
     # `mp4_machinelearning.py:656-674`).
     service_s: float = 0.0
+    # client-cancelled mid-stream: ``tokens`` holds whatever was generated
+    # before the cancel landed (possibly just the prompt + first token)
+    cancelled: bool = False
 
 
 def _set_cursors(cache: Any, cursors: jnp.ndarray) -> Any:
@@ -412,8 +415,9 @@ class DecodeServer:
         self._live: dict[int, Request] = {}       # slot → request
         self._done: list[Completion] = []
         self._next_id = 0
+        self._cancelled: set[int] = set()     # ids cancelled while live
         self._stats = {"dispatches": 0, "admitted": 0, "completed": 0,
-                       "tokens_generated": 0}
+                       "tokens_generated": 0, "cancelled": 0}
 
         if self._draft_model is not None:
             self._decode_spec = self._build_spec_round(draft_len)
@@ -673,6 +677,52 @@ class DecodeServer:
         out, self._done = self._done, []
         return out
 
+    def cancel(self, rid: int) -> str:
+        """Best-effort cancel: a queued request is dropped before admission
+        ("queued"); a live request's row stops decoding at the next
+        retirement pass and completes with ``cancelled=True`` and whatever
+        tokens it had ("live"); anything else — already completed or never
+        seen — is "unknown". Idempotent: cancelling twice is "unknown" the
+        second time."""
+        for i, req in enumerate(self._queue):
+            if req.id == rid:
+                del self._queue[i]
+                self._done.append(Completion(
+                    id=rid, tokens=list(req.tokens),
+                    prompt_len=len(req.tokens), cancelled=True))
+                self._stats["cancelled"] += 1
+                return "queued"
+        for slot, req in self._live.items():
+            if req.id == rid:
+                # a row whose budget is already exhausted (it finished
+                # during the last dispatch and merely awaits retirement)
+                # is COMPLETE, not cancellable — labelling it cancelled
+                # would mislabel a full stream as a truncated partial
+                if int(np.asarray(self._remaining)[slot]) == 0:
+                    return "unknown"
+                # zeroing the row's budget makes the next
+                # `_retire_finished` pass retire it through the normal
+                # path; the freed slot admits the next queued prompt
+                self._remaining = self._remaining.at[slot].set(0)
+                self._cancelled.add(rid)
+                self._stats["cancelled"] += 1
+                return "live"
+        return "unknown"
+
+    def snapshot(self) -> list[dict]:
+        """Progress of every LIVE row — id, tokens so far (prompt +
+        generated), prompt length — for streaming partial results to
+        polling clients. One D2H read; queued requests are not included
+        (they have no progress)."""
+        if not self._live:
+            return []
+        cursors = np.asarray(self._cursors)
+        tokens = np.asarray(self._tokens)
+        return [{"id": req.id,
+                 "tokens": [int(t) for t in tokens[slot][:cursors[slot] + 1]],
+                 "prompt_len": len(req.tokens)}
+                for slot, req in sorted(self._live.items())]
+
     def pending(self) -> int:
         return len(self._queue) + len(self._live)
 
@@ -711,11 +761,15 @@ class DecodeServer:
             req = self._live.pop(slot)
             total = int(cursors[slot]) + 1
             row = np.asarray(self._tokens[slot])[:total]
+            was_cancelled = req.id in self._cancelled
+            self._cancelled.discard(req.id)
             self._done.append(Completion(
                 id=req.id, tokens=[int(t) for t in row],
                 prompt_len=len(req.tokens),
-                service_s=time.monotonic() - req.t_admit))
-            self._stats["completed"] += 1
+                service_s=time.monotonic() - req.t_admit,
+                cancelled=was_cancelled))
+            if not was_cancelled:
+                self._stats["completed"] += 1
             self._stats["tokens_generated"] += total - len(req.tokens)
 
     def _admit(self) -> None:

@@ -268,12 +268,23 @@ class ControlService:
             loop = self._lm_loop(p["name"])
             out = {"completions": [
                 {"id": c.id, "tokens": c.tokens, "prompt_len": c.prompt_len,
-                 "service_s": round(c.service_s, 6)}
+                 "service_s": round(c.service_s, 6),
+                 "cancelled": c.cancelled}
                 for c in loop.poll()]}
             errs = loop.errors()
             if errs:
                 out["errors"] = errs
             return out
+        if verb == "lm_cancel":
+            # best-effort: True = the cancel was initiated (queued request
+            # dropped, or live row retiring with its partial tokens);
+            # False = unknown id (already completed or never submitted)
+            return {"cancelled":
+                    self._lm_loop(p["name"]).cancel(int(p["id"]))}
+        if verb == "lm_partial":
+            # streaming surface: progress of every live row WITHOUT
+            # draining completions (lm_poll keeps that role)
+            return {"partial": self._lm_loop(p["name"]).snapshot()}
         if verb == "lm_stats":
             return {"stats": self._lm_loop(p["name"]).stats()}
         if verb == "lm_stop":
@@ -365,8 +376,8 @@ class ControlService:
             return (mgr.serve(p) if verb == "lm_serve"
                     else mgr.train(p))
         name = p.get("name")
-        if verb in ("lm_submit", "lm_poll", "lm_stats", "lm_stop") \
-                and mgr.has_pool(name):
+        if verb in ("lm_submit", "lm_poll", "lm_stats", "lm_stop",
+                    "lm_cancel", "lm_partial") and mgr.has_pool(name):
             if verb == "lm_submit":
                 rid = mgr.submit(name, [int(t) for t in p["prompt"]],
                                  int(p["max_new"]),
@@ -381,6 +392,10 @@ class ControlService:
                 return mgr.poll(name)
             if verb == "lm_stats":
                 return {"stats": mgr.stats(name)}
+            if verb == "lm_cancel":
+                return mgr.cancel(name, int(p["id"]))
+            if verb == "lm_partial":
+                return mgr.partial(name)
             return mgr.stop(name)
         if verb in ("train_status", "train_stop") and mgr.has_job(name):
             return (mgr.train_status(name) if verb == "train_status"

@@ -52,9 +52,11 @@ CONTROL = "control"
 
 # request lifecycle: pending (not yet on any node) -> inflight (forwarded,
 # node id known) -> done (tokens journaled). Recovery moves inflight back
-# to pending; done and failed (node rejected the request — permanent, e.g.
-# a validation error) are terminal.
+# to pending; done, failed (node rejected the request — permanent, e.g.
+# a validation error) and cancelled (client lm_cancel) are terminal —
+# recovery/resubmission must never replay a cancelled request.
 _PENDING, _INFLIGHT, _DONE, _FAILED = "pending", "inflight", "done", "failed"
+_CANCELLED = "cancelled"
 
 
 class LMPoolManager:
@@ -177,6 +179,7 @@ class LMPoolManager:
             self._pools[name] = {"spec": dict(spec), "node": None,
                                  "next_rid": 0, "requests": {},
                                  "done_total": 0, "failed_total": 0,
+                                 "cancelled_total": 0,
                                  "node_errors": [],
                                  # measured service samples feeding the
                                  # heterogeneous fair share: (seconds from
@@ -264,18 +267,31 @@ class LMPoolManager:
                     req2["error"] = str(e)
                     pool["failed_total"] += 1
             return
+        cancel_on_node = False
         with self._lock:
             # recovery may have requeued/re-placed while the RPC ran; only
             # a still-pending request on the same node takes the mapping
             pool = self._pools.get(name)
-            if (pool is not None and pool["node"] == node
-                    and pool["requests"].get(rid, {}).get("status")
-                    == _PENDING):
-                req2 = pool["requests"][rid]
-                req2["status"] = _INFLIGHT
-                req2["node_id"] = int(out["id"])
-                req2["t_forwarded"] = time.time()
-                req2["attempts"] += 1
+            if pool is not None and pool["node"] == node:
+                status = pool["requests"].get(rid, {}).get("status")
+                if status == _PENDING:
+                    req2 = pool["requests"][rid]
+                    req2["status"] = _INFLIGHT
+                    req2["node_id"] = int(out["id"])
+                    req2["t_forwarded"] = time.time()
+                    req2["attempts"] += 1
+                elif status == _CANCELLED:
+                    # cancel() raced this forward: it saw a pending
+                    # request with no node mapping, so no node-side
+                    # cancel was sent — send it now, or the node decodes
+                    # all max_new tokens into a dropped completion
+                    cancel_on_node = True
+        if cancel_on_node:
+            try:
+                self._call(node, {"verb": "lm_cancel", "name": name,
+                                  "id": int(out["id"])}, timeout=10.0)
+            except (TransportError, ValueError, OSError):
+                pass              # best-effort: the row burns out on its own
 
     def poll(self, name: str) -> dict[str, Any]:
         """Completions not yet handed to a client. Delivery to the CLIENT
@@ -295,7 +311,7 @@ class LMPoolManager:
             for rid in [r for r, q in pool["requests"].items()
                         if q["delivered"]]:
                 del pool["requests"][rid]
-            out, errors = [], []
+            out, errors, cancelled = [], [], []
             for rid, req in sorted(pool["requests"].items()):
                 if req["status"] == _DONE:
                     req["delivered"] = True
@@ -308,10 +324,68 @@ class LMPoolManager:
                     req["delivered"] = True
                     errors.append(f"request {rid} failed: "
                                   f"{req.get('error', '?')}")
+                elif req["status"] == _CANCELLED:
+                    req["delivered"] = True
+                    cancelled.append(rid)
         reply: dict[str, Any] = {"completions": out}
         if errors:
             reply["errors"] = errors
+        if cancelled:
+            reply["cancelled"] = cancelled
         return reply
+
+    def cancel(self, name: str, rid: int) -> dict[str, Any]:
+        """Cancel a journaled request. Terminal immediately in the journal
+        (recovery and the pump will never replay it); if it was inflight,
+        the node-side cancel is forwarded best-effort — the node's partial
+        completion is dropped by `_drain` (its node_id mapping is gone).
+        Client-facing: the id shows up in the next poll's ``cancelled``
+        list. Returns {"cancelled": False} for ids already terminal or
+        never journaled."""
+        with self._lock:
+            pool = self._pools.get(name)
+            if pool is None:
+                raise ValueError(f"no managed pool {name!r}")
+            req = pool["requests"].get(rid)
+            if req is None or req["status"] not in (_PENDING, _INFLIGHT):
+                return {"cancelled": False}
+            was_inflight = req["status"] == _INFLIGHT
+            node, node_id = pool["node"], req["node_id"]
+            req["status"] = _CANCELLED
+            req["node_id"] = None
+            pool["cancelled_total"] += 1
+        if was_inflight and node is not None and node_id is not None:
+            try:
+                self._call(node, {"verb": "lm_cancel", "name": name,
+                                  "id": int(node_id)}, timeout=10.0)
+            except (TransportError, ValueError, OSError):
+                pass          # best-effort: the row burns out on its own
+        return {"cancelled": True}
+
+    def partial(self, name: str) -> dict[str, Any]:
+        """Streaming surface for a managed pool: the node's live-row
+        progress mapped back to journal request ids. Rows the journal no
+        longer tracks as inflight (just cancelled / just drained) are
+        dropped — a client must never see an id it didn't submit."""
+        with self._lock:
+            pool = self._pools.get(name)
+            if pool is None:
+                raise ValueError(f"no managed pool {name!r}")
+            node = pool["node"]
+            id_map = {r["node_id"]: rid
+                      for rid, r in pool["requests"].items()
+                      if r["status"] == _INFLIGHT
+                      and r["node_id"] is not None}
+        if node is None:
+            return {"partial": []}
+        try:
+            out = self._call(node, {"verb": "lm_partial", "name": name},
+                             timeout=10.0)
+        except (TransportError, ValueError, OSError) as e:
+            return {"partial": [], "error": str(e)}
+        return {"partial": [dict(row, id=id_map[int(row["id"])])
+                            for row in out.get("partial", ())
+                            if int(row["id"]) in id_map]}
 
     def stats(self, name: str) -> dict[str, Any]:
         with self._lock:
@@ -327,6 +401,7 @@ class LMPoolManager:
             # are pruned from the journal)
             counts[_DONE] = pool["done_total"]
             counts[_FAILED] = pool["failed_total"]
+            counts[_CANCELLED] = pool["cancelled_total"]
             node_errors = list(pool["node_errors"][-5:])
         out = {"node": node, "journal": counts}
         if node_errors:
@@ -722,6 +797,15 @@ class LMPoolManager:
             for c in out.get("completions", ()):
                 req = by_node_id.get(int(c["id"]))
                 if req is not None:
+                    if c.get("cancelled"):
+                        # out-of-band node-side cancel (a local=True
+                        # lm_cancel bypassing this manager): journal it as
+                        # cancelled, and keep its partial service time out
+                        # of the fair-share samples
+                        req["status"] = _CANCELLED
+                        req["node_id"] = None
+                        pool["cancelled_total"] += 1
+                        continue
                     req["status"] = _DONE
                     req["tokens"] = [int(t) for t in c["tokens"]]
                     req["prompt_len"] = int(c["prompt_len"])
@@ -861,6 +945,7 @@ class LMPoolManager:
                               "next_rid": p["next_rid"],
                               "done_total": p["done_total"],
                               "failed_total": p["failed_total"],
+                              "cancelled_total": p["cancelled_total"],
                               "svc_samples": [list(s) for s
                                               in p["svc_samples"]],
                               "slots_now": p["slots_now"],
@@ -883,6 +968,7 @@ class LMPoolManager:
                     "next_rid": int(p["next_rid"]),
                     "done_total": int(p.get("done_total", 0)),
                     "failed_total": int(p.get("failed_total", 0)),
+                    "cancelled_total": int(p.get("cancelled_total", 0)),
                     "node_errors": [],
                     "svc_samples": [tuple(s) for s
                                     in p.get("svc_samples", ())],

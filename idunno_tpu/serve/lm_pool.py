@@ -35,6 +35,14 @@ class LMServingLoop:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._errors: list[str] = []
+        # cancellation + snapshot both mutate/read DecodeServer state, so
+        # they are handed to the loop thread: cancels as a drained box,
+        # snapshots as a request/response pair of events
+        self._cancel_box: list[int] = []      # server-side ids
+        self._snap_serial = threading.Lock()  # one snapshot waiter at a time
+        self._snap_want = threading.Event()
+        self._snap_done = threading.Event()
+        self._snap: list[dict] = []
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"{name}-decode-loop")
         self._thread.start()
@@ -68,6 +76,44 @@ class LMServingLoop:
         with self._lock:
             out, self._outbox = self._outbox, []
             return out
+
+    def cancel(self, rid: int) -> bool:
+        """Best-effort cancel of public request ``rid``. A request still in
+        the inbox is dropped here and completes (cancelled, prompt-only)
+        immediately; one already on the server is cancelled by the loop
+        thread at its next iteration and completes with whatever tokens it
+        had. Returns False when the id is unknown — already completed (its
+        tokens are in the outbox or were polled) or never submitted."""
+        with self._lock:
+            for i, entry in enumerate(self._inbox):
+                if entry[0] == rid:
+                    del self._inbox[i]
+                    self._outbox.append(Completion(
+                        id=rid, tokens=list(entry[1]),
+                        prompt_len=len(entry[1]), cancelled=True))
+                    return True
+            sid = next((s for s, r in self._id_map.items() if r == rid),
+                       None)
+            if sid is None:
+                return False
+            self._cancel_box.append(sid)
+        self._wake.set()
+        return True
+
+    def snapshot(self, timeout: float = 2.0) -> list[dict]:
+        """Progress of every live row (public ids): prompt + tokens
+        generated so far — the streaming surface behind ``lm_partial``.
+        Fulfilled by the loop thread at its next iteration; returns [] if
+        the loop doesn't answer within ``timeout`` (stopped or wedged)."""
+        with self._snap_serial:
+            self._snap_done.clear()
+            self._snap_want.set()
+            self._wake.set()
+            if not self._snap_done.wait(timeout):
+                self._snap_want.clear()
+                return []
+            with self._lock:
+                return list(self._snap)
 
     def stats(self) -> dict:
         """Server counters + this loop's queue depths. The server's dict is
@@ -104,11 +150,36 @@ class LMServingLoop:
             sid = self.server.submit(tokens, max_new,
                                      temperature=temperature, top_p=top_p,
                                      seed=rid if seed is None else seed)
-            self._id_map[sid] = rid
+            # under the lock: cancel() iterates this map from RPC threads
+            with self._lock:
+                self._id_map[sid] = rid
+
+    def _drain_cancels(self) -> None:
+        with self._lock:
+            batch, self._cancel_box = self._cancel_box, []
+        for sid in batch:
+            self.server.cancel(sid)
+
+    def _fulfill_snapshot(self) -> None:
+        if not self._snap_want.is_set():
+            return
+        try:
+            snap = self.server.snapshot()
+        except Exception as e:  # noqa: BLE001 - waiter must not hang
+            snap = []
+            with self._lock:
+                if len(self._errors) < 100:
+                    self._errors.append(f"snapshot: {type(e).__name__}: {e}")
+        with self._lock:
+            self._snap = [dict(e, id=self._id_map.get(e["id"], e["id"]))
+                          for e in snap]
+        self._snap_want.clear()
+        self._snap_done.set()
 
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
+                self._drain_cancels()
                 self._drain_inbox()
                 live = self.server.step()
                 done = self.server.poll()
@@ -117,13 +188,14 @@ class LMServingLoop:
                     if len(self._errors) < 100:   # bounded between drains
                         self._errors.append(f"{type(e).__name__}: {e}")
                 live, done = 0, []
+            self._fulfill_snapshot()
             if done:
                 with self._lock:
                     for c in done:
                         self._outbox.append(Completion(
                             id=self._id_map.pop(c.id, c.id),
                             tokens=c.tokens, prompt_len=c.prompt_len,
-                            service_s=c.service_s))
+                            service_s=c.service_s, cancelled=c.cancelled))
             if live == 0:
                 self._wake.wait(timeout=0.5)
                 self._wake.clear()

@@ -178,8 +178,11 @@ def run_train_bench(platform: str, device_kind: str, n_devices: int,
     # axis (the single-chip TPU run skips it; CPU-mesh tests cover it) ----
     if n_data > 1 and time.perf_counter() < deadline:
         try:
-            fstate = create_lm_train_state(model, jax.random.PRNGKey(0),
-                                           cfg["seq"], tx, batch=1)
+            # init through the plain-attention twin at tiny seq, same as
+            # the main point — re-initing with the flash model at full seq
+            # would pay exactly the compile the twin exists to avoid
+            fstate = create_lm_train_state(init_model, jax.random.PRNGKey(0),
+                                           8, tx, batch=1)
             fstate = fsdp_shard_train_state(fstate, mesh)
             perf, cf, _ = _timed_steps(step, fstate, (tokens,), cfg["iters"])
             out["fsdp"] = {

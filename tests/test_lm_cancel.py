@@ -1,0 +1,361 @@
+"""Request cancellation + partial-result streaming across the LM tier.
+
+Three levels (the DecodeServer level is in `test_serve_lm.py`):
+  - `LMServingLoop`: thread-safe cancel (inbox drop vs loop-thread handoff)
+    and the snapshot request/response pair behind `lm_partial`.
+  - `LMPoolManager`: journal semantics — cancelled is terminal (recovery
+    and the pump must never replay it), poll reports it once, the node-side
+    cancel is forwarded, late node completions for cancelled requests are
+    dropped without polluting the fair-share samples.
+  - control RPC: the `lm_cancel` / `lm_partial` verbs end to end.
+"""
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idunno_tpu.comm.message import Message
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.engine.generate import generate
+from idunno_tpu.engine.serve_lm import DecodeServer
+from idunno_tpu.models.transformer import TransformerLM
+from idunno_tpu.serve.lm_manager import LMPoolManager
+from idunno_tpu.serve.lm_pool import LMServingLoop
+from idunno_tpu.utils.types import MessageType
+
+VOCAB = 47
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = TransformerLM(vocab=VOCAB, dim=32, depth=2, num_heads=4)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def expected(model, params, prompt, max_new):
+    out = generate(model, params, jnp.asarray([prompt], jnp.int32),
+                   prompt_len=len(prompt), max_new=max_new)
+    return [int(t) for t in np.asarray(out[0])]
+
+
+def _poll_until(loop, want_ids, deadline_s=120.0):
+    done = {}
+    deadline = time.time() + deadline_s
+    while time.time() < deadline and not want_ids <= set(done):
+        for c in loop.poll():
+            done[c.id] = c
+        time.sleep(0.02)
+    assert want_ids <= set(done), f"only {sorted(done)} completed"
+    return done
+
+
+# -- LMServingLoop ---------------------------------------------------------
+
+def test_loop_cancel_and_snapshot(lm):
+    model, params = lm
+    # a LONG stream (500 tokens) so the cancel reliably lands mid-decode
+    # even on a fast host — an 80-token request can complete before the
+    # cancel call reaches the loop
+    loop = LMServingLoop(DecodeServer(model, params, slots=1, prompt_len=4,
+                                      max_len=520))
+    try:
+        long_id = loop.submit([1, 2], max_new=500)
+        # wait until the long request is actually live on the server
+        deadline = time.time() + 60
+        while time.time() < deadline and loop.stats()["live"] == 0:
+            time.sleep(0.02)
+        assert loop.stats()["live"] == 1
+
+        # snapshot: live progress under PUBLIC ids, a prefix of the stream
+        snap = []
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            snap = loop.snapshot()
+            if snap and len(snap[0]["tokens"]) > 2:
+                break
+            time.sleep(0.02)
+        assert snap and snap[0]["id"] == long_id
+
+        # a second request is stuck behind the single slot → inbox/queued
+        queued_id = loop.submit([3, 4], max_new=5)
+        assert loop.cancel(queued_id) is True
+        assert loop.cancel(long_id) is True       # live: loop-thread cancel
+        assert loop.cancel(12345) is False        # unknown
+
+        done = _poll_until(loop, {long_id, queued_id})
+        # oracle LAST: a 500-token generate takes seconds, and running it
+        # between snapshot and cancel would let the pool finish first
+        full = expected(model, params, [1, 2], 500)
+        assert snap[0]["tokens"] == full[:len(snap[0]["tokens"])]
+        assert done[queued_id].cancelled
+        assert done[queued_id].tokens == [3, 4]
+        got = done[long_id]
+        assert got.cancelled
+        assert len(got.tokens) < len(full)
+        assert got.tokens == full[:len(got.tokens)]
+        assert loop.cancel(long_id) is False      # already delivered
+    finally:
+        loop.stop()
+
+
+# -- LMPoolManager ---------------------------------------------------------
+
+HOSTS = ("n0", "n1")
+
+
+class FakeTransport:
+    def __init__(self):
+        self.calls = []
+        self._next_sub = 0
+        self.partial_reply = []
+
+    def call(self, node, component, msg, timeout=30.0):
+        p = dict(msg.payload)
+        self.calls.append((node, p))
+        verb = p.get("verb")
+        if verb == "lm_serve":
+            return Message(MessageType.ACK, node,
+                           {"slots": p.get("slots")})
+        if verb == "lm_submit":
+            self._next_sub += 1
+            return Message(MessageType.ACK, node, {"id": self._next_sub})
+        if verb == "lm_partial":
+            return Message(MessageType.ACK, node,
+                           {"partial": list(self.partial_reply)})
+        if verb == "lm_stats":
+            return Message(MessageType.ACK, node, {"stats": {}})
+        return Message(MessageType.ACK, node, {"completions": []})
+
+    def verbs(self, name):
+        return [(n, p) for n, p in self.calls if p.get("verb") == name]
+
+
+class FakeMembership:
+    def __init__(self, hosts=HOSTS):
+        self.is_acting_master = True
+        self.members = SimpleNamespace(alive_hosts=lambda: list(hosts))
+        self._hosts = hosts
+
+    def on_change(self, cb):
+        pass
+
+    def acting_master(self):
+        return self._hosts[0]
+
+
+@pytest.fixture
+def mgr():
+    cfg = ClusterConfig(hosts=HOSTS, coordinator="n0",
+                        standby_coordinator="n1", introducer="n0")
+    transport = FakeTransport()
+    m = LMPoolManager("n0", cfg, transport, FakeMembership())
+    m.serve({"name": "chat", "slots": 4, "prompt_len": 4, "max_len": 32})
+    return m, transport
+
+
+def test_manager_cancel_inflight_forwards_and_reports(mgr):
+    m, transport = mgr
+    rid = m.submit("chat", [1, 2], max_new=8)
+    req = m._pools["chat"]["requests"][rid]
+    assert req["status"] == "inflight"
+    node_id = req["node_id"]
+
+    assert m.cancel("chat", rid) == {"cancelled": True}
+    assert req["status"] == "cancelled"
+    # node-side cancel forwarded with the NODE's id
+    assert [(p["id"]) for _, p in transport.verbs("lm_cancel")] == [node_id]
+    # terminal: a second cancel is a no-op
+    assert m.cancel("chat", rid) == {"cancelled": False}
+
+    # poll reports the id once, then prunes it
+    assert m.poll("chat")["cancelled"] == [rid]
+    assert "cancelled" not in m.poll("chat")
+    assert rid not in m._pools["chat"]["requests"]
+    assert m.stats("chat")["journal"]["cancelled"] == 1
+
+
+def test_manager_cancel_pending_and_recovery_skips_cancelled(mgr):
+    m, transport = mgr
+    rid1 = m.submit("chat", [1], max_new=4)
+    rid2 = m.submit("chat", [2], max_new=4)
+    pool = m._pools["chat"]
+    # orphan the pool (as node-death recovery does): inflight → pending
+    m._orphan_pool_locked("chat")
+    assert pool["requests"][rid1]["status"] == "pending"
+    assert m.cancel("chat", rid1) == {"cancelled": True}
+    # no node-side RPC for a request that wasn't on any node
+    assert transport.verbs("lm_cancel") == []
+
+    # recovery resubmits ONLY the un-cancelled request
+    pool["node"] = None
+    before = len(transport.verbs("lm_submit"))
+    m._recover_pool("chat")
+    resubmitted = transport.verbs("lm_submit")[before:]
+    assert [p["prompt"] for _, p in resubmitted] == [[2]]
+    assert pool["requests"][rid2]["status"] == "inflight"
+    assert pool["requests"][rid1]["status"] == "cancelled"
+
+
+def test_manager_drain_drops_late_completion_for_cancelled(mgr):
+    m, transport = mgr
+    rid = m.submit("chat", [1, 2], max_new=8)
+    m.cancel("chat", rid)
+
+    # a late node completion for the cancelled request must not resurrect
+    # it or feed the fair-share samples
+    class LateTransport(FakeTransport):
+        def call(self, node, component, msg, timeout=30.0):
+            p = dict(msg.payload)
+            if p.get("verb") == "lm_poll":
+                return Message(MessageType.ACK, node, {"completions": [
+                    {"id": 1, "tokens": [1, 2, 3], "prompt_len": 2,
+                     "service_s": 0.5}]})
+            return super().call(node, component, msg, timeout)
+
+    m.transport = LateTransport()
+    m._drain("chat", m._pools["chat"]["node"])
+    assert m._pools["chat"]["requests"][rid]["status"] == "cancelled"
+    assert m._pools["chat"]["svc_samples"] == []
+    assert m._pools["chat"]["done_total"] == 0
+
+
+def test_manager_partial_maps_node_ids_to_journal_ids(mgr):
+    m, transport = mgr
+    rid = m.submit("chat", [1, 2], max_new=8)
+    node_id = m._pools["chat"]["requests"][rid]["node_id"]
+    transport.partial_reply = [
+        {"id": node_id, "tokens": [1, 2, 9], "prompt_len": 2},
+        {"id": 777, "tokens": [5], "prompt_len": 1},   # unknown node id
+    ]
+    out = m.partial("chat")
+    assert out == {"partial": [{"id": rid, "tokens": [1, 2, 9],
+                                "prompt_len": 2}]}
+
+
+def test_manager_cancelled_total_survives_wire_roundtrip(mgr):
+    m, transport = mgr
+    rid = m.submit("chat", [1], max_new=4)
+    m.cancel("chat", rid)
+    cfg = ClusterConfig(hosts=HOSTS, coordinator="n0",
+                        standby_coordinator="n1", introducer="n0")
+    standby = LMPoolManager("n1", cfg, FakeTransport(), FakeMembership())
+    standby.load_wire(m.to_wire())
+    assert standby._pools["chat"]["cancelled_total"] == 1
+    assert standby._pools["chat"]["requests"][rid]["status"] == "cancelled"
+
+
+# -- control RPC end to end ------------------------------------------------
+
+def test_cancel_and_partial_verbs_over_rpc(lm, tmp_path):
+    from idunno_tpu.engine.generate import save_lm
+    from idunno_tpu.serve.control import ControlService
+    from idunno_tpu.store.sdfs import FileStoreService
+    from idunno_tpu.comm.inproc import InProcNetwork
+    from idunno_tpu.membership.service import MembershipService
+
+    from tests.test_membership import FakeClock, pump
+
+    model, params = lm
+    net = InProcNetwork()
+    cfg = ClusterConfig(hosts=("n0",), coordinator="n0",
+                        standby_coordinator="n0", introducer="n0",
+                        replication_factor=1)
+    transport = net.transport("n0")
+    clock = FakeClock()
+    member = MembershipService("n0", cfg, transport, clock=clock)
+    store = FileStoreService("n0", cfg, transport, member,
+                             str(tmp_path / "n0"))
+    member.join()
+    clock.advance(0.01)
+    pump({"n0": member}, clock)
+    save_lm(store, "pool", model, params)
+
+    node = type("NodeStub", (), {})()
+    node.host, node.store, node.transport = "n0", store, transport
+    ctl = ControlService(node)
+
+    def call(payload):
+        return ctl._handle("control", Message(
+            MessageType.INFERENCE, "client", payload))
+
+    try:
+        out = call({"verb": "lm_serve", "name": "pool", "slots": 1,
+                    "prompt_len": 4, "max_len": 520})
+        assert out.type is MessageType.ACK
+
+        # long stream: the cancel must land mid-decode even on a fast host
+        out = call({"verb": "lm_submit", "name": "pool",
+                    "prompt": [1, 2], "max_new": 500})
+        long_id = out.payload["id"]
+
+        # wait for live progress, then read it through lm_partial
+        partial = []
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            out = call({"verb": "lm_partial", "name": "pool"})
+            assert out.type is MessageType.ACK
+            partial = out.payload["partial"]
+            if partial and len(partial[0]["tokens"]) > 2:
+                break
+            time.sleep(0.05)
+        assert partial and partial[0]["id"] == long_id
+
+        out = call({"verb": "lm_cancel", "name": "pool", "id": long_id})
+        assert out.type is MessageType.ACK and out.payload["cancelled"]
+
+        done = {}
+        deadline = time.time() + 60
+        while time.time() < deadline and long_id not in done:
+            out = call({"verb": "lm_poll", "name": "pool"})
+            for c in out.payload["completions"]:
+                done[c["id"]] = c
+            time.sleep(0.05)
+        # oracle last: a 500-token generate takes seconds and must not sit
+        # between the partial read and the cancel
+        full = expected(model, params, [1, 2], 500)
+        assert partial[0]["tokens"] == full[:len(partial[0]["tokens"])]
+        got = done[long_id]
+        assert got["cancelled"]
+        assert len(got["tokens"]) < len(full)
+        assert got["tokens"] == full[:len(got["tokens"])]
+
+        out = call({"verb": "lm_cancel", "name": "pool", "id": 999})
+        assert out.type is MessageType.ACK
+        assert not out.payload["cancelled"]
+    finally:
+        ctl.close()
+
+
+def test_manager_cancel_racing_forward_sends_node_cancel(mgr):
+    """A cancel that lands while submit()'s forward RPC is in flight sees
+    a pending request with no node mapping — the forward's post-check must
+    then send the node-side cancel itself, or the node decodes the whole
+    request into a dropped completion."""
+    import threading
+
+    m, transport = mgr
+    release = threading.Event()
+    in_submit = threading.Event()
+    orig_call = transport.call
+
+    def slow_call(node, component, msg, timeout=30.0):
+        if msg.payload.get("verb") == "lm_submit":
+            in_submit.set()
+            release.wait(10)
+        return orig_call(node, component, msg, timeout)
+
+    transport.call = slow_call
+    t = threading.Thread(target=lambda: m.submit("chat", [1], max_new=4))
+    t.start()
+    assert in_submit.wait(10)        # journaled pending, blocked in the RPC
+    assert m.cancel("chat", 0) == {"cancelled": True}
+    assert transport.verbs("lm_cancel") == []    # no node id to cancel yet
+    release.set()
+    t.join(10)
+    assert [p["id"] for _, p in transport.verbs("lm_cancel")] == [1]
+    assert m._pools["chat"]["requests"][0]["status"] == "cancelled"

@@ -600,3 +600,74 @@ def test_stats_reports_serving_config(lm):
     cfg = spec.stats()["config"]
     assert cfg["speculative_draft_len"] == 3
     assert cfg["quantize"] == "none"
+
+
+def test_cancel_queued_request(lm):
+    """A cancel that lands while the request is still queued drops it
+    before admission: its completion carries only the prompt and the
+    cancelled flag; the already-live request is untouched (exact)."""
+    model, params = lm
+    srv = DecodeServer(model, params, slots=1, prompt_len=4, max_len=24)
+    live_id = srv.submit([1, 2], max_new=6)
+    srv.step()                                # admit into the only slot
+    queued_id = srv.submit([3, 4, 5], max_new=6)
+    assert srv.cancel(queued_id) == "queued"
+    done = {c.id: c for c in srv.run_until_drained()}
+    assert done[queued_id].cancelled
+    assert done[queued_id].tokens == [3, 4, 5]          # prompt only
+    assert done[queued_id].prompt_len == 3
+    assert not done[live_id].cancelled
+    assert done[live_id].tokens == expected(model, params, [1, 2], 6)
+    assert srv.stats()["cancelled"] == 1
+    assert srv.stats()["completed"] == 1      # cancelled is not completed
+
+
+def test_cancel_live_returns_partial_and_frees_slot(lm):
+    """Cancelling a live row retires it with the tokens generated so far
+    (a strict prefix of what it would have produced), frees the slot for
+    the next queued prompt, and never perturbs co-resident rows."""
+    model, params = lm
+    srv = DecodeServer(model, params, slots=2, prompt_len=4, max_len=44)
+    long_id = srv.submit([1, 2, 3], max_new=40)
+    other_id = srv.submit([7, 8], max_new=10)
+    for _ in range(4):
+        srv.step()
+    assert srv.cancel(long_id) == "live"
+    follow_id = srv.submit([5], max_new=3)    # admitted into the freed slot
+    done = {c.id: c for c in srv.run_until_drained()}
+
+    full = expected(model, params, [1, 2, 3], 40)
+    got = done[long_id]
+    assert got.cancelled
+    assert len(got.tokens) < len(full)
+    assert got.tokens == full[:len(got.tokens)], \
+        "partial tokens must be a prefix of the uncancelled stream"
+    assert len(got.tokens) > 3                # prompt + at least one token
+    assert not done[other_id].cancelled
+    assert done[other_id].tokens == expected(model, params, [7, 8], 10)
+    assert done[follow_id].tokens == expected(model, params, [5], 3)
+    # idempotence / unknown ids
+    assert srv.cancel(long_id) == "unknown"
+    assert srv.cancel(999) == "unknown"
+
+
+def test_snapshot_streams_prefixes(lm):
+    """`snapshot` exposes every live row's progress as an exact prefix of
+    its final stream — the streaming surface behind lm_partial."""
+    model, params = lm
+    srv = DecodeServer(model, params, slots=2, prompt_len=4, max_len=30)
+    a = srv.submit([1, 2], max_new=20)
+    b = srv.submit([9, 3, 4], max_new=20)
+    assert srv.snapshot() == []               # nothing admitted yet
+    for _ in range(3):
+        srv.step()
+    snap = {r["id"]: r for r in srv.snapshot()}
+    assert set(snap) == {a, b}
+    for rid, prompt in ((a, [1, 2]), (b, [9, 3, 4])):
+        row = snap[rid]
+        assert row["prompt_len"] == len(prompt)
+        full = expected(model, params, prompt, 20)
+        assert len(row["tokens"]) > len(prompt)         # progress visible
+        assert row["tokens"] == full[:len(row["tokens"])]
+    srv.run_until_drained()
+    assert srv.snapshot() == []               # drained pool has no live rows
