@@ -107,7 +107,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l = l_ref[:].max(axis=-1, keepdims=True)
         l_safe = jnp.where(l == 0.0, 1.0, l)              # fully-masked rows
         o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m + jnp.log(l_safe)).reshape(block_q)
+        lse_ref[0] = m + jnp.log(l_safe)                  # [bq, 1]
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -130,11 +130,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = _masked_scores(q, k, iq, jk, scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k,
                            seq_len=seq_len, t_pad=t_pad)
-        p = jnp.exp(s - lse_ref[0].reshape(block_q, 1))   # [bq, bk]
+        p = jnp.exp(s - lse_ref[0])   # [bq, bk]
         dp = jax.lax.dot_general(                          # dO·Vᵀ  [bq, bk]
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0].reshape(block_q, 1)) * scale
+        ds = p * (dp - delta_ref[0]) * scale
         acc_ref[:] = acc_ref[:] + jax.lax.dot_general(     # ds·K  [bq, D]
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -165,14 +165,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = _masked_scores(q, k, iq, jk, scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k,
                            seq_len=seq_len, t_pad=t_pad)
-        p = jnp.exp(s - lse_ref[0].reshape(block_q, 1))   # [bq, bk]
+        p = jnp.exp(s - lse_ref[0])   # [bq, bk]
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(       # pᵀ·dO  [bk, D]
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0].reshape(block_q, 1)) * scale
+        ds = p * (dp - delta_ref[0]) * scale
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(       # dsᵀ·Q  [bk, D]
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -200,10 +200,17 @@ def _flash_core(qb, kb, vb, causal, block_q, block_k, seq_len, interpret):
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k,
                                seq_len=seq_len, t_pad=t_pad)
+    # LSE rides as [G, T_pad, 1]: a (1, block_q, 1) block is a legal TPU
+    # tile — the trailing dim equals the array dim, and the middle dim is
+    # either a multiple of 8 (block_q=128 default) or equal to t_pad
+    # (ragged short sequences, where block_q == t == t_pad). The natural
+    # (1, block_q) block over [G, T_pad] violates the (8, 128)
+    # minimum-tile rule and fails to lower on real TPU (observed live:
+    # BENCH_LAST_GOOD_lm.json 2026-07-31 capture).
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(_out_struct((g, t_pad, d), qb.dtype, qb),
-                   _out_struct((g, t_pad), jnp.float32, qb)),
+                   _out_struct((g, t_pad, 1), jnp.float32, qb)),
         grid=(g, t_pad // block_q, t_pad // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
@@ -211,13 +218,13 @@ def _flash_core(qb, kb, vb, causal, block_q, block_k, seq_len, interpret):
             pl.BlockSpec((1, block_k, d), lambda g, i, j: (g, j, 0)),
         ],
         out_specs=(pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0)),
-                   pl.BlockSpec((1, block_q), lambda g, i, j: (g, i))),
+                   pl.BlockSpec((1, block_q, 1), lambda g, i, j: (g, i, 0))),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),    # acc
                         pltpu.VMEM((block_q, 128), jnp.float32),  # running max
                         pltpu.VMEM((block_q, 128), jnp.float32)], # running sum
         interpret=interpret,
     )(qb, kb, vb)
-    return out, lse
+    return out, lse[..., 0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -240,9 +247,12 @@ def _flash_bwd(causal, block_q, block_k, seq_len, interpret, res, do):
     # delta = rowsum(dO ∘ O): cheap elementwise reduce, XLA fuses it.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                               # [G, T_pad]
+    # Row vectors enter the kernels as [G, T_pad, 1] so their (1, block_q, 1)
+    # blocks satisfy the TPU minimum-tile rule (see _flash_core).
+    lse3, delta3 = lse[..., None], delta[..., None]
     nq, nk = t_pad // block_q, t_pad // block_k
     qspec = pl.BlockSpec((1, block_q, d), lambda g, i, j: (g, i, 0))
-    rowspec = pl.BlockSpec((1, block_q), lambda g, i, j: (g, i))
+    rowspec = pl.BlockSpec((1, block_q, 1), lambda g, i, j: (g, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
@@ -259,12 +269,12 @@ def _flash_bwd(causal, block_q, block_k, seq_len, interpret, res, do):
         out_specs=qspec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qb, kb, vb, do, lse, delta)
+    )(qb, kb, vb, do, lse3, delta3)
 
     # dk/dv grid: k block is the carried (outer) axis, q is scanned last.
     kspec = pl.BlockSpec((1, block_k, d), lambda g, j, i: (g, j, 0))
     qspec2 = pl.BlockSpec((1, block_q, d), lambda g, j, i: (g, i, 0))
-    rowspec2 = pl.BlockSpec((1, block_q), lambda g, j, i: (g, i))
+    rowspec2 = pl.BlockSpec((1, block_q, 1), lambda g, j, i: (g, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
@@ -277,7 +287,7 @@ def _flash_bwd(causal, block_q, block_k, seq_len, interpret, res, do):
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(qb, kb, vb, do, lse, delta)
+    )(qb, kb, vb, do, lse3, delta3)
     return dq, dk, dv
 
 
