@@ -358,8 +358,11 @@ def run_bench(devices) -> None:
     # largest batch FIRST: the budget clamp then cuts the cheap points,
     # never the strong one (round-3 VERDICT weak #1: the sweep must
     # genuinely reach 1024 in an unattended run)
+    # 128 rides at the end: the 2026-07-31 capture showed 256 beating 512
+    # and 1024 (activation working-set), so the optimum may sit lower still;
+    # being last, the budget clamp cuts it first.
     sweep = [int(s) for s in
-             os.environ.get("BENCH_SWEEP", "1024,512,256").split(",")]
+             os.environ.get("BENCH_SWEEP", "1024,512,256,128").split(",")]
     # weight residency knobs: param_dtype bfloat16 halves weight HBM traffic
     # vs float32 (and is the MXU-native input dtype); quantize=int8 quarters
     # residency (ops/quantize.py). bfloat16 is the unattended default; the
@@ -392,8 +395,10 @@ def run_bench(devices) -> None:
     # order as the compute and caps measured MFU far below the chip's. A
     # longer scan over REAL distinct HBM buffers (tiled copies, no H2D
     # cost, no XLA CSE of identical passes) amortizes it honestly.
+    # 8 tiles: at tile 4 the 2026-07-31 capture's best point timed a 0.41 s
+    # region, so ~0.1 s of fixed latency was still ~25% of the measurement.
     scan_tile = max(1, int(os.environ.get(
-        "BENCH_SCAN_TILE", "4" if platform == "tpu" else "1")))
+        "BENCH_SCAN_TILE", "8" if platform == "tpu" else "1")))
 
     def staged_for(bs: int):
         k = n_images // bs

@@ -58,11 +58,15 @@ def lm_bench_config(platform: str) -> dict:
         "depth": _env_int("BENCH_LM_DEPTH", 12 if tpu else 2),
         "heads": _env_int("BENCH_LM_HEADS", 16 if tpu else 4),
         "vocab": _env_int("BENCH_LM_VOCAB", 32768 if tpu else 512),
-        "slots": _env_int("BENCH_LM_SLOTS", 8 if tpu else 4),
+        # Decode slots/steps are sized so one dispatch carries enough work
+        # to amortize the tunnel's ~0.1-0.25 s fixed dispatch latency: the
+        # 2026-07-31 capture at slots=8/steps=32 measured 0.29 s/dispatch,
+        # i.e. mostly latency, not the HBM-bound weight stream (~40 ms).
+        "slots": _env_int("BENCH_LM_SLOTS", 16 if tpu else 4),
         "prompt_len": _env_int("BENCH_LM_PROMPT", 64 if tpu else 16),
-        "max_new": _env_int("BENCH_LM_MAXNEW", 224 if tpu else 48),
+        "max_new": _env_int("BENCH_LM_MAXNEW", 448 if tpu else 48),
         "max_len": _env_int("BENCH_LM_MAXLEN", 512 if tpu else 128),
-        "decode_steps": _env_int("BENCH_LM_DECODE_STEPS", 32 if tpu else 8),
+        "decode_steps": _env_int("BENCH_LM_DECODE_STEPS", 128 if tpu else 8),
         "prefill_batch": _env_int("BENCH_LM_PREFILL_BATCH", 4 if tpu else 2),
         "prefill_seq": _env_int("BENCH_LM_PREFILL_SEQ", 1024 if tpu else 64),
         "draft_dim": _env_int("BENCH_LM_DRAFT_DIM", 256 if tpu else 64),
@@ -82,10 +86,12 @@ def _count_params(params) -> tuple[int, int]:
     return n, b
 
 
-def _steady_decode_tok_s(srv, cfg: dict) -> tuple[float, int]:
+def _steady_decode_tok_s(srv, cfg: dict) -> tuple[float, int, float]:
     """Fill every slot, then time K full-occupancy dispatches. Each
     `step()` ends in a host D2H read of the remaining counters
-    (`_retire_finished`), so per-step timing is naturally synced."""
+    (`_retire_finished`), so per-step timing is naturally synced. Returns
+    (tokens/sec, K, seconds/dispatch) — the last makes the fixed
+    per-dispatch latency separable from the HBM-bound compute."""
     for _ in range(cfg["slots"]):
         srv.submit(list(range(1, cfg["prompt_len"] + 1)),
                    max_new=cfg["max_new"])
@@ -95,7 +101,7 @@ def _steady_decode_tok_s(srv, cfg: dict) -> tuple[float, int]:
     for _ in range(k):
         srv.step()
     dt = time.perf_counter() - t0
-    return cfg["slots"] * cfg["decode_steps"] * k / dt, k
+    return cfg["slots"] * cfg["decode_steps"] * k / dt, k, dt / k
 
 
 def run_lm_bench(platform: str, device_kind: str, n_devices: int,
@@ -203,7 +209,7 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
         """Build a pool, pay its compiles on a warm-up request, then
         measure steady-state decode tokens/sec — the shared protocol for
         the plain/int8/GQA points. Returns (tok/s, timed dispatches,
-        compile seconds)."""
+        seconds/dispatch, compile seconds)."""
         srv = DecodeServer(m, p, slots=cfg["slots"],
                            prompt_len=cfg["prompt_len"],
                            max_len=cfg["max_len"],
@@ -212,15 +218,15 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
         t0 = time.perf_counter()
         srv.run_until_drained()
         c_s = time.perf_counter() - t0
-        ts, kk = _steady_decode_tok_s(srv, cfg)
-        return ts, kk, c_s
+        ts, kk, disp_s = _steady_decode_tok_s(srv, cfg)
+        return ts, kk, disp_s, c_s
 
-    tok_s, k, compile_s = measure_pool(model, params)
+    tok_s, k, dispatch_s, compile_s = measure_pool(model, params)
     out["decode_compile_s"] = round(compile_s, 2)
     out["decode"] = {
         "tokens_per_s": round(tok_s, 1),
         "slots": cfg["slots"], "decode_steps": cfg["decode_steps"],
-        "timed_dispatches": k,
+        "timed_dispatches": k, "dispatch_s": round(dispatch_s, 4),
         # decode re-streams the whole weight set once per token step
         # (all slots advance together): steps/s = tok_s / slots
         "implied_weight_stream_gbps": round(
@@ -248,9 +254,14 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
                 draft_len=cfg["draft_len"])
             spec.submit([1, 2, 3], max_new=2)
             spec.run_until_drained()                     # compile
+            # speculative rows need draft_len+1 headroom below max_len
+            # (DecodeServer.validate), so clamp against the serving config
+            spec_max_new = min(
+                cfg["max_new"],
+                cfg["max_len"] - cfg["prompt_len"] - cfg["draft_len"] - 1)
             for _ in range(cfg["slots"]):
                 spec.submit(list(range(1, cfg["prompt_len"] + 1)),
-                            max_new=cfg["max_new"])
+                            max_new=spec_max_new)
             spec.step()              # admission (prefills) + first round
             cur0 = int(np.asarray(spec._cursors).sum())
             disp0 = spec.stats()["dispatches"]
@@ -281,7 +292,7 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
 
     if not compact and time.perf_counter() < deadline:
         try:
-            tok8, _, _ = measure_pool(model, params, quantize="int8")
+            tok8, _, _, _ = measure_pool(model, params, quantize="int8")
             out["int8_decode"] = {
                 "tokens_per_s": round(tok8, 1),
                 "vs_bf16": round(tok8 / tok_s, 2),
@@ -307,7 +318,7 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
                 jax.random.PRNGKey(2),
                 jnp.zeros((1, 8), jnp.int32))["params"]
             gq_n, _ = _count_params(gq_params)
-            tokg, _, _ = measure_pool(gq_model, gq_params)
+            tokg, _, _, _ = measure_pool(gq_model, gq_params)
             out["gqa_decode"] = {
                 "kv_heads": kvh, "heads": cfg["heads"],
                 "tokens_per_s": round(tokg, 1),
