@@ -78,6 +78,15 @@ def lm_bench_config(platform: str) -> dict:
     }
 
 
+def spec_max_new(cfg: dict) -> int:
+    """max_new for the speculative phase: speculative rows reserve
+    draft_len+1 headroom below max_len (DecodeServer.validate), so the
+    plain max_new is clamped against the serving config. Single source of
+    truth — the phase and its config-guard test both call this."""
+    return min(cfg["max_new"],
+               cfg["max_len"] - cfg["prompt_len"] - cfg["draft_len"] - 1)
+
+
 def _count_params(params) -> tuple[int, int]:
     """(n_params, bytes) over a params tree."""
     leaves = jax.tree.leaves(params)
@@ -254,14 +263,9 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
                 draft_len=cfg["draft_len"])
             spec.submit([1, 2, 3], max_new=2)
             spec.run_until_drained()                     # compile
-            # speculative rows need draft_len+1 headroom below max_len
-            # (DecodeServer.validate), so clamp against the serving config
-            spec_max_new = min(
-                cfg["max_new"],
-                cfg["max_len"] - cfg["prompt_len"] - cfg["draft_len"] - 1)
             for _ in range(cfg["slots"]):
                 spec.submit(list(range(1, cfg["prompt_len"] + 1)),
-                            max_new=spec_max_new)
+                            max_new=spec_max_new(cfg))
             spec.step()              # admission (prefills) + first round
             cur0 = int(np.asarray(spec._cursors).sum())
             disp0 = spec.stats()["dispatches"]

@@ -8,7 +8,8 @@ import time
 
 import pytest
 
-from idunno_tpu.utils.lm_bench import lm_bench_config, run_lm_bench
+from idunno_tpu.utils.lm_bench import (lm_bench_config, run_lm_bench,
+                                        spec_max_new)
 
 TINY = {
     "BENCH_LM_DIM": "64", "BENCH_LM_DEPTH": "1", "BENCH_LM_HEADS": "2",
@@ -62,3 +63,25 @@ def test_deadline_skips_optional_phases(tiny_env):
                        deadline=time.perf_counter() - 1, compact=False)
     assert "speculative" not in rec and "int8_decode" not in rec
     assert rec["decode"]["tokens_per_s"] > 0
+
+
+@pytest.mark.parametrize("platform", ["tpu", "cpu"])
+def test_default_config_phases_fit_serving_limits(platform, monkeypatch):
+    """The unattended defaults must keep EVERY phase admissible — a knob
+    bump that overflows a validate() limit silently turns a capture phase
+    into an error record (caught live: max_new 448 + draft headroom > 512)."""
+    for k in list(TINY) + ["BENCH_LM_MAXNEW", "BENCH_LM_MAXLEN",
+                           "BENCH_LM_DRAFT_LEN"]:
+        monkeypatch.delenv(k, raising=False)   # pin the SHIPPED defaults
+    cfg = lm_bench_config(platform)
+    # plain/int8/gqa rows
+    assert cfg["prompt_len"] + cfg["max_new"] <= cfg["max_len"]
+    # speculative rows: after the bench's clamp (same helper the phase
+    # calls) the rows must still generate enough to time ≥1 full round
+    assert spec_max_new(cfg) > cfg["draft_len"] + 1
+    # _steady_decode_tok_s times k = (max_new-1)//decode_steps - 1 ≥ 1
+    # FULL dispatches after the untimed first one; anything less and the
+    # max(1, ...) floor counts a partial dispatch as a full one
+    assert cfg["max_new"] >= 2 * cfg["decode_steps"] + 1
+    assert cfg["heads"] % max(cfg["gqa_kv_heads"], 1) == 0
+    assert cfg["dim"] % cfg["heads"] == 0
