@@ -175,32 +175,67 @@ class LMPoolManager:
                 return {"already": True,
                         "node": self._pools[name]["node"]}
             # reserve before the (slow) remote build so a concurrent serve
-            # of the same name returns "already" instead of double-placing
-            self._pools[name] = {"spec": dict(spec), "node": None,
-                                 "next_rid": 0, "requests": {},
-                                 "done_total": 0, "failed_total": 0,
-                                 "cancelled_total": 0,
-                                 "node_errors": [],
-                                 # measured service samples feeding the
-                                 # heterogeneous fair share: (seconds from
-                                 # submit to completion, new tokens)
-                                 "svc_samples": [],
-                                 "slots_now": int(spec.get("slots", 4)),
-                                 "slots_cap": int(spec.get("slots", 4)),
-                                 "slots_target_prev": None,
-                                 "t_last_resize": 0.0}
+            # of the same name returns "already" instead of double-placing.
+            # _recovering guards the build: the pump treats node=None as an
+            # orphan, and without the flag it would concurrently re-place
+            # this still-building pool on another node — leaking whichever
+            # loop loses the race (the build is ~80 s on a cold TPU shape,
+            # many pump periods long)
+            entry = {"spec": dict(spec), "node": None,
+                     "_recovering": True,
+                     "next_rid": 0, "requests": {},
+                     "done_total": 0, "failed_total": 0,
+                     "cancelled_total": 0,
+                     "node_errors": [],
+                     # measured service samples feeding the
+                     # heterogeneous fair share: (seconds from
+                     # submit to completion, new tokens)
+                     "svc_samples": [],
+                     "slots_now": int(spec.get("slots", 4)),
+                     "slots_cap": int(spec.get("slots", 4)),
+                     "slots_target_prev": None,
+                     "t_last_resize": 0.0}
+            self._pools[name] = entry
         try:
             node = self._place()
             out = self._call(node, dict(spec, verb="lm_serve"),
                              timeout=self.build_rpc_timeout_s)
         except BaseException:
             with self._lock:
-                if self._pools.get(name, {}).get("node") is None:
+                # identity, not name: lm_stop + a re-serve may have
+                # replaced the entry with a NEW generation mid-build —
+                # deleting by name would destroy the newer reservation
+                if self._pools.get(name) is entry:
                     del self._pools[name]
             raise
         with self._lock:
-            self._pools[name]["node"] = node
+            # commit node + clear the build guard atomically, and only
+            # into THIS build's entry: after lm_stop + re-serve the name
+            # maps to a different generation whose build is still in
+            # flight — committing into it would un-guard it mid-build
+            if self._pools.get(name) is entry:
+                entry["node"] = node
+                entry["_recovering"] = False
+                stale_node = None
+            else:
+                # stopped (or superseded) while the build RPC ran:
+                # nothing must keep serving
+                stale_node = node
+        if stale_node is not None:
+            self._stop_stale_loop(stale_node, name)
+            return {"node": None, "stopped": True}
         return {"node": node, "slots": out.get("slots")}
+
+    def _stop_stale_loop(self, node: str, name: str) -> None:
+        """Best-effort lm_stop for a loop this manager just built but can
+        no longer account for (the registry entry was stopped or re-placed
+        while the build RPC ran) — an unaccounted live loop would decode
+        into a dead outbox and hold device HBM indefinitely."""
+        try:
+            self._call(node, {"verb": "lm_stop", "name": name},
+                       timeout=10.0)
+        except (TransportError, ValueError, OSError):
+            pass
 
     def submit(self, name: str, prompt: list[int], max_new: int,
                temperature: float = 0.0, top_p: float = 1.0,
@@ -448,19 +483,44 @@ class LMPoolManager:
             if job is not None and not self._job_over(job):
                 raise ValueError(f"training job {name!r} already running "
                                  f"on {job['node']}")
-            self._jobs[name] = {"spec": dict(spec), "node": None,
-                                "status": None, "stop_requested": False}
+            # _recovering guards the initial build exactly as in serve():
+            # without it the pump sees node=None mid-build and _recover_job
+            # starts a SECOND copy of the job (resume=True) on another
+            # node — two jobs burning two chips, one unaccounted
+            entry = {"spec": dict(spec), "node": None,
+                     "_recovering": True,
+                     "status": None, "stop_requested": False}
+            self._jobs[name] = entry
         try:
             node = self._place()
             self._call(node, dict(spec, verb="train_start"),
                        timeout=self.build_rpc_timeout_s)
         except BaseException:
             with self._lock:
-                if self._jobs.get(name, {}).get("node") is None:
+                # identity, not name (see serve()): a replaced-generation
+                # entry must not be destroyed by this build's cleanup
+                if self._jobs.get(name) is entry:
                     del self._jobs[name]
             raise
         with self._lock:
-            self._jobs[name]["node"] = node
+            # commit node + clear the build guard atomically, and only
+            # into THIS build's entry (as serve()): after a stop + re-train
+            # the name maps to a new generation still mid-build
+            if self._jobs.get(name) is entry:
+                entry["node"] = node
+                entry["_recovering"] = False
+                stale_node = None
+            else:
+                stale_node = node
+        if stale_node is not None:
+            # the job this build started answers to nobody — stop it
+            # (best-effort; a chip-burning unaccounted trainer otherwise)
+            try:
+                self._call(stale_node, {"verb": "train_stop",
+                                        "name": name}, timeout=10.0)
+            except (TransportError, ValueError, OSError):
+                pass
+            return {"started": False, "stopped": True, "node": None}
         return {"started": True, "node": node}
 
     def train_status(self, name: str) -> dict[str, Any]:
@@ -678,12 +738,12 @@ class LMPoolManager:
         the OLD slot count, with the hysteresis free to retry. Only if
         the node itself fails does this fall back to orphan + recovery."""
         with self._lock:
-            pool = self._pools.get(name)
-            if (pool is None or pool["node"] != node
-                    or pool.get("_recovering")):
+            entry = self._pools.get(name)
+            if (entry is None or entry["node"] != node
+                    or entry.get("_recovering")):
                 return
-            pool["_recovering"] = True
-            spec = dict(pool["spec"], slots=target)
+            entry["_recovering"] = True
+            spec = dict(entry["spec"], slots=target)
         try:
             try:
                 out = self._call(node, dict(spec, verb="lm_serve",
@@ -691,8 +751,8 @@ class LMPoolManager:
                                  timeout=self.build_rpc_timeout_s)
             except (TransportError, ValueError, OSError):
                 with self._lock:
-                    pool = self._pools.get(name)
-                    if pool is not None and pool["node"] == node:
+                    if (self._pools.get(name) is entry
+                            and entry["node"] == node):
                         self._orphan_pool_locked(name)
                 return                  # pump re-places on a survivor
             if out.get("already") or out.get("stopped"):
@@ -704,31 +764,39 @@ class LMPoolManager:
                 # (or the stop) settle it
                 return
             with self._lock:
-                pool = self._pools.get(name)
-                if pool is None or pool["node"] != node:
-                    return
-                pool["spec"]["slots"] = target
-                pool["slots_now"] = target
-                pool["t_last_resize"] = time.time()
-                # the replaced loop dropped its in-flight requests; requeue
-                # for token-exact replay. attempts reset: a pool-level
-                # rebuild (and its recompile) must not consume a request's
-                # suspicion budget (ADVICE r3)
-                for req in pool["requests"].values():
-                    if req["status"] == _INFLIGHT:
-                        req["status"] = _PENDING
-                        req["node_id"] = None
-                        req["attempts"] = 0
-                pending = [(rid, dict(r)) for rid, r in
-                           sorted(pool["requests"].items())
-                           if r["status"] == _PENDING]
+                # identity check: stopped (or replaced by a re-serve
+                # generation) while the rebuild RPC ran means the fresh
+                # loop answers to nobody — stop it (an lm_stop that landed
+                # mid-build was already handled by the 'stopped' reply)
+                stale = (self._pools.get(name) is not entry
+                         or entry["node"] != node)
+                if not stale:
+                    entry["spec"]["slots"] = target
+                    entry["slots_now"] = target
+                    entry["t_last_resize"] = time.time()
+                    # the replaced loop dropped its in-flight requests;
+                    # requeue for token-exact replay. attempts reset: a
+                    # pool-level rebuild (and its recompile) must not
+                    # consume a request's suspicion budget (ADVICE r3)
+                    for req in entry["requests"].values():
+                        if req["status"] == _INFLIGHT:
+                            req["status"] = _PENDING
+                            req["node_id"] = None
+                            req["attempts"] = 0
+                    pending = [(rid, dict(r)) for rid, r in
+                               sorted(entry["requests"].items())
+                               if r["status"] == _PENDING]
+            if stale:
+                self._stop_stale_loop(node, name)
+                return
             for rid, req in pending:
                 self._forward(name, node, rid, req)
         finally:
             with self._lock:
-                pool = self._pools.get(name)
-                if pool is not None:
-                    pool["_recovering"] = False
+                # clear only THIS generation's guard: a replacement
+                # entry's in-flight build must stay guarded
+                if self._pools.get(name) is entry:
+                    entry["_recovering"] = False
 
     def _requeue_stale_locked(self, pool: dict[str, Any],
                               now: float) -> None:
@@ -882,12 +950,12 @@ class LMPoolManager:
         just-forwarded requests as inflight ids of a dead loop until the
         watchdog times them out."""
         with self._lock:
-            pool = self._pools.get(name)
-            if (pool is None or pool["node"] is not None
-                    or pool.get("_recovering")):
+            entry = self._pools.get(name)
+            if (entry is None or entry["node"] is not None
+                    or entry.get("_recovering")):
                 return
-            pool["_recovering"] = True
-            spec = dict(pool["spec"])
+            entry["_recovering"] = True
+            spec = dict(entry["spec"])
         try:
             try:
                 node = self._place()
@@ -896,29 +964,36 @@ class LMPoolManager:
             except (TransportError, ValueError, OSError):
                 return                  # pump retries next period
             with self._lock:
-                pool = self._pools.get(name)
-                if pool is None or pool["node"] is not None:
-                    return
-                pool["node"] = node
-                pending = [(rid, dict(r)) for rid, r in
-                           sorted(pool["requests"].items())
-                           if r["status"] == _PENDING]
+                # identity check: stopped, or replaced by a re-serve
+                # generation (whose own build must not be committed into
+                # or un-guarded by this recovery), while the rebuild RPC
+                # ran — the fresh loop answers to nobody, stop it
+                stale = (self._pools.get(name) is not entry
+                         or entry["node"] is not None)
+                if not stale:
+                    entry["node"] = node
+                    pending = [(rid, dict(r)) for rid, r in
+                               sorted(entry["requests"].items())
+                               if r["status"] == _PENDING]
+            if stale:
+                self._stop_stale_loop(node, name)
+                return
             for rid, req in pending:
                 self._forward(name, node, rid, req)
         finally:
             with self._lock:
-                pool = self._pools.get(name)
-                if pool is not None:
-                    pool["_recovering"] = False
+                # clear only THIS generation's guard
+                if self._pools.get(name) is entry:
+                    entry["_recovering"] = False
 
     def _recover_job(self, name: str) -> None:
         with self._lock:
-            job = self._jobs.get(name)
-            if (job is None or job["node"] is not None
-                    or job.get("_recovering")):
+            entry = self._jobs.get(name)
+            if (entry is None or entry["node"] is not None
+                    or entry.get("_recovering")):
                 return
-            job["_recovering"] = True   # serialized like _recover_pool
-            spec = dict(job["spec"], resume=True)
+            entry["_recovering"] = True   # serialized like _recover_pool
+            spec = dict(entry["spec"], resume=True)
         try:
             try:
                 node = self._place()
@@ -926,15 +1001,27 @@ class LMPoolManager:
                            timeout=self.build_rpc_timeout_s)
             except (TransportError, ValueError, OSError):
                 return
+            stale_node = None
             with self._lock:
-                job = self._jobs.get(name)
-                if job is not None and job["node"] is None:
-                    job["node"] = node
+                # identity check, as _recover_pool: a stop + re-train may
+                # have replaced the entry mid-rebuild
+                if (self._jobs.get(name) is entry
+                        and entry["node"] is None
+                        and not entry.get("stop_requested")):
+                    entry["node"] = node
+                else:
+                    stale_node = node
+            if stale_node is not None:
+                try:
+                    self._call(stale_node, {"verb": "train_stop",
+                                            "name": name}, timeout=10.0)
+                except (TransportError, ValueError, OSError):
+                    pass
         finally:
             with self._lock:
-                job = self._jobs.get(name)
-                if job is not None:
-                    job["_recovering"] = False
+                # clear only THIS generation's guard
+                if self._jobs.get(name) is entry:
+                    entry["_recovering"] = False
 
     # -- failover replication ---------------------------------------------
 
