@@ -369,6 +369,13 @@ def run_bench(devices) -> None:
     # float32/int8 comparison points are captured per-run below.
     param_dtype = os.environ.get("BENCH_PARAM_DTYPE", "bfloat16")
     quantize = os.environ.get("BENCH_QUANTIZE", "none")
+    # space-to-depth ResNet stem (models/resnet.py _S2DStem): same params
+    # and outputs, better MXU shape. Off for the headline until measured;
+    # the dtype_points block below captures it as a comparison point.
+    # ResNet-only so the emitted stem_s2d flag always reflects the stem
+    # that actually ran (other families have no 7x7/s2 stem to fold).
+    stem_s2d = (os.environ.get("BENCH_STEM_S2D", "0") == "1"
+                and BENCH_MODEL.startswith("resnet"))
     platform = devices[0].platform
     device_kind = getattr(devices[0], "device_kind", platform)
 
@@ -428,7 +435,7 @@ def run_bench(devices) -> None:
             continue
         engine = InferenceEngine(
             EngineConfig(batch_size=bs, param_dtype=param_dtype,
-                         quantize=quantize),
+                         quantize=quantize, stem_s2d=stem_s2d),
             mesh=mesh, pretrained=False)
         staged, k = staged_for(bs)
         t0 = time.perf_counter()
@@ -472,16 +479,23 @@ def run_bench(devices) -> None:
     if platform == "tpu":
         bs = best["batch_size"]
         staged, k = staged_for(bs)
-        for pd, qz in (("float32", "none"), ("bfloat16", "int8")):
-            if pd == param_dtype and qz == quantize:
+        variants = [("float32", "none", stem_s2d),
+                    ("bfloat16", "int8", stem_s2d)]
+        if BENCH_MODEL.startswith("resnet"):
+            # the stem recast, measured against the headline config (same
+            # dtype/quantize, only the stem differs)
+            variants.append((param_dtype, quantize, not stem_s2d))
+        for pd, qz, s2d in variants:
+            if pd == param_dtype and qz == quantize and s2d == stem_s2d:
                 continue                       # already the headline config
+            label = {"param_dtype": pd, "quantize": qz, "stem_s2d": s2d}
             if time.perf_counter() - t_start > budget_s * 0.85:
-                dtype_points.append({"param_dtype": pd, "quantize": qz,
-                                     "skipped": "time budget"})
+                dtype_points.append(dict(label, skipped="time budget"))
                 continue
             try:
                 eng = InferenceEngine(
-                    EngineConfig(batch_size=bs, param_dtype=pd, quantize=qz),
+                    EngineConfig(batch_size=bs, param_dtype=pd, quantize=qz,
+                                 stem_s2d=s2d),
                     mesh=mesh, pretrained=False)
                 t0 = time.perf_counter()
                 eng.infer_staged(BENCH_MODEL, staged, k * bs)   # compile
@@ -492,15 +506,15 @@ def run_bench(devices) -> None:
                     eng.infer_staged(BENCH_MODEL, staged, k * bs)
                     pts.append(time.perf_counter() - t0)
                 pips = (k * bs) / float(np.median(pts))
-                row = {"param_dtype": pd, "quantize": qz,
-                       "batch_size": bs, "images_per_s": round(pips, 1),
-                       "compile_s": round(c_s, 2)}
+                row = dict(label, batch_size=bs,
+                           images_per_s=round(pips, 1),
+                           compile_s=round(c_s, 2))
                 if peak:
                     row["mfu"] = round(pips * flops_img / peak, 4)
                 dtype_points.append(row)
             except Exception as e:  # noqa: BLE001 - comparison point only
-                dtype_points.append({"param_dtype": pd, "quantize": qz,
-                                     "error": f"{type(e).__name__}: {e}"})
+                dtype_points.append(dict(
+                    label, error=f"{type(e).__name__}: {e}"))
 
     # end-to-end on the WORKER path: InferenceEngine.infer — prefetch
     # pipeline over MULTIPLE device-batch chunks so host decode (synthetic)
@@ -577,7 +591,7 @@ def run_bench(devices) -> None:
          flops_per_image=round(flops_img / 1e9, 3),
          best_batch_size=best["batch_size"], sweep=sweep_out,
          n_images=n_images, iters=iters, scan_tile=scan_tile,
-         param_dtype=param_dtype, quantize=quantize,
+         param_dtype=param_dtype, quantize=quantize, stem_s2d=stem_s2d,
          dtype_points=dtype_points,
          h2d_transfer_s=round(transfer_s, 2),
          p50_query_latency_s_400imgs=round(400 / ips, 4),

@@ -137,6 +137,10 @@ class EngineConfig:
     # resident model weights (ops/quantize.py) — halves/quarters weight HBM;
     # dequant happens inside the jitted forward
     quantize: str = "none"
+    # ResNet stem as a space-to-depth 4x4/s1 conv (models/resnet.py
+    # _S2DStem): same parameters and outputs, better MXU shape for the
+    # 3-channel stride-2 stem; opt-in until measured on hardware
+    stem_s2d: bool = False
     # models to load + compile in the background at node start, so the first
     # query doesn't pay the (remote) compile — the reference instead paid a
     # model download+load on EVERY task (`alexnet_resnet.py:17-22`) and its

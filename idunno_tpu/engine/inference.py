@@ -112,9 +112,19 @@ class InferenceEngine:
     def _load_locked(self, name: str) -> None:
         if name in self._models:
             return
-        module = create_model(name,
-                              dtype=jnp.dtype(self.config.compute_dtype),
-                              param_dtype=jnp.dtype(self.config.param_dtype))
+        dtypes = dict(dtype=jnp.dtype(self.config.compute_dtype),
+                      param_dtype=jnp.dtype(self.config.param_dtype))
+        if self.config.stem_s2d:
+            # stem recast (same params/outputs, models/resnet.py _S2DStem);
+            # capability-gated on the model itself: families without the
+            # field (alexnet, vit, registry extensions) reject the kwarg
+            # and get the plain build
+            try:
+                module = create_model(name, stem_s2d=True, **dtypes)
+            except TypeError:
+                module = create_model(name, **dtypes)
+        else:
+            module = create_model(name, **dtypes)
         variables, provenance = None, "random"
         if self.pretrained and self.store is not None:
             variables = self._try_load_from_store(name, module)

@@ -18,9 +18,52 @@ from collections.abc import Callable, Sequence
 from functools import partial
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 ModuleDef = Callable[..., nn.Module]
+
+
+class _S2DStem(nn.Module):
+    """Space-to-depth stem: the 7x7/stride-2 conv on [H, W, 3] recast as a
+    4x4/stride-1 conv on the 2x2-space-to-depth input [H/2, W/2, 12] — the
+    standard MLPerf-ResNet TPU trick. The 3-channel stride-2 stem is the
+    worst-shaped conv in the network for the 128x128 MXU; the recast form
+    contracts 4*4*12=192 instead of 7*7*3=147 per tap with no stride.
+
+    The PARAMETER is still the torchvision-shaped (7, 7, C, F) kernel under
+    the same ``stem_conv/kernel`` path — converters, checkpoints and parity
+    tests see an identical tree — and the recast runs at apply time:
+    zero-pad 7->8 with one LEADING row/column (tap index a = 2m + dy - 1,
+    so a = -1, never a = 7, is the empty slot), then fold each 2x2 spatial
+    block into channels. Output matches the 7x7 form exactly (same taps,
+    same zero padding, reassociated summation only)."""
+
+    features: int
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        if h % 2 or w % 2:
+            raise ValueError(f"space-to-depth stem needs even spatial dims, "
+                             f"got {h}x{w}")
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (7, 7, c, self.features), self.param_dtype)
+        # taps: out(i,j) reads u = 2i + a - 3 = 2(i - 2 + m) + dy
+        #   => a = 2m + dy - 1, m in 0..3, dy in {0,1}
+        kp = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))  # [8,8,C,F]
+        kp = kp.reshape(4, 2, 4, 2, c, self.features)
+        kp = kp.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c,
+                                                    self.features)
+        xs = x.reshape(b, h // 2, 2, w // 2, 2, c)
+        xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2,
+                                                    4 * c)
+        return jax.lax.conv_general_dilated(
+            xs.astype(self.dtype), kp.astype(self.dtype),
+            window_strides=(1, 1), padding=((2, 1), (2, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 class BasicBlock(nn.Module):
@@ -93,6 +136,9 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
+    # run the stem as a space-to-depth 4x4/s1 conv (see _S2DStem) — same
+    # parameters, same outputs, better MXU shape; opt-in until measured
+    stem_s2d: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -103,8 +149,13 @@ class ResNet(nn.Module):
                        dtype=self.dtype, param_dtype=self.param_dtype)
 
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), strides=(2, 2),
-                 padding=((3, 3), (3, 3)), name="stem_conv")(x)
+        if self.stem_s2d:
+            x = _S2DStem(self.num_filters, dtype=self.dtype,
+                         param_dtype=self.param_dtype,
+                         name="stem_conv")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), strides=(2, 2),
+                     padding=((3, 3), (3, 3)), name="stem_conv")(x)
         x = norm(name="stem_norm")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

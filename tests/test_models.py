@@ -148,3 +148,28 @@ def test_resnet50_shapes_and_param_count():
     logits = model.apply(variables, jnp.zeros((2, 64, 64, 3), jnp.float32),
                          train=False)
     assert logits.shape == (2, 1000)
+
+
+def test_s2d_stem_exact_vs_conv7_stem():
+    """The space-to-depth stem is a pure recast of the 7x7/s2 stem: SAME
+    parameter tree (stem_conv/kernel [7,7,3,64]), same outputs up to
+    summation reassociation. Odd image sizes are rejected."""
+    from idunno_tpu.models.resnet import resnet18
+
+    base = resnet18(dtype=jnp.float32, param_dtype=jnp.float32)
+    s2d = resnet18(dtype=jnp.float32, param_dtype=jnp.float32,
+                   stem_s2d=True)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64, 3),
+                          jnp.float32)
+    variables = base.init(jax.random.PRNGKey(0), x, train=False)
+    v2 = s2d.init(jax.random.PRNGKey(0), x, train=False)
+    assert (jax.tree.structure(variables["params"])
+            == jax.tree.structure(v2["params"]))
+    assert (variables["params"]["stem_conv"]["kernel"].shape
+            == v2["params"]["stem_conv"]["kernel"].shape == (7, 7, 3, 64))
+    out_base = base.apply(variables, x, train=False)
+    out_s2d = s2d.apply(variables, x, train=False)   # SAME weights
+    np.testing.assert_allclose(np.asarray(out_base), np.asarray(out_s2d),
+                               rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError, match="even spatial"):
+        s2d.apply(variables, jnp.zeros((1, 63, 63, 3)), train=False)
