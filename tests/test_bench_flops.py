@@ -97,3 +97,34 @@ def test_roofline_geometry_matches_bench_flops():
     alex = roof.analyze(roof.alexnet_layers(), batch=1)
     assert alex["total_flops"] == alexnet_forward_flops(224), \
         alex["total_flops"]
+
+
+def test_vit_flops_hand_computed():
+    # models/vit.py ViT-S/16 at 224x224: n = 196 patches + cls -> T = 197,
+    # dim 384, depth 12, mlp 4x. Per layer: qkv 6Td^2 + proj 2Td^2 +
+    # mlp 16Td^2 = 24Td^2, attention scores+apply 4T^2d.
+    from bench import vit_forward_flops
+
+    t, d = 197, 384
+    expected = (
+        2 * 196 * (16 * 16 * 3) * d           # patch embed
+        + 12 * (24 * t * d * d + 4 * t * t * d)
+        + 2 * d * 1000                        # head on the cls token
+    )
+    assert vit_forward_flops(224) == expected
+    # literature cross-check: ViT-S/16 ~ 9.2 GF (4.6 GMACs)
+    assert 9.0e9 < expected < 9.4e9
+
+
+def test_model_dispatch_never_borrows_flops():
+    import pytest
+
+    from bench import vit_forward_flops
+
+    assert model_forward_flops("vit") == vit_forward_flops(224)
+    assert model_forward_flops("vit_tiny") == vit_forward_flops(
+        224, dim=192, depth=4)
+    with pytest.raises(ValueError, match="no analytic FLOPs"):
+        model_forward_flops("some_custom_model")
+    with pytest.raises(ValueError, match="resnet34"):
+        model_forward_flops("resnet34")
