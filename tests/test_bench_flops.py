@@ -128,3 +128,21 @@ def test_model_dispatch_never_borrows_flops():
         model_forward_flops("some_custom_model")
     with pytest.raises(ValueError, match="resnet34"):
         model_forward_flops("resnet34")
+
+
+def test_vit_flops_params_match_model_definitions():
+    """The dispatch hard-codes vit/vit_tiny hyperparameters; pin them to
+    the ACTUAL flax module definitions so a model edit can't silently
+    leave the MFU denominator computing another architecture (the round-3
+    weak-#2 bug class, ViT edition)."""
+    from idunno_tpu.models.vit import ViT, vit_s16, vit_tiny
+
+    s = vit_s16()
+    assert (s.patch, s.dim, s.depth) == (16, 384, 12)
+    t = vit_tiny()
+    assert (t.patch, t.dim, t.depth) == (16, 192, 4)
+    assert ViT.num_classes == 1000 or ViT().num_classes == 1000
+    # Block's MLP is the standard 4x (transformer.py); the formula's
+    # mlp_ratio=4 default matches it
+    from idunno_tpu.models.transformer import Block
+    assert Block(dim=8, num_heads=1, causal=False).mlp_ratio == 4
