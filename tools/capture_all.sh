@@ -41,8 +41,9 @@ if probe; then
   echo "rc=$? two_model_fairshare" | tee -a "$LOG"
 fi
 
-step "resnet50 record" 700 BENCH_MODEL=resnet50 BENCH_TIME_BUDGET_S=600
-step "alexnet record" 700 BENCH_MODEL=alexnet BENCH_TIME_BUDGET_S=600
+step "resnet50 record" 700 BENCH_MODEL=resnet50 BENCH_TIME_BUDGET_S=600 BENCH_LM=0
+step "alexnet record" 700 BENCH_MODEL=alexnet BENCH_TIME_BUDGET_S=600 BENCH_LM=0
+step "vit record" 700 BENCH_MODEL=vit BENCH_TIME_BUDGET_S=600 BENCH_LM=0
 step "traced resnet18 (roofline evidence)" 500 \
   BENCH_TRACE=1 BENCH_SWEEP=1024 BENCH_ITERS=2 BENCH_LM=0 \
   BENCH_TIME_BUDGET_S=400
