@@ -168,11 +168,22 @@ class FairScheduler:
         return self.book.stragglers(self.clock(),
                                     self.config.straggler_timeout_s)
 
-    def redispatch_straggler(self, task: Task, alive: list[str]) -> Task:
+    def redispatch_straggler(self, task: Task, alive: list[str],
+                             expected_worker: str | None = None,
+                             expected_stamp: float | None = None
+                             ) -> Task | None:
         """Move a stuck task to a different alive worker (reference
         `monitor_inference_work` re-sends to the same worker, `:809-830`;
         moving is strictly better when the worker is wedged). These moves —
-        and only these — count against the task's retry cap."""
+        and only these — count against the task's retry cap. With an
+        expected (worker, stamp) snapshot the move is currency-checked
+        (TaskBook.reassign_if_current) and returns None when another
+        thread re-booked the task first."""
         others = [h for h in alive if h != task.worker] or alive
-        return self.book.reassign(task, self.rng.choice(others),
-                                  self.clock(), count_retry=True)
+        target = self.rng.choice(others)
+        if expected_worker is None:
+            return self.book.reassign(task, target, self.clock(),
+                                      count_retry=True)
+        return self.book.reassign_if_current(
+            task, expected_worker, expected_stamp, target, self.clock(),
+            count_retry=True)

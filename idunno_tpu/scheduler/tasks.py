@@ -96,6 +96,32 @@ class TaskBook:
                 task.retries += 1
             return task
 
+    def assignment(self, task: Task) -> tuple[str, float, str]:
+        """Atomic (worker, t_assigned, state) snapshot. Reading the two
+        fields without the lock can tear against a concurrent `reassign`
+        (new worker with the old stamp), which would stamp a JOB message
+        no error report could ever match."""
+        with self._lock:
+            return task.worker, task.t_assigned, task.state
+
+    def reassign_if_current(self, task: Task, expected_worker: str,
+                            expected_stamp: float, new_worker: str,
+                            now: float,
+                            count_retry: bool = False) -> Task | None:
+        """`reassign`, but only if the caller's view of the assignment is
+        still the booked one. Dispatch retry loops run on several threads
+        (member-change reassignment, straggler monitor, error reports) and
+        share Task objects; a loop whose snapshot went stale must DROP its
+        claim — the thread that re-booked the task owns its dispatch —
+        instead of double-moving (and double-executing) it. Returns None
+        when the book has moved on (also when the task finished/failed)."""
+        with self._lock:
+            if (task.state != WORKING or task.worker != expected_worker
+                    or abs(task.t_assigned - expected_stamp) > 1e-6):
+                return None
+            return self.reassign(task, new_worker, now,
+                                 count_retry=count_retry)
+
     def mark_failed(self, task: Task, now: float) -> Task:
         """Permanently fail a task (retry cap exhausted): the query will
         never be 'done'; `query_failed` surfaces it to pollers instead of

@@ -32,7 +32,7 @@ from idunno_tpu.comm.transport import Transport, TransportError
 from idunno_tpu.config import ClusterConfig
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.scheduler.fair import FairScheduler
-from idunno_tpu.scheduler.tasks import Task
+from idunno_tpu.scheduler.tasks import Task, WORKING
 from idunno_tpu.serve.metrics import MetricsTracker
 from idunno_tpu.utils.types import MemberStatus, MessageType
 
@@ -250,30 +250,46 @@ class InferenceService:
         return self.membership.members.alive_hosts()
 
     def _dispatch(self, task: Task) -> None:
-        msg = Message(MessageType.JOB, self.host,
-                      {"model": task.model, "qnum": task.qnum,
-                       "start": task.start, "end": task.end,
-                       "dataset": task.dataset,
-                       "assigned": task.t_assigned})
         # On send failure, reassign on the spot rather than waiting for the
         # failure detector — with a cumulative exclusion set so several
         # simultaneously-dead workers can't ping-pong the dispatch forever.
         tried: set[str] = set()
         while True:
-            if task.worker == self.host:
+            # snapshot the assignment this attempt is for (atomic — a torn
+            # read could pair the new worker with the old stamp), and
+            # rebuild the message per attempt: the echoed ``assigned``
+            # stamp must match the CURRENT booking or the worker's error
+            # report about it would be dropped as stale
+            worker, stamp, state = self.scheduler.book.assignment(task)
+            if state != WORKING:
+                return          # finished/failed while queued for dispatch
+            msg = Message(MessageType.JOB, self.host,
+                          {"model": task.model, "qnum": task.qnum,
+                           "start": task.start, "end": task.end,
+                           "dataset": task.dataset,
+                           "assigned": stamp})
+            if worker == self.host:
                 self._handle_inference(SERVICE, msg)
                 return
             try:
-                self.transport.call(task.worker, SERVICE, msg, timeout=30.0)
+                self.transport.call(worker, SERVICE, msg, timeout=30.0)
                 return
             except TransportError:
-                tried.add(task.worker)
+                tried.add(worker)
                 alive = [h for h in self._eligible_workers()
                          if h not in tried]
                 if not alive:
                     return    # straggler monitor will retry later
-                task = self.scheduler.book.reassign(
-                    task, self.scheduler.rng.choice(alive), self.clock())
+                moved = self.scheduler.book.reassign_if_current(
+                    task, worker, stamp,
+                    self.scheduler.rng.choice(alive), self.clock())
+                if moved is None:
+                    # another thread re-booked (second death, straggler
+                    # pass, error report) while this send was in flight;
+                    # that thread owns the dispatch now — dropping here
+                    # prevents double-moves and double-execution
+                    return
+                task = moved
 
     def _handle_result(self, service: str, msg: Message) -> Message | None:
         """Acting master accumulates results + metrics (`:623-704`);
@@ -304,8 +320,11 @@ class InferenceService:
             # (master-local; a failover resets it, costing at most one
             # grace period)
             self._task_errors[model] = self._task_errors.get(model, 0) + 1
+            # the report is about THIS (sender, stamp) assignment — the
+            # snapshot keeps a concurrent re-booking from being moved twice
             self._redispatch_or_fail(
-                task, f"engine error on {msg.sender}: {p['error']}")
+                task, f"engine error on {msg.sender}: {p['error']}",
+                snapshot=(msg.sender, assigned))
             return Message(MessageType.ACK, self.host)
         task = self.scheduler.book.mark_finished(model, qnum, start, end,
                                                  self.clock())
@@ -338,8 +357,53 @@ class InferenceService:
         if new is not MemberStatus.LEAVE or not self.membership.is_acting_master:
             return
         alive = self._eligible_workers()
-        for task in self.scheduler.reassign_failed(host, alive):
-            self._dispatch(task)
+        # book mutation is synchronous (tasks re-booked before returning);
+        # only the network sends go off-thread: this callback runs on the
+        # membership monitor loop, and a dispatch to a PARTITIONED
+        # successor blocks on the full RPC timeout — failure detection for
+        # other hosts must not stall behind it (same discipline as
+        # lm_manager._on_member_change). The stale-snapshot guards in
+        # _dispatch/_redispatch_or_fail keep the now-concurrent paths from
+        # double-moving shared tasks.
+        tasks = self.scheduler.reassign_failed(host, alive)
+        if not tasks:
+            return
+
+        def _safe_dispatch(t: Task) -> None:
+            try:
+                self._dispatch(t)
+            except Exception:  # noqa: BLE001 - a failed send must not
+                # abandon the task silently; the straggler monitor retries
+                import logging
+                logging.getLogger("idunno.serving").warning(
+                    "reassignment dispatch of %s#%s [%s, %s] failed",
+                    t.model, t.qnum, t.start, t.end, exc_info=True)
+
+        # one thread per task: a partitioned successor costs ITS task the
+        # RPC timeout, not every later task's dispatch latency too. The
+        # threads are tracked so tests (and shutdown paths) can join them
+        # — the InProc transport's determinism contract is preserved via
+        # `join_reassign_dispatch`, not by blocking the monitor loop here.
+        for t in tasks:
+            th = threading.Thread(target=_safe_dispatch, args=(t,),
+                                  daemon=True,
+                                  name=f"{self.host}-reassign")
+            with self._jobs_lock:
+                self._reassign_threads = [
+                    x for x in getattr(self, "_reassign_threads", [])
+                    if x.is_alive()] + [th]
+            th.start()
+
+    def join_reassign_dispatch(self, timeout: float = 5.0) -> None:
+        """Wait for in-flight member-change re-dispatch sends (they run on
+        background threads so a partitioned successor can't stall the
+        membership monitor loop). Deterministic tests call this between
+        `monitor_once` and their job pump."""
+        with self._jobs_lock:
+            threads = list(getattr(self, "_reassign_threads", ()))
+        deadline = time.monotonic() + timeout
+        for th in threads:
+            th.join(timeout=max(0.0, deadline - time.monotonic()))
 
     # a model with NO completed task cluster-wide yet is probably
     # compiling on every worker at once (first TPU compile of a shape is
@@ -365,25 +429,49 @@ class InferenceService:
         moved = 0
         now = self.clock()
         for task in self.scheduler.stragglers():
+            # atomic snapshot of the assignment this suspicion is about;
+            # _redispatch_or_fail drops the move if the book moved on
+            worker, stamp, state = self.scheduler.book.assignment(task)
+            if state != WORKING:
+                continue
             # cumulative counters, not the windowed average: a warm model
             # idle past the metrics window must NOT regain compile grace,
             # and a model with reported engine FAILURES isn't compiling
             if (task.moves == 0 and task.retries == 0
                     and self.metrics.finished_images(task.model) == 0
                     and not self._task_errors.get(task.model)
-                    and now - task.t_assigned <= self.first_compile_grace_s):
+                    and now - stamp <= self.first_compile_grace_s):
                 continue      # cold model, every worker compiling: wait
-            if self._redispatch_or_fail(task, "straggler", alive=alive):
+            if self._redispatch_or_fail(task, "straggler",
+                                        snapshot=(worker, stamp),
+                                        alive=alive):
                 moved += 1
         return moved
 
     def _redispatch_or_fail(self, task: Task, why: str,
+                            snapshot: tuple[str, float],
                             alive: list[str] | None = None) -> bool:
         """Shared failure semantics for the straggler monitor and worker
         error reports: move the task (consuming its retry budget) or,
         past ``max_task_retries``, mark it permanently FAILED. Returns
-        True when the task moved."""
+        True when the task moved. ``snapshot`` is the (worker, stamp)
+        assignment the caller's suspicion is ABOUT — required, captured
+        where the suspicion arose, so the check spans the caller's whole
+        decision window — if the book has moved the task since
+        (concurrent member-change reassignment or a racing report), the
+        suspicion is stale and the move is dropped: the re-booking thread
+        owns the dispatch, and a double move would burn the retry budget
+        twice and execute the task on two workers."""
+        exp_worker, exp_stamp = snapshot
+        cur_worker, cur_stamp, cur_state = \
+            self.scheduler.book.assignment(task)
+        if (cur_state != WORKING or cur_worker != exp_worker
+                or abs(cur_stamp - exp_stamp) > 1e-6):
+            return False
         if task.retries >= self.config.max_task_retries:
+            # (a move between the check above and here would mislabel the
+            # moved task FAILED — the window is lock-free microseconds,
+            # vs. the RPC-length window the snapshot check closes)
             self.scheduler.book.mark_failed(task, self.clock())
             import logging
             logging.getLogger("idunno.serving").error(
@@ -391,9 +479,12 @@ class InferenceService:
                 "(last worker %s; %s)", task.model, task.qnum, task.start,
                 task.end, task.retries, task.worker, why)
             return False
-        self._dispatch(self.scheduler.redispatch_straggler(
-            task, alive if alive is not None
-            else self._eligible_workers()))
+        moved = self.scheduler.redispatch_straggler(
+            task, alive if alive is not None else self._eligible_workers(),
+            expected_worker=exp_worker, expected_stamp=exp_stamp)
+        if moved is None:
+            return False              # re-booked mid-decision: not ours
+        self._dispatch(moved)
         return True
 
     # ------------------------------------------------------------------ #

@@ -91,6 +91,7 @@ def test_real_engine_survives_worker_death(real_cluster):
             services[h].process_jobs_once()
     pump(members, clock, waves=8, dt=0.3)
     members["n0"].monitor_once()
+    master.join_reassign_dispatch()       # sends run on background threads
     run_jobs({h: s for h, s in services.items() if h != victim})
     assert master.query_done("alexnet", qnum)
     assert {r[0] for r in master.results("alexnet", qnum)} == \

@@ -100,6 +100,7 @@ def test_worker_result_falls_back_to_standby(cluster):
     net.kill("n0")
     pump(members, clock, waves=8, dt=0.3)
     members["n1"].monitor_once()
+    services["n1"].join_reassign_dispatch()   # background dispatch threads
     # workers execute; their RESULT send fails over master→standby
     run_jobs({h: s for h, s in services.items() if h != "n0"})
     assert services["n1"].query_done("resnet", qnum)

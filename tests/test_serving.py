@@ -124,6 +124,7 @@ def test_worker_failure_reassigns_and_completes(cluster):
             services[h].process_jobs_once()
     pump(members, clock, waves=8, dt=0.3)
     members["n0"].monitor_once()          # detect + reassign + re-dispatch
+    master.join_reassign_dispatch()       # sends run on background threads
     run_jobs({h: s for h, s in services.items() if h != victim})
     assert master.query_done("resnet", qnum)
     assert {r[0] for r in master.results("resnet", qnum)} == \
@@ -238,6 +239,7 @@ def test_redispatch_preserves_dataset(cluster):
     net.kill(victim)
     pump(members, clock, waves=8, dt=0.3)
     members["n0"].monitor_once()
+    master.join_reassign_dispatch()       # sends run on background threads
     # reassigned tasks keep the original dataset
     assert all(t.dataset == "/data/real-images"
                for t in master.scheduler.book.in_flight())
@@ -441,3 +443,60 @@ def test_engine_failure_redispatches_immediately(cluster):
         expected_names(0, 99)
     if had_victim_task:
         assert master._task_errors.get("resnet", 0) >= 1
+
+
+def test_dispatch_drops_claim_when_book_moved_on(cluster):
+    """Dispatch retry loops on several threads share Task objects (member-
+    change reassignment, straggler monitor, error reports). A loop whose
+    send failed must re-check the booking before reassigning: if another
+    path re-booked the task while the send was in flight, the stale loop
+    drops its claim instead of double-moving (and double-executing) the
+    task. Driven deterministically: the transport hook re-books the task
+    mid-send, then raises the transport failure."""
+    cfg, net, clock, members, services, engines = cluster
+    master = services["n0"]
+    book = master.scheduler.book
+
+    # a task booked on n2; the dispatch loop will try to send it there
+    from idunno_tpu.scheduler.tasks import Task
+    task = Task(model="resnet", qnum=1, worker="n2", start=0, end=9,
+                t_assigned=clock())
+    book.record([task])
+
+    calls = []
+    real_call = master.transport.call
+
+    def failing_call(host, service, msg, timeout=30.0):
+        if service == "inference" and host == "n2":
+            # another thread re-books the task while this send is in
+            # flight, then the send fails
+            book.reassign(task, "n3", clock())
+            from idunno_tpu.comm.transport import TransportError
+            raise TransportError("n2 gone")
+        calls.append((host, service))
+        return real_call(host, service, msg, timeout=timeout)
+
+    master.transport.call = failing_call
+    master._dispatch(task)
+    # the loop detected the concurrent re-booking and dropped its claim:
+    # exactly ONE move (the hook's), no second dispatch anywhere
+    assert task.worker == "n3" and task.moves == 1
+    assert not [c for c in calls if c[1] == "inference"]
+
+
+def test_reassign_if_current_rejects_stale_snapshots(cluster):
+    cfg, net, clock, members, services, engines = cluster
+    book = services["n0"].scheduler.book
+    from idunno_tpu.scheduler.tasks import Task
+    t = Task(model="m", qnum=1, worker="a", start=0, end=1,
+             t_assigned=100.0)
+    book.record([t])
+    # current snapshot moves it
+    assert book.reassign_if_current(t, "a", 100.0, "b", 101.0) is t
+    assert t.worker == "b" and t.moves == 1
+    # stale snapshot (old worker/stamp) is refused
+    assert book.reassign_if_current(t, "a", 100.0, "c", 102.0) is None
+    assert t.worker == "b" and t.moves == 1
+    # finished tasks are refused too
+    book.mark_finished("m", 1, 0, 1, 103.0)
+    assert book.reassign_if_current(t, "b", 101.0, "c", 104.0) is None
