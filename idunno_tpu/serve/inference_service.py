@@ -99,6 +99,8 @@ class InferenceService:
         self._pending_results: list[Message] = []   # computed, undelivered
         self._jobs_lock = threading.RLock()
         self._jobs_available = threading.Event()
+        # background member-change re-dispatch sends (join_reassign_dispatch)
+        self._reassign_threads: list[threading.Thread] = []
 
         transport.serve(SERVICE, self._handle_inference)
         transport.serve(RESULT_SERVICE, self._handle_result)
@@ -388,11 +390,11 @@ class InferenceService:
             th = threading.Thread(target=_safe_dispatch, args=(t,),
                                   daemon=True,
                                   name=f"{self.host}-reassign")
+            # start before recording: joining an unstarted thread raises
+            th.start()
             with self._jobs_lock:
                 self._reassign_threads = [
-                    x for x in getattr(self, "_reassign_threads", [])
-                    if x.is_alive()] + [th]
-            th.start()
+                    x for x in self._reassign_threads if x.is_alive()] + [th]
 
     def join_reassign_dispatch(self, timeout: float = 5.0) -> None:
         """Wait for in-flight member-change re-dispatch sends (they run on
@@ -400,7 +402,7 @@ class InferenceService:
         membership monitor loop). Deterministic tests call this between
         `monitor_once` and their job pump."""
         with self._jobs_lock:
-            threads = list(getattr(self, "_reassign_threads", ()))
+            threads = list(self._reassign_threads)
         deadline = time.monotonic() + timeout
         for th in threads:
             th.join(timeout=max(0.0, deadline - time.monotonic()))

@@ -151,6 +151,11 @@ class FileStoreService:
         self._meta_lock = threading.RLock()
         self._versions: dict[str, int] = {}
         self._locations: dict[str, set[str]] = {}
+        # serializes death-event repairs (rebuild + re-replication) so two
+        # quick successive deaths don't interleave their copy passes; the
+        # repairs themselves run OFF the membership monitor loop
+        self._repair_serial = threading.Lock()
+        self._repair_threads: list[threading.Thread] = []
         transport.serve(SERVICE, self._handle)
         membership.on_change(self._on_member_change)
 
@@ -431,12 +436,45 @@ class FileStoreService:
             return
         if not self.membership.is_acting_master:
             return
+
+        # fresh_master is decided HERE, synchronously: a client put that
+        # lands on the new master before the repair thread runs would
+        # populate _versions and suppress the rebuild — permanently losing
+        # every pre-failover file's metadata (and its re-replication)
         with self._meta_lock:
             fresh_master = not self._versions
-        if fresh_master:
-            # we may have just become master with empty metadata — rebuild
-            self.rebuild_metadata()
-        self._rereplicate_after_loss(host)
+
+        # repair OFF the monitor loop: the metadata rebuild RPCs every
+        # alive host (10 s timeouts) and re-replication streams whole
+        # files (30 s timeouts per copy) — failure detection for other
+        # hosts must not stall behind either (same discipline as
+        # lm_manager/inference_service member-change handling). Repairs
+        # for successive deaths serialize on _repair_serial.
+        def _repair() -> None:
+            with self._repair_serial:
+                if fresh_master:
+                    # just became master with empty metadata — rebuild
+                    self.rebuild_metadata()
+                self._rereplicate_after_loss(host)
+
+        th = threading.Thread(target=_repair, daemon=True,
+                              name=f"{self.host}-sdfs-repair")
+        # start before recording: joining an unstarted thread raises
+        th.start()
+        with self._meta_lock:
+            self._repair_threads = [t for t in self._repair_threads
+                                    if t.is_alive()] + [th]
+
+    def join_repair(self, timeout: float = 10.0) -> None:
+        """Wait for in-flight death-event repairs (they run on background
+        threads so file streaming can't stall the membership monitor
+        loop). Deterministic tests call this after `monitor_once`."""
+        import time as _time
+        with self._meta_lock:
+            threads = list(self._repair_threads)
+        deadline = _time.monotonic() + timeout
+        for th in threads:
+            th.join(timeout=max(0.0, deadline - _time.monotonic()))
 
     def rebuild_metadata(self) -> None:
         """New acting master: reconstruct versions/locations by querying

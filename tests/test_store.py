@@ -108,6 +108,7 @@ def test_rereplication_after_holder_death(cluster):
     net.kill(victim)
     pump(members, clock, waves=8, dt=0.3)
     members["n0"].monitor_once()        # detects death, triggers re-replication
+    stores["n0"].join_repair()          # repair runs on a background thread
     new_holders = set(stores[observer].ls("precious.txt"))
     assert victim not in new_holders
     alive_holders = {h for h in new_holders
@@ -124,6 +125,7 @@ def test_master_failover_preserves_files(cluster):
     pump(members, clock, waves=8, dt=0.3)
     members["n1"].monitor_once()        # standby notices, takes over
     assert members["n1"].is_acting_master
+    stores["n1"].join_repair()          # rebuild runs on a background thread
     pump(members, clock, waves=2)
     # new master rebuilt metadata from inventories; reads still work
     blob, v = stores["n3"].get_bytes("survivor.txt")
@@ -140,6 +142,7 @@ def test_sanitized_name_survives_failover(cluster):
     net.kill("n0")
     pump(members, clock, waves=8, dt=0.3)
     members["n1"].monitor_once()
+    stores["n1"].join_repair()        # rebuild runs on a background thread
     pump(members, clock, waves=2)
     blob, v = stores["n3"].get_bytes("models/resnet.ckpt")
     assert blob == b"ckpt-bytes" and v == 1
@@ -160,6 +163,7 @@ def test_delete_not_resurrected_by_partitioned_holder(cluster):
     net.kill("n0")
     pump(members, clock, waves=8, dt=0.3)
     members["n1"].monitor_once()
+    stores["n1"].join_repair()        # rebuild runs on a background thread
     pump(members, clock, waves=2)
     with pytest.raises(StoreError):
         stores["n3"].get_bytes("zombie.txt")
