@@ -414,6 +414,12 @@ def run_bench(devices) -> None:
     # that actually ran (other families have no 7x7/s2 stem to fold).
     stem_s2d = (os.environ.get("BENCH_STEM_S2D", "0") == "1"
                 and BENCH_MODEL.startswith("resnet"))
+    # uint8→bf16 preprocess path: "auto" resolves to the Pallas kernel on
+    # TPU. The 2026-07-31 bs256 trace showed XLA inserting ~38 ms/step of
+    # slice/reshape/layout-copy around the kernel's custom-call boundary
+    # (~15% of device time) while the kernel itself costs 4.4 ms — so the
+    # alternate path is captured as a comparison point below.
+    bench_pp = os.environ.get("BENCH_PREPROCESS", "auto")
     platform = devices[0].platform
     device_kind = getattr(devices[0], "device_kind", platform)
 
@@ -473,7 +479,8 @@ def run_bench(devices) -> None:
             continue
         engine = InferenceEngine(
             EngineConfig(batch_size=bs, param_dtype=param_dtype,
-                         quantize=quantize, stem_s2d=stem_s2d),
+                         quantize=quantize, stem_s2d=stem_s2d,
+                         preprocess=bench_pp),
             mesh=mesh, pretrained=False)
         staged, k = staged_for(bs)
         t0 = time.perf_counter()
@@ -517,23 +524,34 @@ def run_bench(devices) -> None:
     if platform == "tpu":
         bs = best["batch_size"]
         staged, k = staged_for(bs)
-        variants = [("float32", "none", stem_s2d),
-                    ("bfloat16", "int8", stem_s2d)]
+        # what the sweep's "auto" actually ran, so the alternate-preprocess
+        # point below measures the path the headline did NOT take
+        sweep_pp = ("pallas" if engine is not None and engine._pallas_ok
+                    else "xla")
+        variants = [("float32", "none", stem_s2d, bench_pp),
+                    ("bfloat16", "int8", stem_s2d, bench_pp)]
         if BENCH_MODEL.startswith("resnet"):
             # the stem recast, measured against the headline config (same
             # dtype/quantize, only the stem differs)
-            variants.append((param_dtype, quantize, not stem_s2d))
-        for pd, qz, s2d in variants:
-            if pd == param_dtype and qz == quantize and s2d == stem_s2d:
+            variants.append((param_dtype, quantize, not stem_s2d, bench_pp))
+        # pallas-vs-xla preprocess at the headline config (trace-driven:
+        # the custom-call layout boundary may cost more than the kernel
+        # saves; this point decides the default by measurement)
+        variants.append((param_dtype, quantize, stem_s2d,
+                         "xla" if sweep_pp == "pallas" else "pallas"))
+        for pd, qz, s2d, pp in variants:
+            if (pd == param_dtype and qz == quantize and s2d == stem_s2d
+                    and pp == bench_pp):
                 continue                       # already the headline config
-            label = {"param_dtype": pd, "quantize": qz, "stem_s2d": s2d}
+            label = {"param_dtype": pd, "quantize": qz, "stem_s2d": s2d,
+                     "preprocess": pp}
             if time.perf_counter() - t_start > budget_s * 0.85:
                 dtype_points.append(dict(label, skipped="time budget"))
                 continue
             try:
                 eng = InferenceEngine(
                     EngineConfig(batch_size=bs, param_dtype=pd, quantize=qz,
-                                 stem_s2d=s2d),
+                                 stem_s2d=s2d, preprocess=pp),
                     mesh=mesh, pretrained=False)
                 t0 = time.perf_counter()
                 eng.infer_staged(BENCH_MODEL, staged, k * bs)   # compile
@@ -564,7 +582,7 @@ def run_bench(devices) -> None:
     n_e2e = 4 * bs
     e2e_engine = InferenceEngine(
         EngineConfig(batch_size=bs, param_dtype=param_dtype,
-                     quantize=quantize),
+                     quantize=quantize, preprocess=bench_pp),
         mesh=mesh, pretrained=False)
     t0 = time.perf_counter()
     e2e_res = e2e_engine.infer(BENCH_MODEL, 0, n_e2e - 1)
@@ -574,9 +592,11 @@ def run_bench(devices) -> None:
     # Pallas preprocess must not have silently fallen back on TPU
     # (round-1 VERDICT weak #2: engine auto-fallback hides broken kernels).
     pallas = ("compiled" if e2e_engine._pallas_ok
-              else ("n/a (cpu)" if platform != "tpu" else "FALLBACK_TO_XLA"))
+              else ("n/a (cpu)" if platform != "tpu"
+                    else ("xla (requested)" if bench_pp == "xla"
+                          else "FALLBACK_TO_XLA")))
     error = None
-    if platform == "tpu" and not e2e_engine._pallas_ok:
+    if platform == "tpu" and not e2e_engine._pallas_ok and bench_pp != "xla":
         error = "pallas preprocess kernel failed to compile on TPU; ran XLA path"
 
     # compact LM sub-record on the same chip (round-3 VERDICT weak #3: the
@@ -630,7 +650,7 @@ def run_bench(devices) -> None:
          best_batch_size=best["batch_size"], sweep=sweep_out,
          n_images=n_images, iters=iters, scan_tile=scan_tile,
          param_dtype=param_dtype, quantize=quantize, stem_s2d=stem_s2d,
-         dtype_points=dtype_points,
+         preprocess=bench_pp, dtype_points=dtype_points,
          h2d_transfer_s=round(transfer_s, 2),
          p50_query_latency_s_400imgs=round(400 / ips, 4),
          e2e_worker_path_images_per_s=round(n_e2e / e2e_s, 1),
