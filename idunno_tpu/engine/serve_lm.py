@@ -20,7 +20,10 @@ TPU-first structure (everything static-shape, three compiled programs):
              retired rows idle harmlessly (their writes are idempotent and
              gated out). ``decode_steps>1`` fuses N tokens into one
              dispatch with a `lax.fori_loop` (fewer host round-trips; the
-             trade is admission only happens at dispatch boundaries).
+             trade is admission only happens at dispatch boundaries). On
+             a speculative pool the same knob fuses N draft+verify ROUNDS
+             per dispatch (up to N·(draft_len+1) tokens), stream-identical
+             to single-round dispatches.
 
 The reference serves nothing autoregressive at all; this is the
 beyond-parity serving tier over the same engine/model machinery
@@ -307,9 +310,12 @@ class DecodeServer:
         if decode_steps < 1:
             raise ValueError(f"decode_steps {decode_steps} must be >= 1")
         if draft is not None:
-            if decode_steps != 1:
-                raise ValueError("speculative decoding fuses its own "
-                                 "multi-token rounds; use decode_steps=1")
+            # decode_steps on a speculative pool = draft+verify ROUNDS
+            # fused into one dispatch (each round commits 1..draft_len+1
+            # tokens per row) — the same host-round-trip amortization the
+            # plain path gets, which is what lets speculation win over a
+            # high-latency link (the 2026-07-31 capture measured one-round
+            # dispatches at 0.21x plain through the ~0.4 s tunnel RTT).
             if draft_len < 1:
                 raise ValueError(f"draft_len {draft_len} must be >= 1")
             if not draft[0].causal:
@@ -420,7 +426,8 @@ class DecodeServer:
                        "tokens_generated": 0, "cancelled": 0}
 
         if self._draft_model is not None:
-            self._decode_spec = self._build_spec_round(draft_len)
+            self._decode_spec = self._build_spec_round(draft_len,
+                                                       decode_steps)
         self._decode = self._build_decode(decode_steps)
 
     @staticmethod
@@ -498,8 +505,9 @@ class DecodeServer:
             return jax.jit(run, donate_argnums=(1, 2, 3, 4, 7))
         return jax.jit(run)
 
-    def _build_spec_round(self, gamma: int):
-        """One speculative round, all rows, one compiled program:
+    def _build_spec_round(self, gamma: int, rounds: int = 1):
+        """``rounds`` speculative rounds, all rows, one compiled program —
+        each round:
 
           1. the draft runs ``gamma`` single-token steps → proposals
              (greedy for temperature-0 rows; sampled from its own
@@ -517,7 +525,16 @@ class DecodeServer:
 
         Rejected positions leave stale K/V in both caches strictly past
         the new cursors; they are overwritten when those positions are
-        genuinely ingested (the standard per-row-cursor invariant)."""
+        genuinely ingested (the standard per-row-cursor invariant).
+
+        ``rounds`` > 1 chains that round body through a `lax.fori_loop`
+        so ONE dispatch advances every row by up to rounds·(γ+1) tokens —
+        the key-split chain, per-row gating, and commit math are byte-for-
+        byte the round-at-a-time logic, so streams are identical to
+        ``rounds`` separate dispatches (exactness tests hold across any
+        ``decode_steps``). Rows that retire mid-dispatch idle harmlessly:
+        their writes land strictly past their final cursor and their
+        carried state is fully gated on ``active``."""
         dec = self._dec
         ddec = self._per_row_decode(self._draft_model, self.max_len)
 
@@ -525,98 +542,105 @@ class DecodeServer:
                 remaining, temps, top_ps, keys):
             params = dequantize_tree(params)
             dparams = dequantize_tree(dparams)
-            active = remaining > 0
             s = tokens.shape[0]
             rows = jnp.arange(s)
-            prev = jnp.take_along_axis(tokens, cursors[:, None],
-                                       axis=1)[:, 0]        # [S]
             sampled = temps > 0.0                            # [S]
-            any_nucleus = jnp.any(active & sampled & (top_ps < 1.0))
             safe_t = jnp.maximum(temps, 1e-6)[:, None]
-            # per-row subkeys: γ draft draws + γ accept uniforms +
-            # 1 residual/bonus draw + 1 carried-forward key
-            subs = jax.vmap(lambda k: jax.random.split(k, 2 * gamma + 2))(
-                keys)                                        # [S, 2γ+2, 2]
-            draft_keys = subs[:, :gamma]
-            accept_keys = subs[:, gamma:2 * gamma]
-            resid_keys = subs[:, 2 * gamma]
-            new_keys = subs[:, 2 * gamma + 1]
 
-            # -- 1. draft: gamma proposals + their full distributions ----
-            def dbody(j, carry):
-                dcache, dcur, tok, props, qdist = carry
-                dcache = _set_cursors(dcache, dcur)
-                logits, mutated = ddec.apply(
-                    {"params": dparams, "cache": dcache},
-                    tok[:, None], mutable=["cache"])
-                l = logits[:, 0].astype(jnp.float32)         # [S, V]
-                # per-row select inside the fast-path cond: a top_p = 1
-                # row's distribution is the plain softmax in BOTH
-                # branches, so no row depends on its co-residents
-                q = jax.lax.cond(
+            def round_body(carry):
+                tokens, cache, dcache, cursors, remaining, keys = carry
+                active = remaining > 0
+                prev = jnp.take_along_axis(tokens, cursors[:, None],
+                                           axis=1)[:, 0]    # [S]
+                any_nucleus = jnp.any(active & sampled & (top_ps < 1.0))
+                # per-row subkeys: γ draft draws + γ accept uniforms +
+                # 1 residual/bonus draw + 1 carried-forward key
+                subs = jax.vmap(
+                    lambda k: jax.random.split(k, 2 * gamma + 2))(
+                    keys)                                    # [S, 2γ+2, 2]
+                draft_keys = subs[:, :gamma]
+                accept_keys = subs[:, gamma:2 * gamma]
+                resid_keys = subs[:, 2 * gamma]
+                new_keys = subs[:, 2 * gamma + 1]
+
+                # -- 1. draft: gamma proposals + their full distributions ----
+                def dbody(j, carry):
+                    dcache, dcur, tok, props, qdist = carry
+                    dcache = _set_cursors(dcache, dcur)
+                    logits, mutated = ddec.apply(
+                        {"params": dparams, "cache": dcache},
+                        tok[:, None], mutable=["cache"])
+                    l = logits[:, 0].astype(jnp.float32)         # [S, V]
+                    # per-row select inside the fast-path cond: a top_p = 1
+                    # row's distribution is the plain softmax in BOTH
+                    # branches, so no row depends on its co-residents
+                    q = jax.lax.cond(
+                        any_nucleus,
+                        lambda: jnp.where(
+                            top_ps[:, None] < 1.0,
+                            nucleus_probs(l / safe_t, top_ps),
+                            jax.nn.softmax(l / safe_t, axis=-1)),
+                        lambda: jax.nn.softmax(l / safe_t, axis=-1))
+                    greedy = jnp.argmax(l, axis=-1).astype(jnp.int32)
+                    draw = jax.vmap(jax.random.categorical)(
+                        draft_keys[:, j],
+                        _safe_log(q)).astype(jnp.int32)
+                    nxt = jnp.where(sampled, draw, greedy)
+                    return (mutated["cache"], dcur + 1, nxt,
+                            props.at[:, j].set(nxt),
+                            qdist.at[:, j].set(q))
+
+                props0 = jnp.zeros((s, gamma), jnp.int32)
+                qdist0 = jnp.zeros((s, gamma, self.model.vocab), jnp.float32)
+                dcache, _, _, proposals, qdist = jax.lax.fori_loop(
+                    0, gamma, dbody, (dcache, cursors, prev, props0, qdist0))
+
+                # -- 2. target: verify the whole chunk in one apply ----------
+                cache = _set_cursors(cache, cursors)
+                tin = jnp.concatenate([prev[:, None], proposals], axis=1)
+                logits, mutated = dec.apply(
+                    {"params": params, "cache": cache}, tin, mutable=["cache"])
+                cache = mutated["cache"]
+                logits = logits.astype(jnp.float32)
+                tpred = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S,γ+1]
+                pdist = jax.lax.cond(
                     any_nucleus,
                     lambda: jnp.where(
-                        top_ps[:, None] < 1.0,
-                        nucleus_probs(l / safe_t, top_ps),
-                        jax.nn.softmax(l / safe_t, axis=-1)),
-                    lambda: jax.nn.softmax(l / safe_t, axis=-1))
-                greedy = jnp.argmax(l, axis=-1).astype(jnp.int32)
-                draw = jax.vmap(jax.random.categorical)(
-                    draft_keys[:, j],
-                    _safe_log(q)).astype(jnp.int32)
-                nxt = jnp.where(sampled, draw, greedy)
-                return (mutated["cache"], dcur + 1, nxt,
-                        props.at[:, j].set(nxt),
-                        qdist.at[:, j].set(q))
+                        top_ps[:, None, None] < 1.0,
+                        nucleus_probs(logits / safe_t[..., None],
+                                      top_ps[:, None]),
+                        jax.nn.softmax(logits / safe_t[..., None], axis=-1)),
+                    lambda: jax.nn.softmax(logits / safe_t[..., None],
+                                           axis=-1))
 
-            props0 = jnp.zeros((s, gamma), jnp.int32)
-            qdist0 = jnp.zeros((s, gamma, self.model.vocab), jnp.float32)
-            dcache, _, _, proposals, qdist = jax.lax.fori_loop(
-                0, gamma, dbody, (dcache, cursors, prev, props0, qdist0))
-
-            # -- 2. target: verify the whole chunk in one apply ----------
-            cache = _set_cursors(cache, cursors)
-            tin = jnp.concatenate([prev[:, None], proposals], axis=1)
-            logits, mutated = dec.apply(
-                {"params": params, "cache": cache}, tin, mutable=["cache"])
-            cache = mutated["cache"]
-            logits = logits.astype(jnp.float32)
-            tpred = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S,γ+1]
-            pdist = jax.lax.cond(
-                any_nucleus,
-                lambda: jnp.where(
-                    top_ps[:, None, None] < 1.0,
-                    nucleus_probs(logits / safe_t[..., None],
-                                  top_ps[:, None]),
-                    jax.nn.softmax(logits / safe_t[..., None], axis=-1)),
-                lambda: jax.nn.softmax(logits / safe_t[..., None],
-                                       axis=-1))
-
-            # -- 3. acceptance + commit (`spec_commit`) ------------------
-            u = jax.vmap(lambda ks: jax.vmap(jax.random.uniform)(ks))(
-                accept_keys)                                 # [S, γ]
-            cand, acc = spec_commit(proposals, qdist, pdist, tpred,
-                                    sampled, u, resid_keys)
-            jidx = jnp.arange(gamma + 1)[None, :]
-            commit = jnp.minimum(acc + 1, remaining)         # [S] ≥1 active
-            if self.eos_id is not None:
-                hit = (cand == self.eos_id) & (jidx < commit[:, None])
-                any_eos = hit.any(axis=1)
-                eos_pos = jnp.argmax(hit, axis=1)
-                commit = jnp.where(any_eos, eos_pos + 1, commit)
-                rem_after = jnp.where(any_eos, 0, remaining - commit)
-            else:
-                rem_after = remaining - commit
-            wpos = jnp.clip(cursors[:, None] + 1 + jidx, 0,
-                            self.max_len - 1)                # [S, γ+1]
-            old = jnp.take_along_axis(tokens, wpos, axis=1)
-            keep = (jidx < commit[:, None]) & active[:, None]
-            tokens = tokens.at[rows[:, None], wpos].set(
-                jnp.where(keep, cand, old))
-            cursors = jnp.where(active, cursors + commit, cursors)
-            remaining = jnp.where(active, rem_after, remaining)
-            keys_out = jnp.where(active[:, None], new_keys, keys)
-            return tokens, cache, dcache, cursors, remaining, keys_out
+                # -- 3. acceptance + commit (`spec_commit`) ------------------
+                u = jax.vmap(lambda ks: jax.vmap(jax.random.uniform)(ks))(
+                    accept_keys)                                 # [S, γ]
+                cand, acc = spec_commit(proposals, qdist, pdist, tpred,
+                                        sampled, u, resid_keys)
+                jidx = jnp.arange(gamma + 1)[None, :]
+                commit = jnp.minimum(acc + 1, remaining)         # [S] ≥1 active
+                if self.eos_id is not None:
+                    hit = (cand == self.eos_id) & (jidx < commit[:, None])
+                    any_eos = hit.any(axis=1)
+                    eos_pos = jnp.argmax(hit, axis=1)
+                    commit = jnp.where(any_eos, eos_pos + 1, commit)
+                    rem_after = jnp.where(any_eos, 0, remaining - commit)
+                else:
+                    rem_after = remaining - commit
+                wpos = jnp.clip(cursors[:, None] + 1 + jidx, 0,
+                                self.max_len - 1)                # [S, γ+1]
+                old = jnp.take_along_axis(tokens, wpos, axis=1)
+                keep = (jidx < commit[:, None]) & active[:, None]
+                tokens = tokens.at[rows[:, None], wpos].set(
+                    jnp.where(keep, cand, old))
+                cursors = jnp.where(active, cursors + commit, cursors)
+                remaining = jnp.where(active, rem_after, remaining)
+                keys_out = jnp.where(active[:, None], new_keys, keys)
+                return tokens, cache, dcache, cursors, remaining, keys_out
+            return jax.lax.fori_loop(
+                0, rounds, lambda _, c: round_body(c),
+                (tokens, cache, dcache, cursors, remaining, keys))
 
         if jax.devices()[0].platform == "tpu":
             return jax.jit(run, donate_argnums=(2, 3, 4, 5, 6, 9))
@@ -727,8 +751,9 @@ class DecodeServer:
         return len(self._queue) + len(self._live)
 
     def stats(self) -> dict:
-        """Serving counters: decode dispatches (``decode_steps`` tokens per
-        live row each), requests admitted/completed, generated-token total,
+        """Serving counters: decode dispatches (``decode_steps`` tokens —
+        or, speculative, that many draft+verify rounds — per live row
+        each), requests admitted/completed, generated-token total,
         current occupancy, and the pool's serving configuration (what an
         operator reading `lm_stats` needs to know the pool is actually
         running — GQA width, cache dtype, weight quantization, draft)."""
@@ -816,7 +841,8 @@ class DecodeServer:
 
     def step(self) -> int:
         """Retire finished rows, admit queued prompts into free slots, run
-        one decode dispatch (``decode_steps`` tokens for every live row).
+        one decode dispatch (``decode_steps`` tokens — or speculative
+        rounds — for every live row).
         Returns live rows + still-queued requests — 0 means drained (a
         max_new=1 admission can retire instantly, leaving 0 live rows with
         the queue non-empty, so live alone would end a client loop early)."""

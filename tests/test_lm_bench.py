@@ -9,7 +9,7 @@ import time
 import pytest
 
 from idunno_tpu.utils.lm_bench import (lm_bench_config, run_lm_bench,
-                                        spec_max_new)
+                                        spec_max_new, spec_rounds)
 
 TINY = {
     "BENCH_LM_DIM": "64", "BENCH_LM_DEPTH": "1", "BENCH_LM_HEADS": "2",
@@ -79,6 +79,11 @@ def test_default_config_phases_fit_serving_limits(platform, monkeypatch):
     # speculative rows: after the bench's clamp (same helper the phase
     # calls) the rows must still generate enough to time ≥1 full round
     assert spec_max_new(cfg) > cfg["draft_len"] + 1
+    # and the fused-round clamp (same helper the phase calls) must leave
+    # real work after the untimed warm-up dispatch: a row's remaining
+    # budget after prefill is spec_max_new-1, so a warm-up that could
+    # retire every row would zero the measurement
+    assert spec_max_new(cfg) - 1 > spec_rounds(cfg) * (cfg["draft_len"] + 1)
     # _steady_decode_tok_s times k = (max_new-1)//decode_steps - 1 ≥ 1
     # FULL dispatches after the untimed first one; anything less and the
     # max(1, ...) floor counts a partial dispatch as a full one

@@ -85,6 +85,38 @@ def test_fused_decode_steps_match(lm):
     assert a.tokens == b.tokens == expected(model, params, prompt, 10)
 
 
+def test_fused_spec_rounds_match(lm):
+    """decode_steps on a SPECULATIVE pool fuses that many draft+verify
+    rounds into one dispatch. The fused server's streams must be
+    token-identical to the round-per-dispatch server's — greedy rows,
+    seeded nucleus rows, and under a weak (rejecting) draft — while
+    issuing strictly fewer decode dispatches (the whole point: one
+    dispatch per round cannot win over a high-latency link)."""
+    model, params = lm
+    weak = TransformerLM(vocab=VOCAB, dim=16, depth=1, num_heads=2)
+    weak_params = weak.init(jax.random.PRNGKey(99),
+                            jnp.zeros((1, 4), jnp.int32))["params"]
+    prompt = [3, 1, 4]
+
+    def serve(steps, draft, draft_params):
+        srv = DecodeServer(model, params, slots=2, prompt_len=4,
+                           max_len=48, draft=(draft, draft_params),
+                           draft_len=3, decode_steps=steps)
+        rid_g = srv.submit(prompt, max_new=12)
+        rid_s = srv.submit(prompt, max_new=12, temperature=0.9,
+                           top_p=0.8, seed=7)
+        done = {c.id: c for c in srv.run_until_drained()}
+        return (done[rid_g].tokens, done[rid_s].tokens,
+                srv.stats()["dispatches"])
+
+    for draft, dparams in ((model, params), (weak, weak_params)):
+        g1, s1, d1 = serve(1, draft, dparams)
+        g3, s3, d3 = serve(3, draft, dparams)
+        assert g1 == g3 == expected(model, params, prompt, 12)
+        assert s1 == s3, "fused rounds changed a sampled stream"
+        assert d3 < d1, f"fusing 3 rounds should cut dispatches ({d3} vs {d1})"
+
+
 def test_docstring_loop_serves_all_instant_requests(lm):
     """`while srv.step():` must not exit while requests are still queued —
     a max_new=1 admission retires instantly, leaving 0 live rows with a
@@ -283,7 +315,7 @@ def test_speculative_validation(lm):
     srv.submit([1, 2], max_new=6)         # 2+6+4 = 12 fits
     with pytest.raises(ValueError, match="decode_steps"):
         DecodeServer(model, params, slots=1, prompt_len=4, max_len=16,
-                     draft=(model, params), decode_steps=2)
+                     draft=(model, params), decode_steps=0)
     bad_vocab = TransformerLM(vocab=VOCAB + 1, dim=16, depth=1,
                               num_heads=2)
     with pytest.raises(ValueError, match="vocab"):
