@@ -120,7 +120,7 @@ def _steady_decode_tok_s(srv, cfg: dict) -> tuple[float, int, float]:
     (`_retire_finished`), so per-step timing is naturally synced. Returns
     (tokens/sec, K, seconds/dispatch) — the last makes the fixed
     per-dispatch latency separable from the HBM-bound compute."""
-    for _ in range(cfg["slots"]):
+    for _ in range(srv.slots):
         srv.submit(list(range(1, cfg["prompt_len"] + 1)),
                    max_new=cfg["max_new"])
     srv.step()                       # admission + first dispatch (all live)
@@ -129,7 +129,7 @@ def _steady_decode_tok_s(srv, cfg: dict) -> tuple[float, int, float]:
     for _ in range(k):
         srv.step()
     dt = time.perf_counter() - t0
-    return cfg["slots"] * cfg["decode_steps"] * k / dt, k, dt / k
+    return srv.slots * cfg["decode_steps"] * k / dt, k, dt / k
 
 
 def run_lm_bench(platform: str, device_kind: str, n_devices: int,
@@ -233,12 +233,12 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
                                 "error": f"{type(e).__name__}: {e}"}
 
     # -- steady-state decode ----------------------------------------------
-    def measure_pool(m, p, **server_kw):
+    def measure_pool(m, p, slots=None, **server_kw):
         """Build a pool, pay its compiles on a warm-up request, then
         measure steady-state decode tokens/sec — the shared protocol for
-        the plain/int8/GQA points. Returns (tok/s, timed dispatches,
-        seconds/dispatch, compile seconds)."""
-        srv = DecodeServer(m, p, slots=cfg["slots"],
+        the plain/int8/GQA/slot-scaling points. Returns (tok/s, timed
+        dispatches, seconds/dispatch, compile seconds)."""
+        srv = DecodeServer(m, p, slots=slots or cfg["slots"],
                            prompt_len=cfg["prompt_len"],
                            max_len=cfg["max_len"],
                            decode_steps=cfg["decode_steps"], **server_kw)
@@ -339,8 +339,9 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
         except Exception as e:  # noqa: BLE001
             out["int8_decode"] = {"error": f"{type(e).__name__}: {e}"}
 
-    # GQA decode point LAST (a new phase must never eat the budget of the
-    # previously-established int8 surface): same architecture with fewer
+    # GQA decode point after int8 (a new phase must never eat the budget
+    # of a previously-established surface — later phases sacrifice first,
+    # so the newest, decode_slots_scaling, runs LAST): same arch with fewer
     # K/V heads. The cache shrinks by the group factor; the K/V
     # projections also shrink (params_* fields expose the weight-side
     # confound), so vs_mha bundles cache bandwidth + weight streaming.
@@ -369,5 +370,26 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
             }
         except Exception as e:  # noqa: BLE001
             out["gqa_decode"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # decode slot-scaling point: the base-slots decode streams weights at
+    # a fraction of HBM peak (64 of 819 GB/s, 2026-07-31 capture) — the
+    # per-step cost is op-dispatch bound, not bandwidth bound, so tok/s
+    # should rise near-linearly with slots until the weight stream
+    # saturates. 4x slots, same weight traffic per step: this point
+    # measures the serving throughput actually available at depth.
+    if not compact and time.perf_counter() < deadline:
+        try:
+            big = cfg["slots"] * 4
+            tokb, _, disp_b, _ = measure_pool(model, params, slots=big)
+            out["decode_slots_scaling"] = {
+                "slots": big,
+                "tokens_per_s": round(tokb, 1),
+                "vs_base_slots": round(tokb / tok_s, 2),
+                "dispatch_s": round(disp_b, 4),
+                "implied_weight_stream_gbps": round(
+                    param_bytes * (tokb / big) / 1e9, 1),
+            }
+        except Exception as e:  # noqa: BLE001
+            out["decode_slots_scaling"] = {"error": f"{type(e).__name__}: {e}"}
 
     return out
