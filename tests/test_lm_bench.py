@@ -48,13 +48,21 @@ def test_full_suite_record_shape(tiny_env):
     assert rec["int8_decode"]["tokens_per_s"] > 0
     assert rec["gqa_decode"]["tokens_per_s"] > 0
     assert rec["gqa_decode"]["kv_heads"] == 1
+    # slot-scaling point: 4x the base slots, sane token accounting (a
+    # config bump that makes the big pool inadmissible must fail HERE,
+    # not silently become an {"error": ...} record in a live capture)
+    assert rec["decode_slots_scaling"]["slots"] == 8
+    assert rec["decode_slots_scaling"]["tokens_per_s"] > 0
+    # tiled prefill: tokens/s must reflect tile*b*t tokens per dispatch
+    assert rec["prefill"]["scan_tile"] == 1     # cpu default
 
 
 def test_compact_skips_optional_phases(tiny_env):
     rec = run_lm_bench("cpu", "cpu", 1, None,
                        deadline=time.perf_counter() + 600, compact=True)
     assert "speculative" not in rec and "int8_decode" not in rec
-    assert "gqa_decode" not in rec
+    assert "gqa_decode" not in rec and "decode_slots_scaling" not in rec
+    assert "xla_full_attention" not in rec["prefill"]
     assert rec["decode"]["tokens_per_s"] > 0
 
 
@@ -62,6 +70,7 @@ def test_deadline_skips_optional_phases(tiny_env):
     rec = run_lm_bench("cpu", "cpu", 1, None,
                        deadline=time.perf_counter() - 1, compact=False)
     assert "speculative" not in rec and "int8_decode" not in rec
+    assert "decode_slots_scaling" not in rec
     assert rec["decode"]["tokens_per_s"] > 0
 
 
