@@ -266,8 +266,12 @@ def test_speculative_pool_over_rpc(stores):
             MessageType.INFERENCE, "client", payload))
 
     try:
+        # decode_steps=2 on a speculative pool = two fused draft+verify
+        # rounds per dispatch — the RPC surface must carry the knob and
+        # the stream must stay exact vs local generate
         out = call({"verb": "lm_serve", "name": "spec-target",
                     "draft": "spec-draft", "draft_len": 3,
+                    "decode_steps": 2,
                     "slots": 2, "prompt_len": 4, "max_len": 24})
         assert out.type is MessageType.ACK, out.payload
         prompt = [3, 9, 14]
