@@ -65,9 +65,9 @@ HELP = """\
         draft pools: greedy token-exact, sampled distribution-exact;
         place=1 = cluster-managed: master-placed, requests journaled to
         the standby, pool+requests recovered if its node dies)
-  lm-submit <name> <max_new> [temperature= top_p= seed=] <tok> [tok ...]
+  lm-submit <name> <max_new> [temperature= top_p= top_k= seed=] <tok> [tok ...]
        queue a prompt -> request id (temperature 0=greedy, >0 sampled;
-       top_p<1 = nucleus)
+       top_p<1 = nucleus, top_k>0 = k most probable first)
   lm-poll <name> | lm-stats <name> | lm-stop <name>
        fetch completions / occupancy+token counters / stop
   lm-cancel <name> <id>   best-effort cancel (live rows return partials)
@@ -433,7 +433,7 @@ class Shell:
     def cmd_lm_submit(self, args: list[str]) -> str:
         if len(args) < 3:
             return ("usage: lm-submit <name> <max_new> "
-                    "[temperature= top_p= seed=] <tok> [tok ...]")
+                    "[temperature= top_p= top_k= seed=] <tok> [tok ...]")
         kv = self._kv([a for a in args[2:] if "=" in a])
         toks = [int(t) for t in args[2:] if "=" not in t]
         payload = {}
@@ -441,6 +441,8 @@ class Shell:
             payload["temperature"] = float(kv.pop("temperature"))
         if "top_p" in kv:
             payload["top_p"] = float(kv.pop("top_p"))
+        if "top_k" in kv:
+            payload["top_k"] = int(kv.pop("top_k"))
         if "seed" in kv:
             payload["seed"] = int(kv.pop("seed"))
         if kv:

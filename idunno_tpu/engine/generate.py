@@ -44,10 +44,10 @@ def init_cache(model: TransformerLM, batch: int, max_len: int) -> Any:
 
 @partial(jax.jit,
          static_argnames=("model", "prompt_len", "max_new", "temperature",
-                          "top_p"))
+                          "top_p", "top_k"))
 def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
              prompt_len: int, max_new: int, *, temperature: float = 0.0,
-             top_p: float = 1.0,
+             top_p: float = 1.0, top_k: int = 0,
              rng: jax.Array | None = None,
              prompt_lens: jnp.ndarray | None = None) -> jnp.ndarray:
     """Generate ``max_new`` tokens after ``prompt[:, :prompt_len]``.
@@ -56,7 +56,9 @@ def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
     [B, prompt_len + max_new]. temperature 0 → greedy argmax; > 0 →
     softmax sampling (needs ``rng``); ``top_p`` < 1 restricts sampling to
     the nucleus — the smallest probability mass ≥ top_p (applied after
-    temperature).
+    temperature); ``top_k`` > 0 first restricts to the k most probable
+    tokens (standard warper order: top-k, then nucleus over the
+    renormalized top-k distribution — `ops.sampling.filtered_probs`).
 
     Ragged batches: pass ``prompt_lens`` (int [B], 1 ≤ len ≤ prompt_len)
     with right-padded prompts — each row is teacher-forced only through its
@@ -88,14 +90,15 @@ def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
         logits = logits[:, 0]                                # [B, vocab]
         if temperature > 0.0:
             scaled = logits / temperature
-            if top_p < 1.0:
-                # nucleus: mask everything outside the smallest prefix of
-                # the sorted distribution whose mass reaches top_p — the
-                # shared construction (`ops.sampling.nucleus_probs`, also
-                # the serving pool's), applied here as a -inf mask so the
+            if top_p < 1.0 or top_k > 0:
+                # top-k then nucleus: mask everything the shared filter
+                # (`ops.sampling.filtered_probs`, also the serving
+                # pool's) zeroes out, applied here as a -inf mask so the
                 # categorical draw below is unchanged
-                from idunno_tpu.ops.sampling import nucleus_probs
-                keep = nucleus_probs(scaled, jnp.full((b,), top_p)) > 0.0
+                from idunno_tpu.ops.sampling import filtered_probs
+                keep = filtered_probs(
+                    scaled, jnp.full((b,), top_p),
+                    jnp.full((b,), top_k, jnp.int32)) > 0.0
                 scaled = jnp.where(keep, scaled, -jnp.inf)
             rng, sub = jax.random.split(rng)
             nxt = jax.random.categorical(sub, scaled, axis=-1)

@@ -45,7 +45,7 @@ import numpy as np
 from idunno_tpu.engine.generate import decode_model, init_cache
 from idunno_tpu.models.transformer import TransformerLM
 from idunno_tpu.ops.quantize import dequantize_tree, quantize_tree
-from idunno_tpu.ops.sampling import nucleus_probs
+from idunno_tpu.ops.sampling import filtered_probs
 
 
 @dataclass
@@ -60,6 +60,7 @@ class Request:
     max_new: int
     temperature: float = 0.0
     top_p: float = 1.0
+    top_k: int = 0
     seed: int | None = None
     t_admit: float = 0.0       # monotonic stamp set at slot admission
 
@@ -117,38 +118,45 @@ def _safe_log(probs: jnp.ndarray) -> jnp.ndarray:
                      -jnp.inf)
 
 
-def _row_sample_logits(scaled: jnp.ndarray,
-                       top_p: jnp.ndarray) -> jnp.ndarray:
-    """Per-row sampling logits: nucleus-filtered for top_p < 1 rows,
-    plain log-softmax otherwise. The per-ROW select (not a batch-level
-    branch) keeps every row's formula a function of its own request
-    alone, so a journal replay without its former co-residents redraws
-    the SAME stream bit-for-bit."""
+def _filter_on(top_p: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
+    """Per-row: does this row ask for any sampling filter at all?"""
+    return (top_p < 1.0) | (top_k > 0)
+
+
+def _row_sample_logits(scaled: jnp.ndarray, top_p: jnp.ndarray,
+                       top_k: jnp.ndarray) -> jnp.ndarray:
+    """Per-row sampling logits: top-k/nucleus-filtered for rows that ask
+    for a filter, plain log-softmax otherwise. The per-ROW select (not a
+    batch-level branch) keeps every row's formula a function of its own
+    request alone, so a journal replay without its former co-residents
+    redraws the SAME stream bit-for-bit."""
     plain = jax.nn.log_softmax(scaled, axis=-1)
-    filtered = _safe_log(nucleus_probs(scaled, top_p))
-    return jnp.where(top_p[..., None] < 1.0, filtered, plain)
+    filtered = _safe_log(filtered_probs(scaled, top_p, top_k))
+    return jnp.where(_filter_on(top_p, top_k)[..., None], filtered, plain)
 
 
 def _next_token(logits: jnp.ndarray, temp: jnp.ndarray,
-                key: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
-    """Greedy (temp == 0) or temperature+nucleus-sampled next token;
-    shared by the prefill pick and the batched decode step (vmapped
-    there, so every array is one row's)."""
+                key: jnp.ndarray, top_p: jnp.ndarray,
+                top_k: jnp.ndarray) -> jnp.ndarray:
+    """Greedy (temp == 0) or temperature + top-k/nucleus-sampled next
+    token; shared by the prefill pick and the batched decode step
+    (vmapped there, so every array is one row's)."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temp, 1e-6)
     sampled = jax.random.categorical(
-        key, _row_sample_logits(scaled, top_p), axis=-1).astype(jnp.int32)
+        key, _row_sample_logits(scaled, top_p, top_k),
+        axis=-1).astype(jnp.int32)
     return jnp.where(temp > 0.0, sampled, greedy)
 
 
 @jax.jit
 def _pick_first(logits: jnp.ndarray, temp: jnp.ndarray,
-                key: jnp.ndarray,
-                top_p: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+                key: jnp.ndarray, top_p: jnp.ndarray,
+                top_k: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """First generated token from the prefill logits; returns (token,
     advanced key) so the decode stream continues from a fresh subkey."""
     sub, nxt_key = jax.random.split(key)
-    return _next_token(logits, temp, sub, top_p), nxt_key
+    return _next_token(logits, temp, sub, top_p, top_k), nxt_key
 
 
 def _splice_rows(cache: Any, row_cache: Any, slot: jnp.ndarray) -> Any:
@@ -404,6 +412,7 @@ class DecodeServer:
         self._remaining = zeros((slots,), jnp.int32)
         self._temps = zeros((slots,), jnp.float32)
         self._top_ps = zeros((slots,), jnp.float32) + 1.0
+        self._top_ks = zeros((slots,), jnp.int32)        # 0 = no k-filter
         self._keys = zeros((slots, 2), jnp.uint32)       # per-row rng
         self._draft_cache = None
         if self._draft_model is not None:
@@ -446,7 +455,7 @@ class DecodeServer:
         dec = self._dec
 
         def run(params, tokens, cache, cursors, remaining, temps,
-                top_ps, keys):
+                top_ps, top_ks, keys):
             params = dequantize_tree(params)   # int8 stays HBM-resident
 
             def body(_, carry):
@@ -464,15 +473,15 @@ class DecodeServer:
                 l = logits[:, 0]
                 scaled = l / jnp.maximum(temps, 1e-6)[:, None]
                 # the full-vocab sort+cumsum only runs when some live row
-                # actually asked for a nucleus; inside that branch the
-                # PER-ROW select gives top_p = 1 rows the identical plain
+                # actually asked for a filter; inside that branch the
+                # PER-ROW select gives unfiltered rows the identical plain
                 # log-softmax the other branch computes, so no row's
                 # stream ever depends on its co-residents (token-exact
                 # journal replay)
                 sample_logits = jax.lax.cond(
                     jnp.any((remaining > 0) & (temps > 0.0)
-                            & (top_ps < 1.0)),
-                    lambda: _row_sample_logits(scaled, top_ps),
+                            & _filter_on(top_ps, top_ks)),
+                    lambda: _row_sample_logits(scaled, top_ps, top_ks),
                     lambda: jax.nn.log_softmax(scaled, axis=-1))
                 drawn = jax.vmap(jax.random.categorical)(
                     split[:, 0], sample_logits).astype(jnp.int32)
@@ -500,9 +509,9 @@ class DecodeServer:
         # the KV cache is by far the largest buffer and every step returns
         # a fresh one — donation lets XLA update it in place instead of
         # copying it per dispatch. (CPU doesn't implement donation and
-        # would warn.) temps/top_ps are read-only and not donated.
+        # would warn.) temps/top_ps/top_ks are read-only and not donated.
         if jax.devices()[0].platform == "tpu":
-            return jax.jit(run, donate_argnums=(1, 2, 3, 4, 7))
+            return jax.jit(run, donate_argnums=(1, 2, 3, 4, 8))
         return jax.jit(run)
 
     def _build_spec_round(self, gamma: int, rounds: int = 1):
@@ -539,7 +548,7 @@ class DecodeServer:
         ddec = self._per_row_decode(self._draft_model, self.max_len)
 
         def run(params, dparams, tokens, cache, dcache, cursors,
-                remaining, temps, top_ps, keys):
+                remaining, temps, top_ps, top_ks, keys):
             params = dequantize_tree(params)
             dparams = dequantize_tree(dparams)
             s = tokens.shape[0]
@@ -552,7 +561,8 @@ class DecodeServer:
                 active = remaining > 0
                 prev = jnp.take_along_axis(tokens, cursors[:, None],
                                            axis=1)[:, 0]    # [S]
-                any_nucleus = jnp.any(active & sampled & (top_ps < 1.0))
+                any_filter = jnp.any(active & sampled
+                                     & _filter_on(top_ps, top_ks))
                 # per-row subkeys: γ draft draws + γ accept uniforms +
                 # 1 residual/bonus draw + 1 carried-forward key
                 subs = jax.vmap(
@@ -571,14 +581,14 @@ class DecodeServer:
                         {"params": dparams, "cache": dcache},
                         tok[:, None], mutable=["cache"])
                     l = logits[:, 0].astype(jnp.float32)         # [S, V]
-                    # per-row select inside the fast-path cond: a top_p = 1
-                    # row's distribution is the plain softmax in BOTH
-                    # branches, so no row depends on its co-residents
+                    # per-row select inside the fast-path cond: an
+                    # unfiltered row's distribution is the plain softmax
+                    # in BOTH branches, so no row depends on co-residents
                     q = jax.lax.cond(
-                        any_nucleus,
+                        any_filter,
                         lambda: jnp.where(
-                            top_ps[:, None] < 1.0,
-                            nucleus_probs(l / safe_t, top_ps),
+                            _filter_on(top_ps, top_ks)[:, None],
+                            filtered_probs(l / safe_t, top_ps, top_ks),
                             jax.nn.softmax(l / safe_t, axis=-1)),
                         lambda: jax.nn.softmax(l / safe_t, axis=-1))
                     greedy = jnp.argmax(l, axis=-1).astype(jnp.int32)
@@ -604,11 +614,11 @@ class DecodeServer:
                 logits = logits.astype(jnp.float32)
                 tpred = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S,γ+1]
                 pdist = jax.lax.cond(
-                    any_nucleus,
+                    any_filter,
                     lambda: jnp.where(
-                        top_ps[:, None, None] < 1.0,
-                        nucleus_probs(logits / safe_t[..., None],
-                                      top_ps[:, None]),
+                        _filter_on(top_ps, top_ks)[:, None, None],
+                        filtered_probs(logits / safe_t[..., None],
+                                       top_ps[:, None], top_ks[:, None]),
                         jax.nn.softmax(logits / safe_t[..., None], axis=-1)),
                     lambda: jax.nn.softmax(logits / safe_t[..., None],
                                            axis=-1))
@@ -643,13 +653,14 @@ class DecodeServer:
                 (tokens, cache, dcache, cursors, remaining, keys))
 
         if jax.devices()[0].platform == "tpu":
-            return jax.jit(run, donate_argnums=(2, 3, 4, 5, 6, 9))
+            return jax.jit(run, donate_argnums=(2, 3, 4, 5, 6, 10))
         return jax.jit(run)
 
     # -- client surface ---------------------------------------------------
 
     def validate(self, tokens: list[int], max_new: int,
-                 temperature: float = 0.0, top_p: float = 1.0) -> None:
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 top_k: int = 0) -> None:
         """Raise ValueError if the request can't fit this server's static
         buckets; shared by every submission front-end (the RPC serving
         loop validates on the caller's thread with this)."""
@@ -679,21 +690,24 @@ class DecodeServer:
             raise ValueError(f"temperature {temperature} must be >= 0")
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p {top_p} must be in (0, 1]")
+        if top_k < 0 or top_k != int(top_k):
+            raise ValueError(f"top_k {top_k} must be a non-negative int")
 
     def submit(self, tokens: list[int], max_new: int, *,
                temperature: float = 0.0, top_p: float = 1.0,
-               seed: int | None = None) -> int:
+               top_k: int = 0, seed: int | None = None) -> int:
         """Queue a prompt; returns the request id. ``temperature`` 0 =
         greedy; > 0 samples with a per-request stream seeded by ``seed``
         (default: the request id); ``top_p`` < 1 restricts sampling to
-        the nucleus, exactly as in `engine.generate`."""
-        self.validate(tokens, max_new, temperature, top_p)
+        the nucleus and ``top_k`` > 0 to the k most probable tokens
+        (k-filter first, then nucleus), exactly as in `engine.generate`."""
+        self.validate(tokens, max_new, temperature, top_p, top_k)
         rid = self._next_id
         self._next_id += 1
         self._queue.append(Request(id=rid, tokens=list(tokens),
                                    max_new=max_new,
                                    temperature=temperature, top_p=top_p,
-                                   seed=seed))
+                                   top_k=int(top_k), seed=seed))
         return rid
 
     def poll(self) -> list[Completion]:
@@ -812,9 +826,10 @@ class DecodeServer:
                 jnp.int32(true_len), bucket)
             temp = jnp.float32(req.temperature)
             topp = jnp.float32(req.top_p)
+            topk = jnp.int32(req.top_k)
             seed = req.id if req.seed is None else req.seed
             first, key = _pick_first(last_logits, temp,
-                                     jax.random.PRNGKey(seed), topp)
+                                     jax.random.PRNGKey(seed), topp, topk)
             self._tokens, self._cache = _insert(
                 self._tokens, self._cache, row_cache, jnp.asarray(prompt),
                 first, jnp.int32(true_len), jnp.int32(slot), bucket)
@@ -828,6 +843,7 @@ class DecodeServer:
             self._cursors = self._cursors.at[slot].set(true_len)
             self._temps = self._temps.at[slot].set(temp)
             self._top_ps = self._top_ps.at[slot].set(topp)
+            self._top_ks = self._top_ks.at[slot].set(topk)
             self._keys = self._keys.at[slot].set(key)
             rem = req.max_new - 1
             if self.eos_id is not None and int(first) == self.eos_id:
@@ -857,13 +873,13 @@ class DecodeServer:
                     self.params, self._draft_params, self._tokens,
                     self._cache, self._draft_cache, self._cursors,
                     self._remaining, self._temps, self._top_ps,
-                    self._keys)
+                    self._top_ks, self._keys)
             else:
                 (self._tokens, self._cache, self._cursors,
                  self._remaining, self._keys) = self._decode(
                     self.params, self._tokens, self._cache, self._cursors,
                     self._remaining, self._temps, self._top_ps,
-                    self._keys)
+                    self._top_ks, self._keys)
             self._stats["dispatches"] += 1
             self._retire_finished()
         return len(self._live) + len(self._queue)

@@ -1,11 +1,11 @@
 """Shared sampling transforms for the generation tiers.
 
-One implementation of nucleus (top-p) filtering serves both one-shot
-`engine.generate` and the continuous-batching pool / speculative-sampling
-path (`engine.serve_lm`) — the pool's distribution-exactness contract
-depends on the two tiers filtering identically, so the construction lives
-here once. Reference has no sampling at all (`alexnet_resnet.py` serves
-argmax classifications only).
+One implementation of top-k and nucleus (top-p) filtering serves both
+one-shot `engine.generate` and the continuous-batching pool /
+speculative-sampling path (`engine.serve_lm`) — the pool's
+distribution-exactness contract depends on the two tiers filtering
+identically, so the construction lives here once. Reference has no
+sampling at all (`alexnet_resnet.py` serves argmax classifications only).
 """
 from __future__ import annotations
 
@@ -13,20 +13,67 @@ import jax
 import jax.numpy as jnp
 
 
-def nucleus_probs(scaled_logits: jnp.ndarray,
-                  top_p: jnp.ndarray) -> jnp.ndarray:
-    """Temperature-scaled logits → nucleus-filtered, renormalized
-    probabilities over the LAST axis (any leading shape; ``top_p``
-    broadcasts over it). top_p >= 1 is the identity. The nucleus is the
-    smallest sorted-probability prefix whose mass reaches top_p, with the
-    target clamped to the achievable float32 cumsum total so round-off
-    near 1.0 can't collapse the nucleus to the argmax token."""
-    probs = jax.nn.softmax(scaled_logits, axis=-1)
+def _nucleus_on_probs(probs: jnp.ndarray,
+                      top_p: jnp.ndarray) -> jnp.ndarray:
+    """Nucleus-filter an (already normalized) probability tensor over the
+    LAST axis. The nucleus is the smallest sorted-probability prefix whose
+    mass reaches top_p, with the target clamped to the achievable float32
+    cumsum total so round-off near 1.0 can't collapse the nucleus to the
+    argmax token. top_p >= 1 is the identity."""
     sorted_p = jnp.flip(jnp.sort(probs, axis=-1), axis=-1)
     cum = jnp.cumsum(sorted_p, axis=-1)
     target = jnp.minimum(top_p[..., None], cum[..., -1:])
     k_idx = jnp.argmax(cum >= target, axis=-1)
     cutoff = jnp.take_along_axis(sorted_p, k_idx[..., None], axis=-1)
     keep = (probs >= cutoff) | (top_p[..., None] >= 1.0)
+    filt = jnp.where(keep, probs, 0.0)
+    return filt / filt.sum(axis=-1, keepdims=True)
+
+
+def nucleus_probs(scaled_logits: jnp.ndarray,
+                  top_p: jnp.ndarray) -> jnp.ndarray:
+    """Temperature-scaled logits → nucleus-filtered, renormalized
+    probabilities over the LAST axis (any leading shape; ``top_p``
+    broadcasts over it)."""
+    return _nucleus_on_probs(jax.nn.softmax(scaled_logits, axis=-1), top_p)
+
+
+def filtered_probs(scaled_logits: jnp.ndarray, top_p: jnp.ndarray,
+                   top_k: jnp.ndarray) -> jnp.ndarray:
+    """Temperature-scaled logits → top-k then nucleus filtered,
+    renormalized probabilities over the LAST axis.
+
+    ``top_k`` is integer (0 or >= vocab disables the k-filter); ``top_p``
+    as in `nucleus_probs`; both broadcast over the leading shape. Filter
+    order matches the standard sequential-warper convention: the k
+    largest tokens are kept first (ties AT the k-th probability are all
+    kept — the filter is a probability threshold, so equal-probability
+    tokens are indistinguishable), then the nucleus is taken over the
+    RENORMALIZED top-k distribution. With both filters off this is the
+    plain softmax."""
+    probs = jax.nn.softmax(scaled_logits, axis=-1)
+    v = probs.shape[-1]
+    k = jnp.clip(top_k, 0, v)
+    # ONE descending sort serves both filters (this runs on the decode
+    # hot path): the top-k survivors are exactly the prefix of sorted_p
+    # at/above the k-th probability, and k-masking preserves sort order,
+    # so the nucleus cutoff over the RENORMALIZED top-k distribution is
+    # derivable from the same sorted array — cumsum of the masked prefix
+    # divided by its total is the normalized cumulative the nucleus
+    # construction needs.
+    sorted_p = jnp.flip(jnp.sort(probs, axis=-1), axis=-1)
+    idx = jnp.clip(k - 1, 0, v - 1)
+    kth = jnp.take_along_axis(
+        sorted_p, jnp.broadcast_to(idx[..., None], probs.shape[:-1] + (1,)),
+        axis=-1)
+    k_off = (k[..., None] <= 0) | (k[..., None] >= v)
+    keep_k = (probs >= kth) | k_off
+    masked_sorted = jnp.where((sorted_p >= kth) | k_off, sorted_p, 0.0)
+    z = masked_sorted.sum(axis=-1, keepdims=True)
+    cum = jnp.cumsum(masked_sorted, axis=-1) / z
+    target = jnp.minimum(top_p[..., None], cum[..., -1:])
+    k_idx = jnp.argmax(cum >= target, axis=-1)
+    cutoff = jnp.take_along_axis(masked_sorted, k_idx[..., None], axis=-1)
+    keep = keep_k & ((probs >= cutoff) | (top_p[..., None] >= 1.0))
     filt = jnp.where(keep, probs, 0.0)
     return filt / filt.sum(axis=-1, keepdims=True)

@@ -187,7 +187,8 @@ class ControlService:
                            prompt_len=prompt.shape[1],
                            max_new=int(p["max_new"]),
                            temperature=temperature,
-                           top_p=float(p.get("top_p", 1.0)), **kw)
+                           top_p=float(p.get("top_p", 1.0)),
+                           top_k=int(p.get("top_k", 0)), **kw)
             return {"tokens": [[int(t) for t in row] for row in out]}
         if verb == "lm_serve":
             # continuous-batching serving of a store-persisted LM: a decode
@@ -261,6 +262,7 @@ class ControlService:
                 [int(t) for t in p["prompt"]], int(p["max_new"]),
                 temperature=float(p.get("temperature", 0.0)),
                 top_p=float(p.get("top_p", 1.0)),
+                top_k=int(p.get("top_k", 0)),
                 seed=(int(p["seed"]) if p.get("seed") is not None
                       else None))
             return {"id": rid}
@@ -382,6 +384,7 @@ class ControlService:
                 rid = mgr.submit(name, [int(t) for t in p["prompt"]],
                                  int(p["max_new"]),
                                  top_p=float(p.get("top_p", 1.0)),
+                                 top_k=int(p.get("top_k", 0)),
                                  temperature=float(
                                      p.get("temperature", 0.0)),
                                  seed=(int(p["seed"])

@@ -239,7 +239,7 @@ class LMPoolManager:
 
     def submit(self, name: str, prompt: list[int], max_new: int,
                temperature: float = 0.0, top_p: float = 1.0,
-               seed: int | None = None) -> int:
+               top_k: int = 0, seed: int | None = None) -> int:
         """Journal a request (seed pinned NOW — replay after any failure
         must be token-exact even for sampled requests), then forward it to
         the pool's node. Forward failures leave it pending; the pump
@@ -255,6 +255,7 @@ class LMPoolManager:
                    "max_new": int(max_new),
                    "temperature": float(temperature),
                    "top_p": float(top_p),
+                   "top_k": int(top_k),
                    "seed": int(seed) if seed is not None else rid,
                    "status": _PENDING, "node_id": None,
                    "tokens": None, "prompt_len": None, "delivered": False,
@@ -273,7 +274,8 @@ class LMPoolManager:
                 "verb": "lm_submit", "name": name,
                 "prompt": req["prompt"], "max_new": req["max_new"],
                 "temperature": req["temperature"],
-                "top_p": req.get("top_p", 1.0), "seed": req["seed"]})
+                "top_p": req.get("top_p", 1.0),
+                "top_k": req.get("top_k", 0), "seed": req["seed"]})
         except (TransportError, OSError):
             return                      # stays pending; pump will retry
         except ValueError as e:
@@ -1069,6 +1071,7 @@ class LMPoolManager:
                     # predate the watchdog/measurement fields
                     "requests": {int(rid): {"t_forwarded": None,
                                             "attempts": 0, "top_p": 1.0,
+                                            "top_k": 0,
                                             "t_submitted": 0.0, **dict(r)}
                                  for rid, r in p["requests"].items()}}
                 for n, p in snap.get("pools", {}).items()}

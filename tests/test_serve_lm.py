@@ -472,7 +472,7 @@ def test_nucleus_probs_masks_tail():
     reaching top_p and renormalizes; top_p=1 is the identity."""
     import jax.numpy as jnp
 
-    from idunno_tpu.engine.serve_lm import nucleus_probs
+    from idunno_tpu.ops.sampling import nucleus_probs
 
     logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
     out = np.asarray(nucleus_probs(logits, jnp.asarray([0.6])))[0]
@@ -480,6 +480,88 @@ def test_nucleus_probs_masks_tail():
     assert np.allclose(out, [0.625, 0.375, 0.0, 0.0], atol=1e-6)
     ident = np.asarray(nucleus_probs(logits, jnp.asarray([1.0])))[0]
     assert np.allclose(ident, [0.5, 0.3, 0.15, 0.05], atol=1e-6)
+
+
+def test_filtered_probs_top_k():
+    """filtered_probs: top_k keeps the k most probable (renormalized),
+    composes with the nucleus over the RENORMALIZED top-k distribution,
+    and k=0 / k>=vocab are the identity."""
+    import jax.numpy as jnp
+
+    from idunno_tpu.ops.sampling import filtered_probs, nucleus_probs
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    k2 = np.asarray(filtered_probs(logits, jnp.asarray([1.0]),
+                                   jnp.asarray([2])))[0]
+    assert np.allclose(k2, [0.625, 0.375, 0.0, 0.0], atol=1e-6)
+    off = np.asarray(filtered_probs(logits, jnp.asarray([1.0]),
+                                    jnp.asarray([0])))[0]
+    assert np.allclose(off, [0.5, 0.3, 0.15, 0.05], atol=1e-6)
+    big = np.asarray(filtered_probs(logits, jnp.asarray([1.0]),
+                                    jnp.asarray([99])))[0]
+    assert np.allclose(big, off, atol=1e-6)
+    # k=3 then top_p=0.6 on the renormalized {0.526, 0.316, 0.158}:
+    # nucleus = {0.526, 0.316} → 0.625/0.375
+    both = np.asarray(filtered_probs(logits, jnp.asarray([0.6]),
+                                     jnp.asarray([3])))[0]
+    assert np.allclose(both, [0.625, 0.375, 0.0, 0.0], atol=1e-4)
+    # pure-nucleus path unchanged by the refactor
+    nuc = np.asarray(nucleus_probs(logits, jnp.asarray([0.6])))[0]
+    assert np.allclose(nuc, [0.625, 0.375, 0.0, 0.0], atol=1e-6)
+
+
+def test_pool_top_k_sampling(lm):
+    """top_k in the pool: reproducible per seed, differs from unfiltered
+    sampling on the same seed, top_k=1 is exactly the greedy stream, and
+    a greedy co-resident is unaffected."""
+    model, params = lm
+    prompt = [5, 11, 17]
+
+    def serve(top_k):
+        srv = DecodeServer(model, params, slots=2, prompt_len=4,
+                           max_len=24)
+        rid = srv.submit(prompt, max_new=10, temperature=1.5,
+                         top_k=top_k, seed=42)
+        g = srv.submit(prompt, max_new=10)
+        done = {c.id: c for c in srv.run_until_drained()}
+        return done[rid].tokens, done[g].tokens
+
+    a1, g1 = serve(3)
+    a2, g2 = serve(3)
+    b1, _ = serve(0)
+    one, _ = serve(1)
+    assert a1 == a2                     # seeded top-k stream reproducible
+    assert g1 == g2 == expected(model, params, prompt, 10)
+    assert a1 != b1                     # the k-filter changed the stream
+    # k=1 leaves only the argmax token: identical to the greedy stream
+    assert one == g1
+    with pytest.raises(ValueError, match="top_k"):
+        serve(-1)
+
+
+def test_speculative_top_k_requests_complete(lm):
+    """top_k on a speculative pool: q and p are both the k-filtered
+    distributions, so the rejection math carries over — completes,
+    seed-reproducible, greedy co-resident token-exact, and k=1 sampled
+    rows emit exactly the target's greedy stream through the spec path."""
+    model, params = lm
+    prompt = [3, 1, 4]
+
+    def run(top_k):
+        srv = DecodeServer(model, params, slots=2, prompt_len=4,
+                           max_len=40, draft=(model, params), draft_len=3)
+        rid_s = srv.submit(prompt, max_new=10, temperature=0.9,
+                           top_k=top_k, seed=7)
+        rid_g = srv.submit(prompt, max_new=10)
+        done = {c.id: c for c in srv.run_until_drained()}
+        return done[rid_s], done[rid_g]
+
+    s1, g1 = run(3)
+    s2, g2 = run(3)
+    assert g1.tokens == g2.tokens == expected(model, params, prompt, 10)
+    assert s1.tokens == s2.tokens
+    k1, _ = run(1)
+    assert k1.tokens == g1.tokens
 
 
 def test_pool_top_p_sampling(lm):
@@ -541,7 +623,8 @@ def test_spec_commit_distribution_exact_with_nucleus():
     import jax
     import jax.numpy as jnp
 
-    from idunno_tpu.engine.serve_lm import nucleus_probs, spec_commit
+    from idunno_tpu.engine.serve_lm import spec_commit
+    from idunno_tpu.ops.sampling import nucleus_probs
 
     vocab, gamma, trials = 5, 2, 20_000
     p_raw = jnp.log(jnp.asarray([0.05, 0.45, 0.10, 0.25, 0.15]))
