@@ -379,12 +379,16 @@ def test_service_time_excludes_queue_wait(lm):
     assert len(done) == 3
     for c in done:
         assert c.service_s > 0
-        # sojourn-style accounting would charge the LAST request nearly
-        # the whole wall clock; service time stays a per-request cost
-        assert c.service_s < 0.62 * wall, (c.service_s, wall)
-    # identical work → near-identical measured service
+    # the load-immune discriminator: with ONE slot the three service
+    # intervals are disjoint sub-intervals of the wall clock, so correct
+    # service accounting sums to <= wall (+ scheduling slack), while
+    # sojourn accounting sums to ~2x wall (1/3 + 2/3 + 3/3). A
+    # per-request ratio bound flakes under xdist box load (measured:
+    # 0.62x-wall bound tripped on a loaded 4-worker run); the sum cannot.
     svc = sorted(c.service_s for c in done)
-    assert svc[-1] < 3.0 * svc[0], svc
+    assert sum(svc) < 1.5 * wall, (svc, wall)
+    # identical work → same-order measured service (loose: box jitter)
+    assert svc[-1] < 5.0 * svc[0], svc
 
 
 def test_spec_commit_distribution_exact():
