@@ -165,7 +165,8 @@ class ControlService:
             import jax
             import jax.numpy as jnp
 
-            from idunno_tpu.engine.generate import generate, load_lm
+            from idunno_tpu.engine.generate import (beam_search, generate,
+                                                    load_lm)
 
             name = p["name"]
             if name not in self._lms or p.get("reload"):
@@ -173,6 +174,25 @@ class ControlService:
             model, params = self._lms[name]
             prompt = jnp.asarray(p["prompt"], jnp.int32)
             temperature = float(p.get("temperature", 0.0))
+            beam_width = int(p.get("beam_width", 0))
+            if beam_width >= 1:       # width 1 is valid (greedy + scores)
+                # disabled-sampler values (temperature 0, top_p 1, top_k
+                # 0) are fine alongside beam; ACTIVE samplers are not
+                if (temperature > 0.0 or float(p.get("top_p", 1.0)) < 1.0
+                        or int(p.get("top_k", 0)) > 0):
+                    raise ValueError("beam_width is a search, not a "
+                                     "sampler: temperature/top_p/top_k "
+                                     "don't apply")
+                if p.get("prompt_lens") is not None:
+                    raise ValueError("beam_search does not support ragged "
+                                     "prompt_lens; pad per-call or use "
+                                     "the sampler path")
+                seqs, scores = beam_search(model, params, prompt,
+                                           prompt_len=prompt.shape[1],
+                                           max_new=int(p["max_new"]),
+                                           beam_width=beam_width)
+                return {"tokens": [[int(t) for t in row] for row in seqs],
+                        "log_probs": [float(s) for s in scores]}
             kw = {}
             if p.get("prompt_lens") is not None:
                 kw["prompt_lens"] = jnp.asarray(p["prompt_lens"])

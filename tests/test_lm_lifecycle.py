@@ -83,6 +83,28 @@ def test_lm_served_through_cluster_control(stores, tmp_path):
                                   np.asarray(want))
     assert "tiny" in ctl._lms                      # cached for later calls
 
+    # beam search over the same verb: matches the library call, scores
+    # included; samplers are rejected (beam is a search, not a sampler)
+    from idunno_tpu.engine.generate import beam_search
+    want_seqs, want_scores = beam_search(model, state.params, prompt,
+                                         prompt_len=4, max_new=5,
+                                         beam_width=3)
+    out_beam = ctl._handle("control", Message(
+        MessageType.INFERENCE, "client",
+        {"verb": "generate", "name": "tiny",
+         "prompt": [[int(t) for t in row] for row in prompt],
+         "max_new": 5, "beam_width": 3}))
+    assert out_beam.type is MessageType.ACK, out_beam.payload
+    np.testing.assert_array_equal(np.asarray(out_beam.payload["tokens"]),
+                                  np.asarray(want_seqs))
+    np.testing.assert_allclose(np.asarray(out_beam.payload["log_probs"]),
+                               np.asarray(want_scores), rtol=1e-5)
+    out_bad = ctl._handle("control", Message(
+        MessageType.INFERENCE, "client",
+        {"verb": "generate", "name": "tiny", "prompt": [[1, 2]],
+         "max_new": 2, "beam_width": 3, "temperature": 0.7}))
+    assert out_bad.type is MessageType.ERROR
+
     # re-save with a DIFFERENT architecture: versions pair config+weights
     # atomically, the cache serves old weights until reload=true
     model_v2 = TransformerLM(vocab=32, dim=16, depth=1, num_heads=2,
