@@ -126,16 +126,24 @@ def test_shell_lm_and_train_commands(nodes):
         assert "already serving" in sh.dispatch("lm-serve shelllm 4 10")
         assert "request 0 queued" in sh.dispatch(
             "lm-submit shelllm 4 3 1 2")
+        # sampler options parse and land in the pool (top_k new)
+        assert "request 1 queued" in sh.dispatch(
+            "lm-submit shelllm 2 temperature=0.8 top_k=3 top_p=0.9 "
+            "seed=5 3 1 2")
+        assert "unknown lm-submit option" in sh.dispatch(
+            "lm-submit shelllm 2 bogus=1 3")
         deadline = time.time() + 60.0
-        text = ""
-        while time.time() < deadline and "#0:" not in text:
-            text = sh.dispatch("lm-poll shelllm")
+        seen = ""
+        while time.time() < deadline and not (
+                "#0:" in seen and "#1:" in seen):
+            seen += sh.dispatch("lm-poll shelllm") + "\n"
             time.sleep(0.05)
-        assert "#0:" in text and "prompt_len=3" in text
-        toks = text.split(":")[1].split("(")[0].split()
+        assert "#0:" in seen and "#1:" in seen and "prompt_len=3" in seen
+        line0 = next(ln for ln in seen.splitlines() if ln.startswith("#0:"))
+        toks = line0.split(":")[1].split("(")[0].split()
         assert len(toks) == 3 + 4                  # prompt + max_new
         stats = sh.dispatch("lm-stats shelllm")
-        assert "completed=1" in stats and "tokens_generated=4" in stats
+        assert "completed=2" in stats and "tokens_generated=6" in stats
         assert "live=0/2" in stats
         assert "stopped" in sh.dispatch("lm-stop shelllm")
     finally:
