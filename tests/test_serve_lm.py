@@ -620,22 +620,18 @@ def test_speculative_top_p_requests_complete(lm):
     assert all(0 <= t < VOCAB for t in s1.tokens)
 
 
-def test_spec_commit_distribution_exact_with_nucleus():
-    """Distribution exactness under nucleus sampling: with q and p both
-    nucleus-FILTERED, the first committed token is distributed exactly as
-    the filtered target distribution."""
+def _spec_commit_empirical(pf, qf, seed: int, gamma: int = 2,
+                           trials: int = 20_000) -> np.ndarray:
+    """Monte-Carlo distribution of the FIRST committed token when the
+    draft proposes from ``qf`` and the target distribution is ``pf``
+    (both already filtered identically) — the shared harness for the
+    filtered distribution-exactness tests."""
     import jax
     import jax.numpy as jnp
 
     from idunno_tpu.engine.serve_lm import spec_commit
-    from idunno_tpu.ops.sampling import nucleus_probs
 
-    vocab, gamma, trials = 5, 2, 20_000
-    p_raw = jnp.log(jnp.asarray([0.05, 0.45, 0.10, 0.25, 0.15]))
-    q_raw = jnp.log(jnp.asarray([0.50, 0.05, 0.20, 0.05, 0.20]))
-    top_p = jnp.asarray([0.75])
-    pf = nucleus_probs(p_raw[None], top_p)[0]   # filtered target
-    qf = nucleus_probs(q_raw[None], top_p)[0]   # filtered draft
+    vocab = int(pf.shape[-1])
 
     def one_trial(key):
         ks = jax.random.split(key, 2 * gamma + 1)
@@ -652,10 +648,48 @@ def test_spec_commit_distribution_exact_with_nucleus():
         return cand[0, 0]
 
     toks = jax.jit(jax.vmap(one_trial))(
-        jax.random.split(jax.random.PRNGKey(1), trials))
-    emp = np.bincount(np.asarray(toks), minlength=vocab) / trials
+        jax.random.split(jax.random.PRNGKey(seed), trials))
+    return np.bincount(np.asarray(toks), minlength=vocab) / trials
+
+
+def test_spec_commit_distribution_exact_with_nucleus():
+    """Distribution exactness under nucleus sampling: with q and p both
+    nucleus-FILTERED, the first committed token is distributed exactly as
+    the filtered target distribution."""
+    import jax.numpy as jnp
+
+    from idunno_tpu.ops.sampling import nucleus_probs
+
+    p_raw = jnp.log(jnp.asarray([0.05, 0.45, 0.10, 0.25, 0.15]))
+    q_raw = jnp.log(jnp.asarray([0.50, 0.05, 0.20, 0.05, 0.20]))
+    top_p = jnp.asarray([0.75])
+    pf = nucleus_probs(p_raw[None], top_p)[0]   # filtered target
+    qf = nucleus_probs(q_raw[None], top_p)[0]   # filtered draft
+
+    emp = _spec_commit_empirical(pf, qf, seed=1)
     assert np.abs(emp - np.asarray(pf)).max() < 0.02, (emp, pf)
     # tokens outside the nucleus are NEVER committed as the first token
+    assert emp[np.asarray(pf) == 0].max() == 0.0
+
+
+def test_spec_commit_distribution_exact_with_top_k():
+    """Distribution exactness under top-k (composed with a nucleus): with
+    q and p both run through the SAME filtered_probs, the first committed
+    token is distributed exactly as the filtered target distribution, and
+    k-excluded tokens are never committed."""
+    import jax.numpy as jnp
+
+    from idunno_tpu.ops.sampling import filtered_probs
+
+    p_raw = jnp.log(jnp.asarray([0.05, 0.45, 0.10, 0.25, 0.15]))
+    q_raw = jnp.log(jnp.asarray([0.50, 0.05, 0.20, 0.05, 0.20]))
+    top_p, top_k = jnp.asarray([0.9]), jnp.asarray([3])
+    pf = filtered_probs(p_raw[None], top_p, top_k)[0]
+    qf = filtered_probs(q_raw[None], top_p, top_k)[0]
+    assert (np.asarray(pf) == 0).sum() >= 2     # the filter genuinely cut
+
+    emp = _spec_commit_empirical(pf, qf, seed=2)
+    assert np.abs(emp - np.asarray(pf)).max() < 0.02, (emp, pf)
     assert emp[np.asarray(pf) == 0].max() == 0.0
 
 
