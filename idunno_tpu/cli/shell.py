@@ -398,7 +398,7 @@ class Shell:
         if len(args) < 3:
             return ("usage: lm-serve <name> <prompt_len> <max_len> "
                     "[slots= decode_steps= quantize=int8 "
-                    "kv_cache_dtype=int8 eos_id=N "
+                    "kv_cache_dtype=int8 eos_id=N logprobs=1 "
                     "draft=<lm> draft_len=N place=1 reload=1]\n"
                     "note: draft (speculative) pools serve greedy "
                     "requests token-exact and sampled requests "
@@ -418,6 +418,9 @@ class Shell:
             # least-loaded node, journals requests, and recovers it (with
             # its unfinished requests) if its node dies
             payload["placement"] = "auto"
+        if "logprobs" in kv:
+            payload["track_logprobs"] = kv.pop("logprobs") not in (
+                "0", "false", "")
         if "reload" in kv:
             payload["reload"] = kv.pop("reload") not in ("0", "false", "")
         if kv:

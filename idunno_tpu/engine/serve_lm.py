@@ -80,6 +80,10 @@ class Completion:
     # client-cancelled mid-stream: ``tokens`` holds whatever was generated
     # before the cancel landed (possibly just the prompt + first token)
     cancelled: bool = False
+    # per-GENERATED-token logprobs under the raw model distribution
+    # (aligned with tokens[prompt_len:]); None unless the pool was built
+    # with track_logprobs=True
+    logprobs: list[float] | None = None
 
 
 def _set_cursors(cache: Any, cursors: jnp.ndarray) -> Any:
@@ -296,7 +300,8 @@ class DecodeServer:
                  quantize: str = "none", eos_id: int | None = None,
                  mesh=None, draft: tuple | None = None,
                  draft_len: int = 4,
-                 prompt_buckets: tuple[int, ...] | None = None) -> None:
+                 prompt_buckets: tuple[int, ...] | None = None,
+                 track_logprobs: bool = False) -> None:
         if not model.causal:
             raise ValueError("continuous batching needs a causal LM")
         if prompt_len > max_len:
@@ -351,6 +356,12 @@ class DecodeServer:
         elif quantize != "none":
             raise ValueError(f"quantize={quantize!r}: want none|int8")
         self.quantize = quantize
+        # compile-time flag: when off, the decode programs carry zero
+        # logprob bookkeeping (the hot path is unchanged); when on, every
+        # generated token's logprob under the RAW model distribution
+        # (untempered, unfiltered — sampler-independent semantics) is
+        # recorded and returned on the Completion
+        self.track_logprobs = bool(track_logprobs)
         self.model = model
         self.params = params
         self.slots = slots
@@ -414,6 +425,13 @@ class DecodeServer:
         self._top_ps = zeros((slots,), jnp.float32) + 1.0
         self._top_ks = zeros((slots,), jnp.int32)        # 0 = no k-filter
         self._keys = zeros((slots, 2), jnp.uint32)       # per-row rng
+        # width-0 when tracking is off: the decode programs keep one
+        # signature and the buffer costs nothing (no in-body updates).
+        # The empty buffer is allocated UNSHARDED — XLA refuses a named
+        # sharding on a zero-size dimension, and it carries no data
+        self._logprobs = (zeros((slots, max_len), jnp.float32)
+                          if self.track_logprobs
+                          else jnp.zeros((slots, 0), jnp.float32))
         self._draft_cache = None
         if self._draft_model is not None:
             ddec = self._per_row_decode(self._draft_model)
@@ -453,13 +471,14 @@ class DecodeServer:
 
     def _build_decode(self, n_steps: int):
         dec = self._dec
+        track = self.track_logprobs     # static: traced once
 
         def run(params, tokens, cache, cursors, remaining, temps,
-                top_ps, top_ks, keys):
+                top_ps, top_ks, keys, logprobs):
             params = dequantize_tree(params)   # int8 stays HBM-resident
 
             def body(_, carry):
-                tokens, cache, cursors, remaining, keys = carry
+                tokens, cache, cursors, remaining, keys, logprobs = carry
                 active = remaining > 0
                 cache = _set_cursors(cache, cursors)
                 tok = jnp.take_along_axis(tokens, cursors[:, None], axis=1)
@@ -493,25 +512,35 @@ class DecodeServer:
                 rows = jnp.arange(tokens.shape[0])
                 tokens = tokens.at[rows, wpos].set(
                     jnp.where(active, nxt, old))
+                if track:
+                    lp_all = jax.nn.log_softmax(l.astype(jnp.float32),
+                                                axis=-1)
+                    lp = jnp.take_along_axis(
+                        lp_all, nxt[:, None], axis=1)[:, 0]
+                    lp_old = jnp.take_along_axis(
+                        logprobs, wpos[:, None], axis=1)[:, 0]
+                    logprobs = logprobs.at[rows, wpos].set(
+                        jnp.where(active, lp, lp_old))
                 cursors = jnp.where(active, cursors + 1, cursors)
                 new_remaining = remaining - 1
                 if self.eos_id is not None:        # static: traced once
                     new_remaining = jnp.where(nxt == self.eos_id, 0,
                                               new_remaining)
                 remaining = jnp.where(active, new_remaining, remaining)
-                return tokens, cache, cursors, remaining, keys
+                return tokens, cache, cursors, remaining, keys, logprobs
 
             return jax.lax.fori_loop(
                 0, n_steps, body,
-                (tokens, cache, cursors, remaining, keys))
+                (tokens, cache, cursors, remaining, keys, logprobs))
 
-        # donate the decode state (tokens/cache/cursors/remaining/keys):
-        # the KV cache is by far the largest buffer and every step returns
-        # a fresh one — donation lets XLA update it in place instead of
-        # copying it per dispatch. (CPU doesn't implement donation and
-        # would warn.) temps/top_ps/top_ks are read-only and not donated.
+        # donate the decode state (tokens/cache/cursors/remaining/keys/
+        # logprobs): the KV cache is by far the largest buffer and every
+        # step returns a fresh one — donation lets XLA update it in place
+        # instead of copying it per dispatch. (CPU doesn't implement
+        # donation and would warn.) temps/top_ps/top_ks are read-only and
+        # not donated.
         if jax.devices()[0].platform == "tpu":
-            return jax.jit(run, donate_argnums=(1, 2, 3, 4, 8))
+            return jax.jit(run, donate_argnums=(1, 2, 3, 4, 8, 9))
         return jax.jit(run)
 
     def _build_spec_round(self, gamma: int, rounds: int = 1):
@@ -546,9 +575,10 @@ class DecodeServer:
         carried state is fully gated on ``active``."""
         dec = self._dec
         ddec = self._per_row_decode(self._draft_model, self.max_len)
+        track = self.track_logprobs     # static: traced once
 
         def run(params, dparams, tokens, cache, dcache, cursors,
-                remaining, temps, top_ps, top_ks, keys):
+                remaining, temps, top_ps, top_ks, keys, logprobs):
             params = dequantize_tree(params)
             dparams = dequantize_tree(dparams)
             s = tokens.shape[0]
@@ -557,7 +587,8 @@ class DecodeServer:
             safe_t = jnp.maximum(temps, 1e-6)[:, None]
 
             def round_body(carry):
-                tokens, cache, dcache, cursors, remaining, keys = carry
+                (tokens, cache, dcache, cursors, remaining, keys,
+                 logprobs) = carry
                 active = remaining > 0
                 prev = jnp.take_along_axis(tokens, cursors[:, None],
                                            axis=1)[:, 0]    # [S]
@@ -644,16 +675,25 @@ class DecodeServer:
                 keep = (jidx < commit[:, None]) & active[:, None]
                 tokens = tokens.at[rows[:, None], wpos].set(
                     jnp.where(keep, cand, old))
+                if track:
+                    lp_all = jax.nn.log_softmax(logits, axis=-1)
+                    lp_cand = jnp.take_along_axis(
+                        lp_all, cand[..., None], axis=-1)[..., 0]  # [S,γ+1]
+                    lp_old = jnp.take_along_axis(logprobs, wpos, axis=1)
+                    logprobs = logprobs.at[rows[:, None], wpos].set(
+                        jnp.where(keep, lp_cand, lp_old))
                 cursors = jnp.where(active, cursors + commit, cursors)
                 remaining = jnp.where(active, rem_after, remaining)
                 keys_out = jnp.where(active[:, None], new_keys, keys)
-                return tokens, cache, dcache, cursors, remaining, keys_out
+                return (tokens, cache, dcache, cursors, remaining,
+                        keys_out, logprobs)
             return jax.lax.fori_loop(
                 0, rounds, lambda _, c: round_body(c),
-                (tokens, cache, dcache, cursors, remaining, keys))
+                (tokens, cache, dcache, cursors, remaining, keys,
+                 logprobs))
 
         if jax.devices()[0].platform == "tpu":
-            return jax.jit(run, donate_argnums=(2, 3, 4, 5, 6, 10))
+            return jax.jit(run, donate_argnums=(2, 3, 4, 5, 6, 10, 11))
         return jax.jit(run)
 
     # -- client surface ---------------------------------------------------
@@ -778,6 +818,7 @@ class DecodeServer:
             "kv_heads": m.num_kv_heads or m.num_heads,
             "kv_cache_dtype": m.kv_cache_dtype,
             "quantize": self.quantize,
+            "track_logprobs": self.track_logprobs,
             "decode_steps": self.decode_steps,
             "prompt_len": self.prompt_len, "max_len": self.max_len,
             "speculative_draft_len": (self.draft_len
@@ -802,11 +843,15 @@ class DecodeServer:
             row = np.asarray(self._tokens[slot])[:total]
             was_cancelled = req.id in self._cancelled
             self._cancelled.discard(req.id)
+            lps = None
+            if self.track_logprobs:
+                lp_row = np.asarray(self._logprobs[slot])[:total]
+                lps = [float(x) for x in lp_row[len(req.tokens):]]
             self._done.append(Completion(
                 id=req.id, tokens=[int(t) for t in row],
                 prompt_len=len(req.tokens),
                 service_s=time.monotonic() - req.t_admit,
-                cancelled=was_cancelled))
+                cancelled=was_cancelled, logprobs=lps))
             if not was_cancelled:
                 self._stats["completed"] += 1
             self._stats["tokens_generated"] += total - len(req.tokens)
@@ -845,6 +890,10 @@ class DecodeServer:
             self._top_ps = self._top_ps.at[slot].set(topp)
             self._top_ks = self._top_ks.at[slot].set(topk)
             self._keys = self._keys.at[slot].set(key)
+            if self.track_logprobs:   # the prefill-picked token's logprob
+                lp0 = jax.nn.log_softmax(
+                    last_logits.astype(jnp.float32))[first]
+                self._logprobs = self._logprobs.at[slot, true_len].set(lp0)
             rem = req.max_new - 1
             if self.eos_id is not None and int(first) == self.eos_id:
                 rem = 0                   # the prompt's very next token
@@ -869,17 +918,18 @@ class DecodeServer:
             if self._draft_model is not None:
                 (self._tokens, self._cache, self._draft_cache,
                  self._cursors, self._remaining,
-                 self._keys) = self._decode_spec(
+                 self._keys, self._logprobs) = self._decode_spec(
                     self.params, self._draft_params, self._tokens,
                     self._cache, self._draft_cache, self._cursors,
                     self._remaining, self._temps, self._top_ps,
-                    self._top_ks, self._keys)
+                    self._top_ks, self._keys, self._logprobs)
             else:
                 (self._tokens, self._cache, self._cursors,
-                 self._remaining, self._keys) = self._decode(
+                 self._remaining, self._keys,
+                 self._logprobs) = self._decode(
                     self.params, self._tokens, self._cache, self._cursors,
                     self._remaining, self._temps, self._top_ps,
-                    self._top_ks, self._keys)
+                    self._top_ks, self._keys, self._logprobs)
             self._stats["dispatches"] += 1
             self._retire_finished()
         return len(self._live) + len(self._queue)

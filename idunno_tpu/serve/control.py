@@ -258,6 +258,7 @@ class ControlService:
                     max_len=int(p["max_len"]),
                     decode_steps=int(p.get("decode_steps", 1)),
                     quantize=p.get("quantize", "none"),
+                    track_logprobs=bool(p.get("track_logprobs", False)),
                     eos_id=(int(p["eos_id"])
                             if p.get("eos_id") is not None else None),
                     draft=draft,
@@ -291,7 +292,9 @@ class ControlService:
             out = {"completions": [
                 {"id": c.id, "tokens": c.tokens, "prompt_len": c.prompt_len,
                  "service_s": round(c.service_s, 6),
-                 "cancelled": c.cancelled}
+                 "cancelled": c.cancelled,
+                 **({"logprobs": c.logprobs}
+                    if c.logprobs is not None else {})}
                 for c in loop.poll()]}
             errs = loop.errors()
             if errs:

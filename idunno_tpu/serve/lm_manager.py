@@ -356,7 +356,10 @@ class LMPoolManager:
                                 "prompt_len": req["prompt_len"],
                                 # same completion shape as the node-direct
                                 # lm_poll reply (control.py)
-                                "service_s": req.get("service_s", 0.0)})
+                                "service_s": req.get("service_s", 0.0),
+                                **({"logprobs": req["logprobs"]}
+                                   if req.get("logprobs") is not None
+                                   else {})})
                 elif req["status"] == _FAILED:
                     req["delivered"] = True
                     errors.append(f"request {rid} failed: "
@@ -879,6 +882,9 @@ class LMPoolManager:
                     req["status"] = _DONE
                     req["tokens"] = [int(t) for t in c["tokens"]]
                     req["prompt_len"] = int(c["prompt_len"])
+                    if c.get("logprobs") is not None:
+                        req["logprobs"] = [float(x)
+                                           for x in c["logprobs"]]
                     req["service_s"] = round(
                         float(c.get("service_s", 0.0)), 6)
                     req["node_id"] = None

@@ -91,7 +91,9 @@ class LMServingLoop:
                     del self._inbox[i]
                     self._outbox.append(Completion(
                         id=rid, tokens=list(entry[1]),
-                        prompt_len=len(entry[1]), cancelled=True))
+                        prompt_len=len(entry[1]), cancelled=True,
+                        logprobs=([] if self.server.track_logprobs
+                                  else None)))
                     return True
             sid = next((s for s, r in self._id_map.items() if r == rid),
                        None)
@@ -198,7 +200,8 @@ class LMServingLoop:
                         self._outbox.append(Completion(
                             id=self._id_map.pop(c.id, c.id),
                             tokens=c.tokens, prompt_len=c.prompt_len,
-                            service_s=c.service_s, cancelled=c.cancelled))
+                            service_s=c.service_s, cancelled=c.cancelled,
+                            logprobs=c.logprobs))
             if live == 0:
                 self._wake.wait(timeout=0.5)
                 self._wake.clear()
