@@ -13,6 +13,7 @@ sites, `:922-939`).
 """
 from __future__ import annotations
 
+import logging
 import socket
 import struct
 import threading
@@ -22,6 +23,8 @@ from idunno_tpu.comm.message import Message
 from idunno_tpu.comm.transport import Handler, Transport, TransportError
 
 AddrOf = Callable[[str], tuple[str, int, int]]   # (ip, tcp_port, udp_port)
+
+log = logging.getLogger("idunno.net")
 
 _MAX_FRAME = 1 << 31
 
@@ -112,6 +115,7 @@ class NetTransport(Transport):
                              daemon=True).start()
 
     def _handle_conn(self, conn: socket.socket) -> None:
+        svc = "?"
         try:
             with conn:
                 conn.settimeout(30.0)
@@ -122,6 +126,11 @@ class NetTransport(Transport):
                     _send_frame(conn, svc, out)
         except (ConnectionError, socket.timeout, OSError):
             pass
+        except Exception:  # noqa: BLE001 - malformed frame body or a
+            # handler bug: drop THIS connection (the client sees a close
+            # and errors/retries) but log it instead of spraying a raw
+            # thread traceback — the listener itself keeps serving
+            log.exception("connection handler error (service %s)", svc)
 
     def _udp_loop(self) -> None:
         while not self._stop.is_set():
@@ -139,7 +148,14 @@ class NetTransport(Transport):
                 continue
             handler = self._handlers.get(svc)
             if handler:
-                handler(svc, msg)     # datagrams never reply
+                try:
+                    handler(svc, msg)     # datagrams never reply
+                except Exception:  # noqa: BLE001 - a handler bug must not
+                    # kill the UDP loop: this thread carries every
+                    # heartbeat/gossip datagram for the node, and its
+                    # silent death would make peers falsely suspect us
+                    log.exception("datagram handler error (service %s)",
+                                  svc)
 
     # -- client side ------------------------------------------------------
 

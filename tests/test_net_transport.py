@@ -94,3 +94,54 @@ def test_concurrent_oneshot_calls(pair):
         results = sorted(pool.map(call, range(40)))
     assert results == list(range(40))
     assert sorted(seen) == list(range(40))
+
+
+def test_malformed_frame_and_handler_bug_do_not_kill_listener(pair):
+    """A garbage frame body (undecodable Message) or a raising handler
+    must cost only THAT connection — the listener keeps serving and a
+    well-formed call afterwards succeeds."""
+    import socket
+    import struct
+
+    ta, tb = pair
+    calls = {"n": 0}
+
+    def handler(svc, msg):
+        calls["n"] += 1
+        if msg.payload.get("boom"):
+            raise RuntimeError("handler bug")
+        return Message(MessageType.ACK, "h1", {"ok": True})
+
+    tb.serve("store", handler)
+    ip, tcp_port, _ = tb._addr_of("h1")
+
+    # 1. valid header, garbage body → Message.from_bytes raises server-side
+    with socket.create_connection((ip, tcp_port), timeout=2.0) as s:
+        body = b"\xff\xfenot-a-message"
+        s.sendall(struct.pack(">HI", 5, len(body)) + b"store" + body)
+        s.shutdown(socket.SHUT_WR)
+        assert s.recv(1) == b""          # server dropped the connection
+
+    # 2. handler raises → this client sees a close (call returns None)
+    assert ta.call("h1", "store",
+                   Message(MessageType.PUT, "h0", {"boom": True})) is None
+
+    # 3. the listener survived both: a good call still round-trips
+    out = ta.call("h1", "store", Message(MessageType.PUT, "h0", {}))
+    assert out is not None and out.payload == {"ok": True}
+    assert calls["n"] == 2
+
+    # 4. same invariant on the UDP loop (it carries every heartbeat:
+    # a handler bug there must not silently kill failure detection)
+    seen = threading.Event()
+
+    def udp_handler(svc, m):
+        if m.payload.get("boom"):
+            raise RuntimeError("udp handler bug")
+        seen.set()
+
+    tb.serve("membership", udp_handler)
+    ta.datagram("h1", "membership",
+                Message(MessageType.PING, "h0", {"boom": True}))
+    ta.datagram("h1", "membership", Message(MessageType.PING, "h0", {}))
+    assert seen.wait(timeout=2.0), "UDP loop died on a handler exception"
