@@ -65,9 +65,11 @@ HELP = """\
         draft pools: greedy token-exact, sampled distribution-exact;
         place=1 = cluster-managed: master-placed, requests journaled to
         the standby, pool+requests recovered if its node dies)
-  lm-submit <name> <max_new> [temperature= top_p= top_k= seed=] <tok> [tok ...]
+  lm-submit <name> <max_new> [temperature= top_p= top_k=
+       presence_penalty= frequency_penalty= seed=] <tok> [tok ...]
        queue a prompt -> request id (temperature 0=greedy, >0 sampled;
-       top_p<1 = nucleus, top_k>0 = k most probable first)
+       top_p<1 = nucleus, top_k>0 = k most probable first; penalties
+       need a penalties=1 pool)
   lm-poll <name> | lm-stats <name> | lm-stop <name>
        fetch completions / occupancy+token counters / stop
   lm-cancel <name> <id>   best-effort cancel (live rows return partials)
@@ -398,7 +400,7 @@ class Shell:
         if len(args) < 3:
             return ("usage: lm-serve <name> <prompt_len> <max_len> "
                     "[slots= decode_steps= quantize=int8 "
-                    "kv_cache_dtype=int8 eos_id=N logprobs=1 "
+                    "kv_cache_dtype=int8 eos_id=N logprobs=1 penalties=1 "
                     "draft=<lm> draft_len=N place=1 reload=1]\n"
                     "note: draft (speculative) pools serve greedy "
                     "requests token-exact and sampled requests "
@@ -420,6 +422,9 @@ class Shell:
             payload["placement"] = "auto"
         if "logprobs" in kv:
             payload["track_logprobs"] = kv.pop("logprobs") not in (
+                "0", "false", "")
+        if "penalties" in kv:
+            payload["penalties"] = kv.pop("penalties") not in (
                 "0", "false", "")
         if "reload" in kv:
             payload["reload"] = kv.pop("reload") not in ("0", "false", "")
@@ -446,6 +451,9 @@ class Shell:
             payload["top_p"] = float(kv.pop("top_p"))
         if "top_k" in kv:
             payload["top_k"] = int(kv.pop("top_k"))
+        for pk in ("presence_penalty", "frequency_penalty"):
+            if pk in kv:
+                payload[pk] = float(kv.pop(pk))
         if "seed" in kv:
             payload["seed"] = int(kv.pop("seed"))
         if kv:

@@ -44,10 +44,13 @@ def init_cache(model: TransformerLM, batch: int, max_len: int) -> Any:
 
 @partial(jax.jit,
          static_argnames=("model", "prompt_len", "max_new", "temperature",
-                          "top_p", "top_k"))
+                          "top_p", "top_k", "presence_penalty",
+                          "frequency_penalty"))
 def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
              prompt_len: int, max_new: int, *, temperature: float = 0.0,
              top_p: float = 1.0, top_k: int = 0,
+             presence_penalty: float = 0.0,
+             frequency_penalty: float = 0.0,
              rng: jax.Array | None = None,
              prompt_lens: jnp.ndarray | None = None) -> jnp.ndarray:
     """Generate ``max_new`` tokens after ``prompt[:, :prompt_len]``.
@@ -59,6 +62,11 @@ def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
     temperature); ``top_k`` > 0 first restricts to the k most probable
     tokens (standard warper order: top-k, then nucleus over the
     renormalized top-k distribution — `ops.sampling.filtered_probs`).
+    ``presence_penalty``/``frequency_penalty`` subtract
+    ``presence·1[count>0] + frequency·count`` from every token's raw
+    logit, where count is over this row's GENERATED tokens only (prompt
+    tokens are not penalized — vLLM semantics); applied before
+    temperature/filters and to greedy picks alike.
 
     Ragged batches: pass ``prompt_lens`` (int [B], 1 ≤ len ≤ prompt_len)
     with right-padded prompts — each row is teacher-forced only through its
@@ -82,12 +90,19 @@ def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
     plens = (jnp.full((b,), prompt_len, jnp.int32) if prompt_lens is None
              else prompt_lens.astype(jnp.int32))
 
+    penalized = presence_penalty != 0.0 or frequency_penalty != 0.0
+    counts0 = jnp.zeros((b, model.vocab if penalized else 0), jnp.int32)
+
     def step(t, carry):
-        tokens, cache, rng = carry
+        tokens, cache, rng, counts = carry
         tok = jax.lax.dynamic_slice(tokens, (0, t), (b, 1))  # current input
         logits, mutated = dec.apply({"params": params, "cache": cache},
                                     tok, mutable=["cache"])
         logits = logits[:, 0]                                # [B, vocab]
+        if penalized:   # static: counts over generated tokens only
+            logits = (logits
+                      - presence_penalty * (counts > 0)
+                      - frequency_penalty * counts.astype(logits.dtype))
         if temperature > 0.0:
             scaled = logits / temperature
             if top_p < 1.0 or top_k > 0:
@@ -111,10 +126,13 @@ def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
         nxt = jnp.where(keep_prompt, cur, nxt.astype(jnp.int32))
         tokens = jax.lax.dynamic_update_slice(
             tokens, nxt[:, None], (0, write_at))
-        return tokens, mutated["cache"], rng
+        if penalized:   # teacher-forced (prompt) tokens never count
+            counts = counts.at[jnp.arange(b), nxt].add(
+                jnp.where(keep_prompt, 0, 1))
+        return tokens, mutated["cache"], rng, counts
 
-    tokens, _, _ = jax.lax.fori_loop(0, total - 1, step,
-                                     (tokens, cache, rng))
+    tokens, _, _, _ = jax.lax.fori_loop(0, total - 1, step,
+                                        (tokens, cache, rng, counts0))
     return tokens
 
 

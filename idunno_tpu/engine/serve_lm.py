@@ -61,6 +61,8 @@ class Request:
     temperature: float = 0.0
     top_p: float = 1.0
     top_k: int = 0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
     seed: int | None = None
     t_admit: float = 0.0       # monotonic stamp set at slot admission
 
@@ -301,7 +303,8 @@ class DecodeServer:
                  mesh=None, draft: tuple | None = None,
                  draft_len: int = 4,
                  prompt_buckets: tuple[int, ...] | None = None,
-                 track_logprobs: bool = False) -> None:
+                 track_logprobs: bool = False,
+                 penalties: bool = False) -> None:
         if not model.causal:
             raise ValueError("continuous batching needs a causal LM")
         if prompt_len > max_len:
@@ -362,6 +365,17 @@ class DecodeServer:
         # (untempered, unfiltered — sampler-independent semantics) is
         # recorded and returned on the Completion
         self.track_logprobs = bool(track_logprobs)
+        # compile-time flag for presence/frequency penalties (a [S, vocab]
+        # generated-token count buffer + a scatter-add per step; zero cost
+        # when off). Speculative pools cannot honor them: a verify chunk's
+        # later positions would need counts that include tokens committed
+        # EARLIER in the same chunk, which depend on acceptance — a
+        # sequential dependency the parallel verify cannot express.
+        self.penalties = bool(penalties)
+        if self.penalties and draft is not None:
+            raise ValueError(
+                "penalties are not supported on speculative pools "
+                "(count-dependent logits break the parallel verify)")
         self.model = model
         self.params = params
         self.slots = slots
@@ -432,6 +446,11 @@ class DecodeServer:
         self._logprobs = (zeros((slots, max_len), jnp.float32)
                           if self.track_logprobs
                           else jnp.zeros((slots, 0), jnp.float32))
+        self._pres = zeros((slots,), jnp.float32)
+        self._freq = zeros((slots,), jnp.float32)
+        self._counts = (zeros((slots, model.vocab), jnp.int32)
+                        if self.penalties
+                        else jnp.zeros((slots, 0), jnp.int32))
         self._draft_cache = None
         if self._draft_model is not None:
             ddec = self._per_row_decode(self._draft_model)
@@ -472,13 +491,15 @@ class DecodeServer:
     def _build_decode(self, n_steps: int):
         dec = self._dec
         track = self.track_logprobs     # static: traced once
+        pen = self.penalties            # static: traced once
 
         def run(params, tokens, cache, cursors, remaining, temps,
-                top_ps, top_ks, keys, logprobs):
+                top_ps, top_ks, keys, logprobs, pres, freq, counts):
             params = dequantize_tree(params)   # int8 stays HBM-resident
 
             def body(_, carry):
-                tokens, cache, cursors, remaining, keys, logprobs = carry
+                (tokens, cache, cursors, remaining, keys, logprobs,
+                 counts) = carry
                 active = remaining > 0
                 cache = _set_cursors(cache, cursors)
                 tok = jnp.take_along_axis(tokens, cursors[:, None], axis=1)
@@ -490,6 +511,9 @@ class DecodeServer:
                 # stay independent of co-resident rows and of admissions)
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
                 l = logits[:, 0]
+                if pen:   # counts cover this row's GENERATED tokens only
+                    l = (l - pres[:, None] * (counts > 0)
+                         - freq[:, None] * counts.astype(l.dtype))
                 scaled = l / jnp.maximum(temps, 1e-6)[:, None]
                 # the full-vocab sort+cumsum only runs when some live row
                 # actually asked for a filter; inside that branch the
@@ -513,8 +537,10 @@ class DecodeServer:
                 tokens = tokens.at[rows, wpos].set(
                     jnp.where(active, nxt, old))
                 if track:
-                    lp_all = jax.nn.log_softmax(l.astype(jnp.float32),
-                                                axis=-1)
+                    # logprobs report the RAW model distribution even on
+                    # penalized rows (sampler-independent semantics)
+                    lp_all = jax.nn.log_softmax(
+                        logits[:, 0].astype(jnp.float32), axis=-1)
                     lp = jnp.take_along_axis(
                         lp_all, nxt[:, None], axis=1)[:, 0]
                     lp_old = jnp.take_along_axis(
@@ -527,11 +553,16 @@ class DecodeServer:
                     new_remaining = jnp.where(nxt == self.eos_id, 0,
                                               new_remaining)
                 remaining = jnp.where(active, new_remaining, remaining)
-                return tokens, cache, cursors, remaining, keys, logprobs
+                if pen:
+                    counts = counts.at[rows, nxt].add(
+                        jnp.where(active, 1, 0))
+                return (tokens, cache, cursors, remaining, keys, logprobs,
+                        counts)
 
             return jax.lax.fori_loop(
                 0, n_steps, body,
-                (tokens, cache, cursors, remaining, keys, logprobs))
+                (tokens, cache, cursors, remaining, keys, logprobs,
+                 counts))
 
         # donate the decode state (tokens/cache/cursors/remaining/keys/
         # logprobs): the KV cache is by far the largest buffer and every
@@ -540,7 +571,7 @@ class DecodeServer:
         # donation and would warn.) temps/top_ps/top_ks are read-only and
         # not donated.
         if jax.devices()[0].platform == "tpu":
-            return jax.jit(run, donate_argnums=(1, 2, 3, 4, 8, 9))
+            return jax.jit(run, donate_argnums=(1, 2, 3, 4, 8, 9, 12))
         return jax.jit(run)
 
     def _build_spec_round(self, gamma: int, rounds: int = 1):
@@ -700,7 +731,8 @@ class DecodeServer:
 
     def validate(self, tokens: list[int], max_new: int,
                  temperature: float = 0.0, top_p: float = 1.0,
-                 top_k: int = 0) -> None:
+                 top_k: int = 0, presence_penalty: float = 0.0,
+                 frequency_penalty: float = 0.0) -> None:
         """Raise ValueError if the request can't fit this server's static
         buckets; shared by every submission front-end (the RPC serving
         loop validates on the caller's thread with this)."""
@@ -732,22 +764,32 @@ class DecodeServer:
             raise ValueError(f"top_p {top_p} must be in (0, 1]")
         if top_k < 0 or top_k != int(top_k):
             raise ValueError(f"top_k {top_k} must be a non-negative int")
+        if (presence_penalty or frequency_penalty) and not self.penalties:
+            raise ValueError(
+                "this pool was built without penalties=True; "
+                "presence/frequency penalties need the count buffer")
 
     def submit(self, tokens: list[int], max_new: int, *,
                temperature: float = 0.0, top_p: float = 1.0,
-               top_k: int = 0, seed: int | None = None) -> int:
+               top_k: int = 0, presence_penalty: float = 0.0,
+               frequency_penalty: float = 0.0,
+               seed: int | None = None) -> int:
         """Queue a prompt; returns the request id. ``temperature`` 0 =
         greedy; > 0 samples with a per-request stream seeded by ``seed``
         (default: the request id); ``top_p`` < 1 restricts sampling to
         the nucleus and ``top_k`` > 0 to the k most probable tokens
         (k-filter first, then nucleus), exactly as in `engine.generate`."""
-        self.validate(tokens, max_new, temperature, top_p, top_k)
+        self.validate(tokens, max_new, temperature, top_p, top_k,
+                      presence_penalty, frequency_penalty)
         rid = self._next_id
         self._next_id += 1
         self._queue.append(Request(id=rid, tokens=list(tokens),
                                    max_new=max_new,
                                    temperature=temperature, top_p=top_p,
-                                   top_k=int(top_k), seed=seed))
+                                   top_k=int(top_k),
+                                   presence_penalty=float(presence_penalty),
+                                   frequency_penalty=float(frequency_penalty),
+                                   seed=seed))
         return rid
 
     def poll(self) -> list[Completion]:
@@ -819,6 +861,7 @@ class DecodeServer:
             "kv_cache_dtype": m.kv_cache_dtype,
             "quantize": self.quantize,
             "track_logprobs": self.track_logprobs,
+            "penalties": self.penalties,
             "decode_steps": self.decode_steps,
             "prompt_len": self.prompt_len, "max_len": self.max_len,
             "speculative_draft_len": (self.draft_len
@@ -894,6 +937,15 @@ class DecodeServer:
                 lp0 = jax.nn.log_softmax(
                     last_logits.astype(jnp.float32))[first]
                 self._logprobs = self._logprobs.at[slot, true_len].set(lp0)
+            if self.penalties:   # fresh row; the first token counts.
+                # validate() guarantees zero penalties off-flag, so the
+                # buffers are only ever touched when the kernel reads them
+                self._pres = self._pres.at[slot].set(
+                    jnp.float32(req.presence_penalty))
+                self._freq = self._freq.at[slot].set(
+                    jnp.float32(req.frequency_penalty))
+                self._counts = self._counts.at[slot].set(0)
+                self._counts = self._counts.at[slot, first].set(1)
             rem = req.max_new - 1
             if self.eos_id is not None and int(first) == self.eos_id:
                 rem = 0                   # the prompt's very next token
@@ -925,11 +977,12 @@ class DecodeServer:
                     self._top_ks, self._keys, self._logprobs)
             else:
                 (self._tokens, self._cache, self._cursors,
-                 self._remaining, self._keys,
-                 self._logprobs) = self._decode(
+                 self._remaining, self._keys, self._logprobs,
+                 self._counts) = self._decode(
                     self.params, self._tokens, self._cache, self._cursors,
                     self._remaining, self._temps, self._top_ps,
-                    self._top_ks, self._keys, self._logprobs)
+                    self._top_ks, self._keys, self._logprobs,
+                    self._pres, self._freq, self._counts)
             self._stats["dispatches"] += 1
             self._retire_finished()
         return len(self._live) + len(self._queue)

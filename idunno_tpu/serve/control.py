@@ -259,6 +259,7 @@ class ControlService:
                     decode_steps=int(p.get("decode_steps", 1)),
                     quantize=p.get("quantize", "none"),
                     track_logprobs=bool(p.get("track_logprobs", False)),
+                    penalties=bool(p.get("penalties", False)),
                     eos_id=(int(p["eos_id"])
                             if p.get("eos_id") is not None else None),
                     draft=draft,
@@ -284,6 +285,8 @@ class ControlService:
                 temperature=float(p.get("temperature", 0.0)),
                 top_p=float(p.get("top_p", 1.0)),
                 top_k=int(p.get("top_k", 0)),
+                presence_penalty=float(p.get("presence_penalty", 0.0)),
+                frequency_penalty=float(p.get("frequency_penalty", 0.0)),
                 seed=(int(p["seed"]) if p.get("seed") is not None
                       else None))
             return {"id": rid}
@@ -408,6 +411,10 @@ class ControlService:
                                  int(p["max_new"]),
                                  top_p=float(p.get("top_p", 1.0)),
                                  top_k=int(p.get("top_k", 0)),
+                                 presence_penalty=float(
+                                     p.get("presence_penalty", 0.0)),
+                                 frequency_penalty=float(
+                                     p.get("frequency_penalty", 0.0)),
                                  temperature=float(
                                      p.get("temperature", 0.0)),
                                  seed=(int(p["seed"])

@@ -239,7 +239,9 @@ class LMPoolManager:
 
     def submit(self, name: str, prompt: list[int], max_new: int,
                temperature: float = 0.0, top_p: float = 1.0,
-               top_k: int = 0, seed: int | None = None) -> int:
+               top_k: int = 0, presence_penalty: float = 0.0,
+               frequency_penalty: float = 0.0,
+               seed: int | None = None) -> int:
         """Journal a request (seed pinned NOW — replay after any failure
         must be token-exact even for sampled requests), then forward it to
         the pool's node. Forward failures leave it pending; the pump
@@ -256,6 +258,8 @@ class LMPoolManager:
                    "temperature": float(temperature),
                    "top_p": float(top_p),
                    "top_k": int(top_k),
+                   "presence_penalty": float(presence_penalty),
+                   "frequency_penalty": float(frequency_penalty),
                    "seed": int(seed) if seed is not None else rid,
                    "status": _PENDING, "node_id": None,
                    "tokens": None, "prompt_len": None, "delivered": False,
@@ -275,7 +279,10 @@ class LMPoolManager:
                 "prompt": req["prompt"], "max_new": req["max_new"],
                 "temperature": req["temperature"],
                 "top_p": req.get("top_p", 1.0),
-                "top_k": req.get("top_k", 0), "seed": req["seed"]})
+                "top_k": req.get("top_k", 0),
+                "presence_penalty": req.get("presence_penalty", 0.0),
+                "frequency_penalty": req.get("frequency_penalty", 0.0),
+                "seed": req["seed"]})
         except (TransportError, OSError):
             return                      # stays pending; pump will retry
         except ValueError as e:

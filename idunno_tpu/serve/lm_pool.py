@@ -26,9 +26,9 @@ class LMServingLoop:
     def __init__(self, server: DecodeServer, name: str = "lm") -> None:
         self.server = server
         self._lock = threading.Lock()
-        # (id, toks, max_new, temperature, top_p, top_k, seed)
+        # (id, toks, max_new, temperature, top_p, top_k, pres, freq, seed)
         self._inbox: list[
-            tuple[int, list[int], int, float, float, int,
+            tuple[int, list[int], int, float, float, int, float, float,
                   int | None]] = []
         self._outbox: list[Completion] = []
         self._next_id = 0
@@ -52,13 +52,16 @@ class LMServingLoop:
 
     def submit(self, tokens: list[int], max_new: int, *,
                temperature: float = 0.0, top_p: float = 1.0,
-               top_k: int = 0, seed: int | None = None) -> int:
+               top_k: int = 0, presence_penalty: float = 0.0,
+               frequency_penalty: float = 0.0,
+               seed: int | None = None) -> int:
         """Validate + queue a prompt; returns the public request id.
         Raises once the pool is stopped — a submit racing `stop()` must
         error loudly, not return an id that never completes."""
         # validate eagerly on the caller's thread so the RPC gets the error
         # (the loop thread has nowhere to raise to)
-        self.server.validate(tokens, max_new, temperature, top_p, top_k)
+        self.server.validate(tokens, max_new, temperature, top_p, top_k,
+                             presence_penalty, frequency_penalty)
         with self._lock:
             # checked under the lock: stop() sets the flag BEFORE its own
             # locked inbox drain, so an append here either precedes the
@@ -68,7 +71,8 @@ class LMServingLoop:
             rid = self._next_id
             self._next_id += 1
             self._inbox.append((rid, list(tokens), max_new,
-                                temperature, top_p, top_k, seed))
+                                temperature, top_p, top_k,
+                                presence_penalty, frequency_penalty, seed))
         self._wake.set()
         return rid
 
@@ -149,11 +153,12 @@ class LMServingLoop:
     def _drain_inbox(self) -> None:
         with self._lock:
             batch, self._inbox = self._inbox, []
-        for rid, tokens, max_new, temperature, top_p, top_k, seed \
-                in batch:
+        for (rid, tokens, max_new, temperature, top_p, top_k, pres,
+             freq, seed) in batch:
             sid = self.server.submit(tokens, max_new,
                                      temperature=temperature, top_p=top_p,
-                                     top_k=top_k,
+                                     top_k=top_k, presence_penalty=pres,
+                                     frequency_penalty=freq,
                                      seed=rid if seed is None else seed)
             # under the lock: cancel() iterates this map from RPC threads
             with self._lock:

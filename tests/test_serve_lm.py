@@ -554,6 +554,56 @@ def test_logprobs_tracking(lm):
         loop.stop()
 
 
+def test_presence_frequency_penalties(lm):
+    """Penalties on a penalties=True pool: a penalized greedy stream is
+    token-exact vs `generate` with the same penalties (the count
+    bookkeeping agrees across tiers), a huge frequency penalty forbids
+    any repeat, co-resident unpenalized rows are untouched, sampled
+    penalized streams are seed-reproducible, and the flag/spec guards
+    reject what they must."""
+    model, params = lm
+    prompt = [3, 1, 4]
+
+    def gen(max_new=12, **kw):
+        out = generate(model, params, jnp.asarray([prompt], jnp.int32),
+                       prompt_len=3, max_new=max_new, **kw)
+        return [int(t) for t in np.asarray(out[0])]
+
+    srv = DecodeServer(model, params, slots=2, prompt_len=4, max_len=24,
+                       penalties=True)
+    r_pen = srv.submit(prompt, max_new=12, frequency_penalty=1e9)
+    r_plain = srv.submit(prompt, max_new=12)
+    done = {c.id: c for c in srv.run_until_drained()}
+    assert done[r_pen].tokens == gen(frequency_penalty=1e9)
+    g = done[r_pen].tokens[3:]
+    assert len(set(g)) == len(g), "huge frequency penalty must forbid repeats"
+    assert done[r_plain].tokens == expected(model, params, prompt, 12)
+
+    # presence penalty: also cross-tier exact (different formula branch)
+    srv2 = DecodeServer(model, params, slots=1, prompt_len=4, max_len=24,
+                        penalties=True)
+    srv2.submit(prompt, max_new=10, presence_penalty=2.5)
+    assert srv2.run_until_drained()[0].tokens == gen(
+        max_new=10, presence_penalty=2.5)
+
+    def sampled(seed):
+        s3 = DecodeServer(model, params, slots=1, prompt_len=4,
+                          max_len=24, penalties=True)
+        rid = s3.submit(prompt, max_new=10, temperature=1.1,
+                        frequency_penalty=0.7, seed=seed)
+        return {c.id: c for c in s3.run_until_drained()}[rid].tokens
+
+    assert sampled(11) == sampled(11)
+
+    # guards: penalized request needs the flag; spec pools reject the flag
+    off = DecodeServer(model, params, slots=1, prompt_len=4, max_len=24)
+    with pytest.raises(ValueError, match="penalties"):
+        off.submit(prompt, max_new=4, presence_penalty=0.5)
+    with pytest.raises(ValueError, match="speculative"):
+        DecodeServer(model, params, slots=1, prompt_len=4, max_len=24,
+                     penalties=True, draft=(model, params))
+
+
 def test_filtered_probs_top_k():
     """filtered_probs: top_k keeps the k most probable (renormalized),
     composes with the nucleus over the RENORMALIZED top-k distribution,
