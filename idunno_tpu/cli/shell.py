@@ -66,10 +66,11 @@ HELP = """\
         place=1 = cluster-managed: master-placed, requests journaled to
         the standby, pool+requests recovered if its node dies)
   lm-submit <name> <max_new> [temperature= top_p= top_k=
-       presence_penalty= frequency_penalty= seed=] <tok> [tok ...]
+       presence_penalty= frequency_penalty= stop=1,2;9 seed=]
+       <tok> [tok ...]
        queue a prompt -> request id (temperature 0=greedy, >0 sampled;
        top_p<1 = nucleus, top_k>0 = k most probable first; penalties
-       need a penalties=1 pool)
+       need a penalties=1 pool; stop = token sequences, ';'-separated)
   lm-poll <name> | lm-stats <name> | lm-stop <name>
        fetch completions / occupancy+token counters / stop
   lm-cancel <name> <id>   best-effort cancel (live rows return partials)
@@ -441,7 +442,8 @@ class Shell:
     def cmd_lm_submit(self, args: list[str]) -> str:
         if len(args) < 3:
             return ("usage: lm-submit <name> <max_new> "
-                    "[temperature= top_p= top_k= seed=] <tok> [tok ...]")
+                    "[temperature= top_p= top_k= presence_penalty= "
+                    "frequency_penalty= stop=1,2;9 seed=] <tok> [tok ...]")
         kv = self._kv([a for a in args[2:] if "=" in a])
         toks = [int(t) for t in args[2:] if "=" not in t]
         payload = {}
@@ -454,6 +456,9 @@ class Shell:
         for pk in ("presence_penalty", "frequency_penalty"):
             if pk in kv:
                 payload[pk] = float(kv.pop(pk))
+        if "stop" in kv:   # stop=1,2;9 -> sequences [1,2] and [9]
+            payload["stop"] = [[int(t) for t in seq.split(",") if t]
+                               for seq in kv.pop("stop").split(";") if seq]
         if "seed" in kv:
             payload["seed"] = int(kv.pop("seed"))
         if kv:

@@ -63,6 +63,11 @@ class Request:
     top_k: int = 0
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    # token-level stop sequences over the GENERATED region; like eos_id,
+    # a matched stop sequence is KEPT in the output and the row retires
+    # at its final token (host-side detection at dispatch boundaries, so
+    # up to decode_steps-1 overshoot tokens are computed then discarded)
+    stop: list[list[int]] | None = None
     seed: int | None = None
     t_admit: float = 0.0       # monotonic stamp set at slot admission
 
@@ -732,7 +737,8 @@ class DecodeServer:
     def validate(self, tokens: list[int], max_new: int,
                  temperature: float = 0.0, top_p: float = 1.0,
                  top_k: int = 0, presence_penalty: float = 0.0,
-                 frequency_penalty: float = 0.0) -> None:
+                 frequency_penalty: float = 0.0,
+                 stop: list[list[int]] | None = None) -> None:
         """Raise ValueError if the request can't fit this server's static
         buckets; shared by every submission front-end (the RPC serving
         loop validates on the caller's thread with this)."""
@@ -768,11 +774,19 @@ class DecodeServer:
             raise ValueError(
                 "this pool was built without penalties=True; "
                 "presence/frequency penalties need the count buffer")
+        for seq in stop or ():
+            if not seq:
+                raise ValueError("empty stop sequence")
+            for t in seq:
+                if not 0 <= t < self.model.vocab:
+                    raise ValueError(f"stop token {t} outside vocab "
+                                     f"[0, {self.model.vocab})")
 
     def submit(self, tokens: list[int], max_new: int, *,
                temperature: float = 0.0, top_p: float = 1.0,
                top_k: int = 0, presence_penalty: float = 0.0,
                frequency_penalty: float = 0.0,
+               stop: list[list[int]] | None = None,
                seed: int | None = None) -> int:
         """Queue a prompt; returns the request id. ``temperature`` 0 =
         greedy; > 0 samples with a per-request stream seeded by ``seed``
@@ -780,7 +794,7 @@ class DecodeServer:
         the nucleus and ``top_k`` > 0 to the k most probable tokens
         (k-filter first, then nucleus), exactly as in `engine.generate`."""
         self.validate(tokens, max_new, temperature, top_p, top_k,
-                      presence_penalty, frequency_penalty)
+                      presence_penalty, frequency_penalty, stop)
         rid = self._next_id
         self._next_id += 1
         self._queue.append(Request(id=rid, tokens=list(tokens),
@@ -789,6 +803,8 @@ class DecodeServer:
                                    top_k=int(top_k),
                                    presence_penalty=float(presence_penalty),
                                    frequency_penalty=float(frequency_penalty),
+                                   stop=([list(q) for q in stop]
+                                         if stop else None),
                                    seed=seed))
         return rid
 
@@ -956,6 +972,46 @@ class DecodeServer:
             # _retire_finished pass (step() runs one post-admission) retires
             # the row before any decode dispatch
 
+    def _apply_stops(self) -> None:
+        """Host-side stop-sequence pass (after a dispatch, before
+        retirement): for each live row that asked for stop sequences,
+        scan its GENERATED tokens for the earliest-ending match and
+        truncate the row there — cursor moved back to the match's last
+        token, remaining zeroed, so the normal retire pass completes it
+        (a truncated row is retired before any further scan). Tokens
+        decoded past the stop inside the same dispatch are discarded.
+        The stop sequence itself is KEPT in the output, like eos_id.
+
+        Each pass scans only the tokens a single dispatch can have added
+        (plus a max-seq-1 overlap), so the per-dispatch host cost is
+        O(new tokens), statelessly: any match wholly inside the
+        previously-scanned region was caught by an earlier pass."""
+        stops = {slot: req.stop for slot, req in self._live.items()
+                 if req.stop}
+        if not stops:
+            return
+        bound = self.decode_steps * (
+            self.draft_len + 1 if self._draft_model is not None else 1)
+        cursors = np.asarray(self._cursors)
+        for slot, seqs in stops.items():
+            gen_start = len(self._live[slot].tokens)
+            end = int(cursors[slot]) + 1
+            overlap = max(len(q) for q in seqs) - 1
+            lo = max(gen_start, end - bound - overlap)
+            row = np.asarray(self._tokens[slot])[:end].tolist()
+            best = None                      # earliest END of any match
+            for seq in seqs:
+                n = len(seq)
+                for at in range(lo, end - n + 1):
+                    if row[at:at + n] == list(seq):
+                        best = at + n if best is None else min(best,
+                                                               at + n)
+                        break                # earliest for THIS seq found
+            if best is None:
+                continue
+            self._cursors = self._cursors.at[slot].set(best - 1)
+            self._remaining = self._remaining.at[slot].set(0)
+
     def step(self) -> int:
         """Retire finished rows, admit queued prompts into free slots, run
         one decode dispatch (``decode_steps`` tokens — or speculative
@@ -984,6 +1040,7 @@ class DecodeServer:
                     self._top_ks, self._keys, self._logprobs,
                     self._pres, self._freq, self._counts)
             self._stats["dispatches"] += 1
+            self._apply_stops()
             self._retire_finished()
         return len(self._live) + len(self._queue)
 

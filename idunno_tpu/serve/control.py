@@ -287,6 +287,8 @@ class ControlService:
                 top_k=int(p.get("top_k", 0)),
                 presence_penalty=float(p.get("presence_penalty", 0.0)),
                 frequency_penalty=float(p.get("frequency_penalty", 0.0)),
+                stop=([[int(t) for t in q] for q in p["stop"]]
+                      if p.get("stop") else None),
                 seed=(int(p["seed"]) if p.get("seed") is not None
                       else None))
             return {"id": rid}
@@ -415,6 +417,9 @@ class ControlService:
                                      p.get("presence_penalty", 0.0)),
                                  frequency_penalty=float(
                                      p.get("frequency_penalty", 0.0)),
+                                 stop=([[int(t) for t in q]
+                                        for q in p["stop"]]
+                                       if p.get("stop") else None),
                                  temperature=float(
                                      p.get("temperature", 0.0)),
                                  seed=(int(p["seed"])

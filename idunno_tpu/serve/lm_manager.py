@@ -241,6 +241,7 @@ class LMPoolManager:
                temperature: float = 0.0, top_p: float = 1.0,
                top_k: int = 0, presence_penalty: float = 0.0,
                frequency_penalty: float = 0.0,
+               stop: list[list[int]] | None = None,
                seed: int | None = None) -> int:
         """Journal a request (seed pinned NOW — replay after any failure
         must be token-exact even for sampled requests), then forward it to
@@ -260,6 +261,8 @@ class LMPoolManager:
                    "top_k": int(top_k),
                    "presence_penalty": float(presence_penalty),
                    "frequency_penalty": float(frequency_penalty),
+                   "stop": ([[int(t) for t in q] for q in stop]
+                            if stop else None),
                    "seed": int(seed) if seed is not None else rid,
                    "status": _PENDING, "node_id": None,
                    "tokens": None, "prompt_len": None, "delivered": False,
@@ -282,6 +285,7 @@ class LMPoolManager:
                 "top_k": req.get("top_k", 0),
                 "presence_penalty": req.get("presence_penalty", 0.0),
                 "frequency_penalty": req.get("frequency_penalty", 0.0),
+                "stop": req.get("stop"),
                 "seed": req["seed"]})
         except (TransportError, OSError):
             return                      # stays pending; pump will retry

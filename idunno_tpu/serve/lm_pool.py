@@ -26,10 +26,9 @@ class LMServingLoop:
     def __init__(self, server: DecodeServer, name: str = "lm") -> None:
         self.server = server
         self._lock = threading.Lock()
-        # (id, toks, max_new, temperature, top_p, top_k, pres, freq, seed)
-        self._inbox: list[
-            tuple[int, list[int], int, float, float, int, float, float,
-                  int | None]] = []
+        # (id, toks, max_new, temperature, top_p, top_k, pres, freq,
+        #  stop, seed)
+        self._inbox: list[tuple] = []
         self._outbox: list[Completion] = []
         self._next_id = 0
         self._id_map: dict[int, int] = {}     # server-side id → public id
@@ -54,6 +53,7 @@ class LMServingLoop:
                temperature: float = 0.0, top_p: float = 1.0,
                top_k: int = 0, presence_penalty: float = 0.0,
                frequency_penalty: float = 0.0,
+               stop: list[list[int]] | None = None,
                seed: int | None = None) -> int:
         """Validate + queue a prompt; returns the public request id.
         Raises once the pool is stopped — a submit racing `stop()` must
@@ -61,7 +61,7 @@ class LMServingLoop:
         # validate eagerly on the caller's thread so the RPC gets the error
         # (the loop thread has nowhere to raise to)
         self.server.validate(tokens, max_new, temperature, top_p, top_k,
-                             presence_penalty, frequency_penalty)
+                             presence_penalty, frequency_penalty, stop)
         with self._lock:
             # checked under the lock: stop() sets the flag BEFORE its own
             # locked inbox drain, so an append here either precedes the
@@ -72,7 +72,8 @@ class LMServingLoop:
             self._next_id += 1
             self._inbox.append((rid, list(tokens), max_new,
                                 temperature, top_p, top_k,
-                                presence_penalty, frequency_penalty, seed))
+                                presence_penalty, frequency_penalty,
+                                stop, seed))
         self._wake.set()
         return rid
 
@@ -154,11 +155,11 @@ class LMServingLoop:
         with self._lock:
             batch, self._inbox = self._inbox, []
         for (rid, tokens, max_new, temperature, top_p, top_k, pres,
-             freq, seed) in batch:
+             freq, stop, seed) in batch:
             sid = self.server.submit(tokens, max_new,
                                      temperature=temperature, top_p=top_p,
                                      top_k=top_k, presence_penalty=pres,
-                                     frequency_penalty=freq,
+                                     frequency_penalty=freq, stop=stop,
                                      seed=rid if seed is None else seed)
             # under the lock: cancel() iterates this map from RPC threads
             with self._lock:

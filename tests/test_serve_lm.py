@@ -554,6 +554,58 @@ def test_logprobs_tracking(lm):
         loop.stop()
 
 
+def test_stop_sequences(lm):
+    """Token-level stop sequences: the completion is the exact greedy
+    rollout truncated at (and including) the earliest stop match in the
+    GENERATED region; multi-sequence picks the earliest end; prompt-side
+    occurrences don't count; works on speculative pools (host-side
+    detection is mechanism-independent); unmatched stop = full length."""
+    model, params = lm
+    prompt = [9, 21, 3]
+    full = expected(model, params, prompt, 12)
+    gen = full[len(prompt):]
+
+    # a 2-token stop that genuinely occurs mid-stream
+    stop2 = [gen[4], gen[5]]
+    want = full[:len(prompt) + 6]          # kept through the match
+
+    def serve(stop, draft=None, max_new=12):
+        kw = {}
+        if draft is not None:
+            kw = dict(draft=draft, draft_len=3)
+        srv = DecodeServer(model, params, slots=2, prompt_len=4,
+                           max_len=48, **kw)
+        rid = srv.submit(prompt, max_new=max_new, stop=stop)
+        other = srv.submit(prompt, max_new=max_new)    # no-stop co-resident
+        done = {c.id: c for c in srv.run_until_drained()}
+        return done[rid].tokens, done[other].tokens
+
+    got, other = serve([stop2])
+    assert got == want, (got, want)
+    assert other == full                   # co-resident unaffected
+
+    # earliest-end wins across sequences (a later 1-token match loses)
+    got2, _ = serve([[gen[8]], stop2])
+    assert got2 == want
+
+    # prompt occurrences don't count: a stop matching a PROMPT token that
+    # never appears in the generated region must not truncate anything
+    # (falls back to any unused token if the whole prompt reappears)
+    loner = next((t for t in prompt if t not in gen),
+                 next(t for t in range(VOCAB) if t not in gen))
+    got3, _ = serve([[loner]])
+    assert got3 == full
+
+    # speculative pool: same truncated stream
+    got4, other4 = serve([stop2], draft=(model, params))
+    assert got4 == want and other4 == full
+
+    with pytest.raises(ValueError, match="empty stop"):
+        serve([[]])
+    with pytest.raises(ValueError, match="stop token"):
+        serve([[VOCAB + 7]])
+
+
 def test_presence_frequency_penalties(lm):
     """Penalties on a penalties=True pool: a penalized greedy stream is
     token-exact vs `generate` with the same penalties (the count
