@@ -402,6 +402,7 @@ class Shell:
             return ("usage: lm-serve <name> <prompt_len> <max_len> "
                     "[slots= decode_steps= quantize=int8 "
                     "kv_cache_dtype=int8 eos_id=N logprobs=1 penalties=1 "
+                    "prefix=7,2,19 "
                     "draft=<lm> draft_len=N place=1 reload=1]\n"
                     "note: draft (speculative) pools serve greedy "
                     "requests token-exact and sampled requests "
@@ -427,6 +428,9 @@ class Shell:
         if "penalties" in kv:
             payload["penalties"] = kv.pop("penalties") not in (
                 "0", "false", "")
+        if "prefix" in kv:   # shared system-prompt tokens, comma-separated
+            payload["prefix"] = [int(t)
+                                 for t in kv.pop("prefix").split(",") if t]
         if "reload" in kv:
             payload["reload"] = kv.pop("reload") not in ("0", "false", "")
         if kv:

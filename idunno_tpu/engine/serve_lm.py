@@ -121,6 +121,52 @@ def _prefill(model: TransformerLM, params: Any, prompt: jnp.ndarray,
     return mutated["cache"], last
 
 
+def _set_scalar_cursor(cache: Any, value) -> Any:
+    """Overwrite the scalar ``cursor`` leaves of a batch-1 decode cache
+    (the chunked-prefill twin of `_set_cursors`)."""
+    def f(path, leaf):
+        if path and getattr(path[-1], "key", None) == "cursor":
+            return jnp.asarray(value, jnp.int32)
+        return leaf
+    return jax.tree_util.tree_map_with_path(f, cache)
+
+
+@partial(jax.jit, static_argnames=("model", "prefix_len", "prompt_len"))
+def _prefill_suffix(model: TransformerLM, params: Any, prefix_cache: Any,
+                    suffix: jnp.ndarray, true_len: jnp.ndarray,
+                    prefix_len: int, prompt_len: int):
+    """[1, P] suffix after a length-``prefix_len`` CACHED prefix →
+    (length-(prefix_len+P) cache rows, first generated token's logits).
+
+    The pool-level prefix cache (paid once at pool build) is spliced
+    into the head of a fresh cache and the chunk applies from cursor
+    ``prefix_len`` — positions/RoPE and the causal mask then match a
+    from-scratch prefill of prefix+suffix exactly (the scalar-cursor
+    t>1 branch, `models/transformer.py` chunked prefill)."""
+    total = prefix_len + prompt_len
+    dec = decode_model(model, total)
+    cache = init_cache(model, 1, total)
+    src = {jax.tree_util.keystr(p): leaf for p, leaf
+           in jax.tree_util.tree_flatten_with_path(prefix_cache)[0]}
+
+    def put(path, dst):
+        if getattr(path[-1], "key", None) not in (
+                "cached_k", "cached_v", "k_scale", "v_scale"):
+            return dst
+        kv = src[jax.tree_util.keystr(path)]
+        return jax.lax.dynamic_update_slice(dst, kv, (0,) * dst.ndim)
+
+    cache = jax.tree_util.tree_map_with_path(put, cache)
+    cache = _set_scalar_cursor(cache, prefix_len)
+    params = dequantize_tree(params)
+    logits, mutated = dec.apply({"params": params, "cache": cache},
+                                suffix.astype(jnp.int32),
+                                mutable=["cache"])
+    last = jax.lax.dynamic_index_in_dim(logits[0], true_len - 1, axis=0,
+                                        keepdims=False)     # [vocab]
+    return mutated["cache"], last
+
+
 def _safe_log(probs: jnp.ndarray) -> jnp.ndarray:
     """log with EXACT -inf outside the support — a filtered-out token
     must have probability zero, not e^-69 (matches generate's -inf
@@ -309,7 +355,8 @@ class DecodeServer:
                  draft_len: int = 4,
                  prompt_buckets: tuple[int, ...] | None = None,
                  track_logprobs: bool = False,
-                 penalties: bool = False) -> None:
+                 penalties: bool = False,
+                 prefix: list[int] | None = None) -> None:
         if not model.causal:
             raise ValueError("continuous batching needs a causal LM")
         if prompt_len > max_len:
@@ -330,6 +377,19 @@ class DecodeServer:
             self.prompt_buckets = (prompt_len,)
         if decode_steps < 1:
             raise ValueError(f"decode_steps {decode_steps} must be >= 1")
+        # cheap argument validation BEFORE any device allocation or
+        # weight quantization: a bad prefix must fail in microseconds
+        self.prefix = list(prefix) if prefix else None
+        self._prefix_cache = self._draft_prefix_cache = None
+        if self.prefix:
+            for t in self.prefix:
+                if not 0 <= t < model.vocab:
+                    raise ValueError(f"prefix token {t} outside vocab "
+                                     f"[0, {model.vocab})")
+            if len(self.prefix) + max(self.prompt_buckets) > max_len:
+                raise ValueError(
+                    f"prefix of {len(self.prefix)} + prompt bucket "
+                    f"{max(self.prompt_buckets)} exceeds max_len {max_len}")
         if draft is not None:
             # decode_steps on a speculative pool = draft+verify ROUNDS
             # fused into one dispatch (each round commits 1..draft_len+1
@@ -480,6 +540,21 @@ class DecodeServer:
             self._decode_spec = self._build_spec_round(draft_len,
                                                        decode_steps)
         self._decode = self._build_decode(decode_steps)
+
+        # shared-prefix cache (system prompt): the prefix is prefilled
+        # ONCE here; every admission then prefills only its suffix from a
+        # spliced copy (`_prefill_suffix`). Completions INCLUDE the
+        # prefix (prompt_len covers prefix + suffix, so
+        # tokens[prompt_len:] is still exactly the generated region).
+        if self.prefix:
+            pf = jnp.asarray([self.prefix], jnp.int32)
+            pl = len(self.prefix)
+            self._prefix_cache, _ = _prefill(
+                self._prefill_model, self.params, pf, jnp.int32(pl), pl)
+            if self._draft_model is not None:
+                self._draft_prefix_cache, _ = _prefill(
+                    self._draft_model, self._draft_params, pf,
+                    jnp.int32(pl), pl)
 
     @staticmethod
     def _per_row_decode(model: TransformerLM,
@@ -756,9 +831,11 @@ class DecodeServer:
                              f"prompt_len bucket {self.prompt_len}")
         headroom = (self.draft_len + 1 if self._draft_model is not None
                     else 0)   # a verify chunk may overshoot the last token
-        if len(tokens) + max_new + headroom > self.max_len:
+        pl = len(self.prefix) if self.prefix else 0
+        if pl + len(tokens) + max_new + headroom > self.max_len:
             raise ValueError(
-                f"{len(tokens)} prompt + {max_new} new"
+                (f"{pl} prefix + " if pl else "")
+                + f"{len(tokens)} prompt + {max_new} new"
                 + (f" + {headroom} speculative headroom" if headroom
                    else "")
                 + f" > max_len {self.max_len}")
@@ -823,9 +900,12 @@ class DecodeServer:
         for i, req in enumerate(self._queue):
             if req.id == rid:
                 del self._queue[i]
+                # same shape as admitted completions on a prefix pool:
+                # tokens include the shared prefix, prompt_len covers it
+                full = (self.prefix or []) + list(req.tokens)
                 self._done.append(Completion(
-                    id=rid, tokens=list(req.tokens),
-                    prompt_len=len(req.tokens), cancelled=True))
+                    id=rid, tokens=full,
+                    prompt_len=len(full), cancelled=True))
                 self._stats["cancelled"] += 1
                 return "queued"
         for slot, req in self._live.items():
@@ -878,6 +958,7 @@ class DecodeServer:
             "quantize": self.quantize,
             "track_logprobs": self.track_logprobs,
             "penalties": self.penalties,
+            "prefix_len": len(self.prefix) if self.prefix else 0,
             "decode_steps": self.decode_steps,
             "prompt_len": self.prompt_len, "max_len": self.max_len,
             "speculative_draft_len": (self.draft_len
@@ -921,13 +1002,31 @@ class DecodeServer:
             slot = free.pop(0)
             req = self._queue.popleft()
             req.t_admit = time.monotonic()
-            true_len = len(req.tokens)
-            bucket = next(b for b in self.prompt_buckets if b >= true_len)
-            prompt = np.zeros((1, bucket), np.int32)
-            prompt[0, :true_len] = req.tokens
-            row_cache, last_logits = _prefill(
-                self._prefill_model, self.params, jnp.asarray(prompt),
-                jnp.int32(true_len), bucket)
+            suffix_true = len(req.tokens)
+            suffix_bucket = next(b for b in self.prompt_buckets
+                                 if b >= suffix_true)
+            suffix = np.zeros((1, suffix_bucket), np.int32)
+            suffix[0, :suffix_true] = req.tokens
+            if self.prefix:
+                pl = len(self.prefix)
+                row_cache, last_logits = _prefill_suffix(
+                    self._prefill_model, self.params, self._prefix_cache,
+                    jnp.asarray(suffix), jnp.int32(suffix_true), pl,
+                    suffix_bucket)
+                # downstream state (tokens row, cursors, prompt_len,
+                # stop/logprob regions) sees the FULL prompt
+                full = np.zeros((1, pl + suffix_bucket), np.int32)
+                full[0, :pl] = self.prefix
+                full[0, pl:pl + suffix_true] = req.tokens
+                req = dataclasses.replace(
+                    req, tokens=self.prefix + req.tokens)
+                prompt, true_len = full, pl + suffix_true
+                bucket = pl + suffix_bucket
+            else:
+                row_cache, last_logits = _prefill(
+                    self._prefill_model, self.params, jnp.asarray(suffix),
+                    jnp.int32(suffix_true), suffix_bucket)
+                prompt, true_len, bucket = suffix, suffix_true, suffix_bucket
             temp = jnp.float32(req.temperature)
             topp = jnp.float32(req.top_p)
             topk = jnp.int32(req.top_k)
@@ -939,9 +1038,18 @@ class DecodeServer:
                 first, jnp.int32(true_len), jnp.int32(slot), bucket)
             if self._draft_model is not None:
                 # the draft needs the prompt through ITS OWN weights
-                drow, _ = _prefill(self._draft_model, self._draft_params,
-                                   jnp.asarray(prompt),
-                                   jnp.int32(true_len), bucket)
+                # (suffix-only when the pool caches a shared prefix)
+                if self.prefix:
+                    drow, _ = _prefill_suffix(
+                        self._draft_model, self._draft_params,
+                        self._draft_prefix_cache, jnp.asarray(suffix),
+                        jnp.int32(suffix_true), len(self.prefix),
+                        suffix_bucket)
+                else:
+                    drow, _ = _prefill(
+                        self._draft_model, self._draft_params,
+                        jnp.asarray(suffix), jnp.int32(suffix_true),
+                        suffix_bucket)
                 self._draft_cache = _insert_cache(self._draft_cache, drow,
                                                   jnp.int32(slot))
             self._cursors = self._cursors.at[slot].set(true_len)

@@ -260,6 +260,8 @@ class ControlService:
                     quantize=p.get("quantize", "none"),
                     track_logprobs=bool(p.get("track_logprobs", False)),
                     penalties=bool(p.get("penalties", False)),
+                    prefix=([int(t) for t in p["prefix"]]
+                            if p.get("prefix") else None),
                     eos_id=(int(p["eos_id"])
                             if p.get("eos_id") is not None else None),
                     draft=draft,

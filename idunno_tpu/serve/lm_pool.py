@@ -94,9 +94,10 @@ class LMServingLoop:
             for i, entry in enumerate(self._inbox):
                 if entry[0] == rid:
                     del self._inbox[i]
+                    full = (self.server.prefix or []) + list(entry[1])
                     self._outbox.append(Completion(
-                        id=rid, tokens=list(entry[1]),
-                        prompt_len=len(entry[1]), cancelled=True,
+                        id=rid, tokens=full,
+                        prompt_len=len(full), cancelled=True,
                         logprobs=([] if self.server.track_logprobs
                                   else None)))
                     return True

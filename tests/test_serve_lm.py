@@ -554,6 +554,59 @@ def test_logprobs_tracking(lm):
         loop.stop()
 
 
+def test_prefix_cache(lm):
+    """Shared-prefix pools (system prompt): the prefix is prefilled once
+    at pool build; every admission prefills only its suffix from the
+    spliced cache. Completions must be token-exact vs `generate` over
+    the FULL prefix+suffix prompt — plain, speculative, and int8-KV
+    pools — with prompt_len covering prefix+suffix (so the generated
+    region and logprob alignment are unchanged)."""
+    import dataclasses as dc
+
+    model, params = lm
+    prefix = [7, 2, 19, 4, 30]
+    suffixes = [[3, 1, 4], [9], [21, 8]]
+
+    def want(suffix, m=model, max_new=10):
+        return expected(m, params, prefix + suffix, max_new)
+
+    srv = DecodeServer(model, params, slots=2, prompt_len=4, max_len=32,
+                       prefix=prefix, track_logprobs=True)
+    assert srv.stats()["config"]["prefix_len"] == len(prefix)
+    ids = {srv.submit(sfx, max_new=10): sfx for sfx in suffixes}
+    done = {c.id: c for c in srv.run_until_drained()}
+    for rid, sfx in ids.items():
+        c = done[rid]
+        assert c.tokens == want(sfx), f"suffix {sfx} diverged"
+        assert c.prompt_len == len(prefix) + len(sfx)
+        assert len(c.logprobs) == 10          # generated region only
+
+    # speculative pool with a prefix: target AND draft ride their own
+    # prefix caches; greedy stays token-exact
+    spec = DecodeServer(model, params, slots=1, prompt_len=4, max_len=40,
+                        prefix=prefix, draft=(model, params), draft_len=3)
+    spec.submit([3, 1, 4], max_new=10)
+    assert spec.run_until_drained()[0].tokens == want([3, 1, 4])
+
+    # int8 KV cache: prefix splice carries the scale leaves too
+    m8 = dc.replace(model, kv_cache_dtype="int8")
+    srv8 = DecodeServer(m8, params, slots=1, prompt_len=4, max_len=32,
+                        prefix=prefix)
+    srv8.submit([3, 1, 4], max_new=8)
+    assert srv8.run_until_drained()[0].tokens == want([3, 1, 4], m=m8,
+                                                      max_new=8)
+
+    # budget: prefix counts against max_len
+    with pytest.raises(ValueError, match="prefix"):
+        srv.submit([1, 2], max_new=30)        # 5 + 2 + 30 > 32
+    with pytest.raises(ValueError, match="max_len"):
+        DecodeServer(model, params, slots=1, prompt_len=8, max_len=10,
+                     prefix=prefix)           # 5 + bucket 8 > 10
+    with pytest.raises(ValueError, match="vocab"):
+        DecodeServer(model, params, slots=1, prompt_len=4, max_len=32,
+                     prefix=[VOCAB + 1])
+
+
 def test_stop_sequences(lm):
     """Token-level stop sequences: the completion is the exact greedy
     rollout truncated at (and including) the earliest stop match in the
