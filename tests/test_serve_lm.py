@@ -554,6 +554,55 @@ def test_logprobs_tracking(lm):
         loop.stop()
 
 
+def test_kitchen_sink_pool(lm):
+    """Every pool feature composed on ONE pool — shared prefix, penalty
+    buffer, logprob tracking — serving co-residents that each exercise a
+    different request surface (greedy+stop, penalized greedy, top-k
+    sampled, plain greedy). Each stream must still match its `generate`
+    oracle exactly where an oracle exists; feature state must not leak
+    between rows or across slot reuse."""
+    model, params = lm
+    prefix = [7, 2, 19]
+    sfx = [3, 1, 4]
+
+    def gen(max_new, **kw):
+        out = generate(model, params, jnp.asarray([prefix + sfx],
+                                                  jnp.int32),
+                       prompt_len=len(prefix) + len(sfx),
+                       max_new=max_new, **kw)
+        return [int(t) for t in np.asarray(out[0])]
+
+    plain = gen(12)
+    g = plain[len(prefix) + len(sfx):]
+    stop2 = [g[4], g[5]]
+
+    srv = DecodeServer(model, params, slots=2, prompt_len=4, max_len=40,
+                       prefix=prefix, penalties=True, track_logprobs=True)
+    r_stop = srv.submit(sfx, max_new=12, stop=[stop2])
+    r_pen = srv.submit(sfx, max_new=12, frequency_penalty=1e9)
+    r_topk = srv.submit(sfx, max_new=12, temperature=1.2, top_k=4,
+                        seed=5)
+    r_plain = srv.submit(sfx, max_new=12)
+    done = {c.id: c for c in srv.run_until_drained()}
+
+    assert done[r_stop].tokens == plain[:len(prefix) + len(sfx) + 6]
+    assert done[r_pen].tokens == gen(12, frequency_penalty=1e9)
+    gen_pen = done[r_pen].tokens[len(prefix) + len(sfx):]
+    assert len(set(gen_pen)) == len(gen_pen)     # no repeats
+    assert done[r_plain].tokens == plain         # untouched by neighbors
+    for rid in (r_pen, r_topk, r_plain):
+        c = done[rid]
+        assert c.prompt_len == len(prefix) + len(sfx)
+        assert len(c.logprobs) == len(c.tokens) - c.prompt_len
+        assert all(lp <= 1e-6 for lp in c.logprobs)   # valid logprobs
+
+    # slot reuse: 4 requests through 2 slots already reused both slots;
+    # run a second wave to confirm no stale penalty/stop/logprob state
+    r2 = srv.submit(sfx, max_new=12)
+    done2 = {c.id: c for c in srv.run_until_drained()}
+    assert done2[r2].tokens == plain
+
+
 def test_prefix_cache(lm):
     """Shared-prefix pools (system prompt): the prefix is prefilled once
     at pool build; every admission prefills only its suffix from the
