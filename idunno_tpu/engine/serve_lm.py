@@ -903,9 +903,12 @@ class DecodeServer:
                 # same shape as admitted completions on a prefix pool:
                 # tokens include the shared prefix, prompt_len covers it
                 full = (self.prefix or []) + list(req.tokens)
+                # logprobs=[] (not None) on tracking pools so the
+                # completion shape matches LMServingLoop.cancel
                 self._done.append(Completion(
-                    id=rid, tokens=full,
-                    prompt_len=len(full), cancelled=True))
+                    id=rid, tokens=full, prompt_len=len(full),
+                    cancelled=True,
+                    logprobs=[] if self.track_logprobs else None))
                 self._stats["cancelled"] += 1
                 return "queued"
         for slot, req in self._live.items():
@@ -1105,7 +1108,11 @@ class DecodeServer:
             gen_start = len(self._live[slot].tokens)
             end = int(cursors[slot]) + 1
             overlap = max(len(q) for q in seqs) - 1
-            lo = max(gen_start, end - bound - overlap)
+            # bound + 1, not bound: the first post-admission dispatch has
+            # bound+1 unscanned tokens (the admission-picked token plus
+            # `bound` decode tokens) — without the +1 a length-1 stop
+            # equal to the FIRST generated token is never seen
+            lo = max(gen_start, end - bound - 1 - overlap)
             row = np.asarray(self._tokens[slot])[:end].tolist()
             best = None                      # earliest END of any match
             for seq in seqs:

@@ -179,10 +179,12 @@ class ControlService:
                 # disabled-sampler values (temperature 0, top_p 1, top_k
                 # 0) are fine alongside beam; ACTIVE samplers are not
                 if (temperature > 0.0 or float(p.get("top_p", 1.0)) < 1.0
-                        or int(p.get("top_k", 0)) > 0):
+                        or int(p.get("top_k", 0)) > 0
+                        or float(p.get("presence_penalty", 0.0)) != 0.0
+                        or float(p.get("frequency_penalty", 0.0)) != 0.0):
                     raise ValueError("beam_width is a search, not a "
-                                     "sampler: temperature/top_p/top_k "
-                                     "don't apply")
+                                     "sampler: temperature/top_p/top_k/"
+                                     "penalties don't apply")
                 if p.get("prompt_lens") is not None:
                     raise ValueError("beam_search does not support ragged "
                                      "prompt_lens; pad per-call or use "
@@ -208,7 +210,13 @@ class ControlService:
                            max_new=int(p["max_new"]),
                            temperature=temperature,
                            top_p=float(p.get("top_p", 1.0)),
-                           top_k=int(p.get("top_k", 0)), **kw)
+                           top_k=int(p.get("top_k", 0)),
+                           # static jit args — distinct values retrace,
+                           # same as temperature/top_p/top_k above
+                           presence_penalty=float(
+                               p.get("presence_penalty", 0.0)),
+                           frequency_penalty=float(
+                               p.get("frequency_penalty", 0.0)), **kw)
             return {"tokens": [[int(t) for t in row] for row in out]}
         if verb == "lm_serve":
             # continuous-batching serving of a store-persisted LM: a decode

@@ -83,6 +83,23 @@ def test_lm_served_through_cluster_control(stores, tmp_path):
                                   np.asarray(want))
     assert "tiny" in ctl._lms                      # cached for later calls
 
+    # penalized one-shot generation over RPC (ADVICE r4 low: the verb
+    # used to silently drop the penalty fields): greedy + penalties is
+    # deterministic, so it must match the library call exactly
+    want_pen = generate(model, state.params, prompt, prompt_len=4,
+                        max_new=5, presence_penalty=1.5,
+                        frequency_penalty=0.5)
+    out_pen = ctl._handle("control", Message(
+        MessageType.INFERENCE, "client",
+        {"verb": "generate", "name": "tiny",
+         "prompt": [[int(t) for t in row] for row in prompt],
+         "max_new": 5, "presence_penalty": 1.5,
+         "frequency_penalty": 0.5}))
+    assert out_pen.type is MessageType.ACK, out_pen.payload
+    np.testing.assert_array_equal(np.asarray(out_pen.payload["tokens"]),
+                                  np.asarray(want_pen))
+    assert not np.array_equal(np.asarray(want_pen), np.asarray(want))
+
     # beam search over the same verb: matches the library call, scores
     # included; samplers are rejected (beam is a search, not a sampler)
     from idunno_tpu.engine.generate import beam_search
@@ -104,6 +121,12 @@ def test_lm_served_through_cluster_control(stores, tmp_path):
         {"verb": "generate", "name": "tiny", "prompt": [[1, 2]],
          "max_new": 2, "beam_width": 3, "temperature": 0.7}))
     assert out_bad.type is MessageType.ERROR
+    # penalties are sampler knobs too — beam must reject, not ignore them
+    out_bad_pen = ctl._handle("control", Message(
+        MessageType.INFERENCE, "client",
+        {"verb": "generate", "name": "tiny", "prompt": [[1, 2]],
+         "max_new": 2, "beam_width": 3, "presence_penalty": 1.0}))
+    assert out_bad_pen.type is MessageType.ERROR
 
     # re-save with a DIFFERENT architecture: versions pair config+weights
     # atomically, the cache serves old weights until reload=true

@@ -702,6 +702,17 @@ def test_stop_sequences(lm):
     got4, other4 = serve([stop2], draft=(model, params))
     assert got4 == want and other4 == full
 
+    # a length-1 stop equal to the FIRST generated token (the
+    # admission-picked one): the first post-admission dispatch has
+    # bound+1 unscanned tokens, so the scan window must reach back to
+    # gen_start (ADVICE r4 high: off-by-one hid this exact case)
+    got5, other5 = serve([[gen[0]]])
+    assert got5 == full[:len(prompt) + 1], (got5, gen[0])
+    assert other5 == full
+    # same case through the speculative pool (bigger per-dispatch bound)
+    got6, _ = serve([[gen[0]]], draft=(model, params))
+    assert got6 == full[:len(prompt) + 1]
+
     with pytest.raises(ValueError, match="empty stop"):
         serve([[]])
     with pytest.raises(ValueError, match="stop token"):
@@ -1045,6 +1056,20 @@ def test_cancel_queued_request(lm):
     assert done[live_id].tokens == expected(model, params, [1, 2], 6)
     assert srv.stats()["cancelled"] == 1
     assert srv.stats()["completed"] == 1      # cancelled is not completed
+    assert done[queued_id].logprobs is None   # non-tracking pool
+
+    # on a track_logprobs pool the queued-cancel completion carries
+    # logprobs=[] — same shape LMServingLoop.cancel produces (ADVICE r4
+    # low: the two tiers disagreed)
+    srv_lp = DecodeServer(model, params, slots=1, prompt_len=4, max_len=24,
+                          track_logprobs=True)
+    live2 = srv_lp.submit([1, 2], max_new=6)
+    srv_lp.step()
+    queued2 = srv_lp.submit([3, 4], max_new=6)
+    assert srv_lp.cancel(queued2) == "queued"
+    done2 = {c.id: c for c in srv_lp.run_until_drained()}
+    assert done2[queued2].cancelled and done2[queued2].logprobs == []
+    assert len(done2[live2].logprobs) == 6    # live row tracked normally
 
 
 def test_cancel_live_returns_partial_and_frees_slot(lm):

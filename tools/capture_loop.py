@@ -30,15 +30,19 @@ PROBE_INTERVAL_S = float(os.environ.get("CAPTURE_PROBE_INTERVAL_S", "180"))
 OUTER_TIMEOUT_S = 1300
 
 # (name, argv-env pairs, artifact whose refresh marks success)
+# Round-5 priority (VERDICT next-1): lm_suite FIRST — the fused
+# speculative rounds, flash-vs-XLA and slot-scaling points have never
+# touched the chip; the headline CNN number exists and only needs a
+# refresh for provenance.
 STEPS = [
-    ("headline_resnet18",
-     {"BENCH_TIME_BUDGET_S": "600"},
-     [sys.executable, "bench.py"],
-     "BENCH_LAST_GOOD.json"),
     ("lm_suite",
      {"BENCH_SUITE": "lm", "BENCH_TIME_BUDGET_S": "600"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_lm.json"),
+    ("headline_resnet18",
+     {"BENCH_TIME_BUDGET_S": "600"},
+     [sys.executable, "bench.py"],
+     "BENCH_LAST_GOOD.json"),
     ("two_model_fairshare",
      {},
      [sys.executable, "tools/two_model_fairshare.py"],
