@@ -199,6 +199,13 @@ def model_forward_flops(model: str, image_size: int = 224) -> float:
                      "forward-flops function so MFU stays honest")
 
 
+def _engine_folded(engine) -> bool:
+    """Did this engine load BENCH_MODEL with the folded-preprocess stem?"""
+    loaded = engine._models.get(BENCH_MODEL)
+    return getattr(getattr(loaded, "module", None),
+                   "fold_preprocess", False)
+
+
 def peak_bf16_for(devices) -> float | None:
     """Aggregate peak dense bf16 FLOP/s for the visible chips, or None
     off-TPU / unknown kind."""
@@ -414,11 +421,13 @@ def run_bench(devices) -> None:
     # that actually ran (other families have no 7x7/s2 stem to fold).
     stem_s2d = (os.environ.get("BENCH_STEM_S2D", "0") == "1"
                 and BENCH_MODEL.startswith("resnet"))
-    # uint8→bf16 preprocess path: "auto" resolves to the Pallas kernel on
-    # TPU. The 2026-07-31 bs256 trace showed XLA inserting ~38 ms/step of
-    # slice/reshape/layout-copy around the kernel's custom-call boundary
-    # (~15% of device time) while the kernel itself costs 4.4 ms — so the
-    # alternate path is captured as a comparison point below.
+    # uint8→bf16 preprocess path: "auto" now resolves to the FOLDED stem
+    # on TPU (models/stem_fold.py). The 2026-07-31 bs256 trace showed XLA
+    # inserting ~38 ms/step of slice/reshape/layout-copy around the Pallas
+    # kernel's custom-call boundary (~15% of device time) while the kernel
+    # itself costs 4.4 ms; the fold removes the materialized preprocess
+    # entirely. Both alternate paths (pallas, xla) are captured as
+    # comparison points below so the default stays measurement-backed.
     bench_pp = os.environ.get("BENCH_PREPROCESS", "auto")
     platform = devices[0].platform
     device_kind = getattr(devices[0], "device_kind", platform)
@@ -525,20 +534,29 @@ def run_bench(devices) -> None:
         bs = best["batch_size"]
         staged, k = staged_for(bs)
         # what the sweep's "auto" actually ran, so the alternate-preprocess
-        # point below measures the path the headline did NOT take
-        sweep_pp = ("pallas" if engine is not None and engine._pallas_ok
-                    else "xla")
+        # points below measure the paths the headline did NOT take
+        if engine is not None and _engine_folded(engine):
+            sweep_pp = "fold"
+        else:
+            sweep_pp = ("pallas" if engine is not None and engine._pallas_ok
+                        else "xla")
         variants = [("float32", "none", stem_s2d, bench_pp),
                     ("bfloat16", "int8", stem_s2d, bench_pp)]
         if BENCH_MODEL.startswith("resnet"):
-            # the stem recast, measured against the headline config (same
-            # dtype/quantize, only the stem differs)
-            variants.append((param_dtype, quantize, not stem_s2d, bench_pp))
-        # pallas-vs-xla preprocess at the headline config (trace-driven:
-        # the custom-call layout boundary may cost more than the kernel
-        # saves; this point decides the default by measurement)
-        variants.append((param_dtype, quantize, stem_s2d,
-                         "xla" if sweep_pp == "pallas" else "pallas"))
+            # the stem recast (same dtype/quantize). The s2d stem cannot
+            # run the folded preprocess (both rebuild the stem conv), so
+            # this point pins preprocess='pallas' and is labeled so — its
+            # honest baseline is the pallas point below, not the folded
+            # headline
+            variants.append((param_dtype, quantize, not stem_s2d,
+                             "pallas" if not stem_s2d else bench_pp))
+        # fold-vs-pallas-vs-xla preprocess at the headline config
+        # (trace-driven: the custom-call layout boundary measured ~15% of
+        # device time; these points keep the default measurement-backed)
+        for alt_pp in ("fold", "pallas", "xla"):
+            if alt_pp == sweep_pp or (alt_pp == "fold" and stem_s2d):
+                continue               # fold+s2d: rejected by the engine
+            variants.append((param_dtype, quantize, stem_s2d, alt_pp))
         for pd, qz, s2d, pp in variants:
             if (pd == param_dtype and qz == quantize and s2d == stem_s2d
                     and pp == bench_pp):
@@ -589,14 +607,19 @@ def run_bench(devices) -> None:
     e2e_s = time.perf_counter() - t0
     assert len(e2e_res.records) == n_e2e
 
-    # Pallas preprocess must not have silently fallen back on TPU
-    # (round-1 VERDICT weak #2: engine auto-fallback hides broken kernels).
-    pallas = ("compiled" if e2e_engine._pallas_ok
+    # Preprocess-path accounting: when the folded stem ran, the Pallas
+    # kernel is legitimately absent; otherwise a Pallas fallback on TPU
+    # must fail loudly (round-1 VERDICT weak #2: engine auto-fallback
+    # hides broken kernels).
+    e2e_folded = _engine_folded(e2e_engine)
+    pallas = ("n/a (folded stem)" if e2e_folded
+              else "compiled" if e2e_engine._pallas_ok
               else ("n/a (cpu)" if platform != "tpu"
                     else ("xla (requested)" if bench_pp == "xla"
                           else "FALLBACK_TO_XLA")))
     error = None
-    if platform == "tpu" and not e2e_engine._pallas_ok and bench_pp != "xla":
+    if (platform == "tpu" and not e2e_folded and not e2e_engine._pallas_ok
+            and bench_pp not in ("xla", "fold")):
         error = "pallas preprocess kernel failed to compile on TPU; ran XLA path"
 
     # compact LM sub-record on the same chip (round-3 VERDICT weak #3: the

@@ -130,8 +130,11 @@ class EngineConfig:
     resize_size: int = 256          # canonical host-decoded size
     compute_dtype: str = "bfloat16"  # MXU-friendly
     param_dtype: str = "float32"
-    # uint8→normalized preprocess: "auto" = Pallas kernel on TPU, XLA
-    # elsewhere; "pallas" / "xla" force one path.
+    # uint8→normalized preprocess: "auto" = normalize affine folded into
+    # the stem conv on TPU for families that support it (models/
+    # stem_fold.py — removes the preprocess boundary the bs256 trace
+    # measured at ~15% of device step time), XLA elsewhere;
+    # "fold" / "pallas" / "xla" force one path.
     preprocess: str = "auto"
     # "none" | "int8": weight-only symmetric per-channel quantization of the
     # resident model weights (ops/quantize.py) — halves/quarters weight HBM;

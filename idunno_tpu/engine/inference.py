@@ -114,7 +114,20 @@ class InferenceEngine:
             return
         dtypes = dict(dtype=jnp.dtype(self.config.compute_dtype),
                       param_dtype=jnp.dtype(self.config.param_dtype))
-        if self.config.stem_s2d:
+        module = None
+        if self._want_fold():
+            # fold the normalize affine into the stem conv (models/
+            # stem_fold.py); capability-gated on the model itself —
+            # families without the field reject the kwarg and fall back
+            # (loudly when the operator forced preprocess="fold")
+            try:
+                module = create_model(name, fold_preprocess=True, **dtypes)
+            except TypeError:
+                if self.config.preprocess == "fold":
+                    raise ValueError(
+                        f"preprocess='fold': model {name!r} does not "
+                        "support fold_preprocess") from None
+        if module is None and self.config.stem_s2d:
             # stem recast (same params/outputs, models/resnet.py _S2DStem);
             # capability-gated on the model itself: families without the
             # field (alexnet, vit, registry extensions) reject the kwarg
@@ -123,7 +136,7 @@ class InferenceEngine:
                 module = create_model(name, stem_s2d=True, **dtypes)
             except TypeError:
                 module = create_model(name, **dtypes)
-        else:
+        if module is None:
             module = create_model(name, **dtypes)
         variables, provenance = None, "random"
         if self.pretrained and self.store is not None:
@@ -317,15 +330,30 @@ class InferenceEngine:
         m = self._models.get(name)
         return m.provenance if m else "unknown"
 
+    def _want_fold(self) -> bool:
+        """Should model creation try the folded-preprocess stem? "fold"
+        always; "auto" on TPU (measured default: the bs256 trace put the
+        materialized-preprocess boundary at ~15% of device step time)
+        unless the operator also asked for the s2d stem recast — the two
+        both rebuild the stem and the model rejects the combination."""
+        mode = self.config.preprocess
+        if mode not in ("auto", "fold", "pallas", "xla"):
+            raise ValueError(f"EngineConfig.preprocess={mode!r}: "
+                             "want auto|fold|pallas|xla")
+        if mode == "fold" and self.config.stem_s2d:
+            raise ValueError("preprocess='fold' and stem_s2d both recast "
+                             "the stem conv; pick one")
+        if mode == "fold":
+            return True
+        return (mode == "auto" and not self.config.stem_s2d
+                and self.mesh.devices.flatten()[0].platform == "tpu")
+
     def _use_pallas(self) -> bool:
         mode = self.config.preprocess
         if mode == "pallas":
             return True
-        if mode == "xla":
+        if mode in ("xla", "fold"):
             return False
-        if mode != "auto":
-            raise ValueError(
-                f"EngineConfig.preprocess={mode!r}: want auto|pallas|xla")
         return self.mesh.devices.flatten()[0].platform == "tpu"
 
     def _build_predict(self, module):
@@ -335,7 +363,8 @@ class InferenceEngine:
         bsharding = batch_sharding(self.mesh)
         rsharding = replicated_sharding(self.mesh)
 
-        if self._pallas_ok is None:
+        folded = getattr(module, "fold_preprocess", False)
+        if not folded and self._pallas_ok is None:
             use_pallas = self._use_pallas()
             if use_pallas and self.config.preprocess == "auto":
                 # auto mode must never take the engine down: smoke-compile
@@ -357,7 +386,15 @@ class InferenceEngine:
                     use_pallas = False
             self._pallas_ok = use_pallas
 
-        if self._pallas_ok:
+        if folded:
+            # the stem consumes RAW cropped 0..255 values (stem_fold.py);
+            # the only boundary op is the crop slice — the u8→compute cast
+            # inside the module fuses into the stem conv's input read
+            from idunno_tpu.ops.preprocess import center_crop
+
+            def preprocess(u8):
+                return center_crop(u8, self.config.image_size)
+        elif self._pallas_ok:
             from idunno_tpu.parallel._compat import shard_map
             from idunno_tpu.ops.pallas_preprocess import preprocess_batch_pallas
 

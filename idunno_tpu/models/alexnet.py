@@ -16,6 +16,10 @@ class AlexNet(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
     dropout_rate: float = 0.5
+    # fold the preprocess normalize affine into conv0
+    # (models/stem_fold.py): the model then takes RAW cropped 0..255
+    # inputs; same parameter tree, mathematically identical outputs
+    fold_preprocess: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -23,7 +27,15 @@ class AlexNet(nn.Module):
             feat, kern, strides=stride, padding=pad,
             dtype=self.dtype, param_dtype=self.param_dtype, name=name)
         x = x.astype(self.dtype)
-        x = nn.relu(conv(64, (11, 11), (4, 4), ((2, 2), (2, 2)), "conv0")(x))
+        if self.fold_preprocess:
+            from idunno_tpu.models.stem_fold import FoldedStemConv
+            x = nn.relu(FoldedStemConv(
+                64, (11, 11), strides=(4, 4), padding=((2, 2), (2, 2)),
+                use_bias=True, dtype=self.dtype,
+                param_dtype=self.param_dtype, name="conv0")(x))
+        else:
+            x = nn.relu(conv(64, (11, 11), (4, 4), ((2, 2), (2, 2)),
+                             "conv0")(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
         x = nn.relu(conv(192, (5, 5), (1, 1), ((2, 2), (2, 2)), "conv1")(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2))

@@ -139,6 +139,10 @@ class ResNet(nn.Module):
     # run the stem as a space-to-depth 4x4/s1 conv (see _S2DStem) — same
     # parameters, same outputs, better MXU shape; opt-in until measured
     stem_s2d: bool = False
+    # fold the preprocess normalize affine into the stem conv
+    # (models/stem_fold.py): the model then takes RAW cropped 0..255
+    # inputs; same parameter tree, mathematically identical outputs
+    fold_preprocess: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -148,8 +152,18 @@ class ResNet(nn.Module):
                        momentum=0.9, epsilon=1e-5,
                        dtype=self.dtype, param_dtype=self.param_dtype)
 
+        if self.stem_s2d and self.fold_preprocess:
+            raise ValueError("stem_s2d and fold_preprocess both recast the "
+                             "stem conv; pick one")
         x = x.astype(self.dtype)
-        if self.stem_s2d:
+        if self.fold_preprocess:
+            from idunno_tpu.models.stem_fold import FoldedStemConv
+            x = FoldedStemConv(self.num_filters, (7, 7), strides=(2, 2),
+                               padding=((3, 3), (3, 3)), use_bias=False,
+                               dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               name="stem_conv")(x)
+        elif self.stem_s2d:
             x = _S2DStem(self.num_filters, dtype=self.dtype,
                          param_dtype=self.param_dtype,
                          name="stem_conv")(x)

@@ -1,0 +1,91 @@
+"""Fold the preprocess normalize affine into the stem convolution.
+
+The serving input pipeline is CenterCrop + ToTensor + Normalize
+(reference `alexnet_resnet.py:57-62`), i.e. per-channel
+``x_norm = x/255·(1/std) - mean/std = a·x + c`` — an affine map feeding a
+convolution. The 2026-07-31 batch-256 trace (`TRACE_BS256.json`) showed
+~15% of device step time spent on the slice→reshape→layout-copy chains
+XLA inserts around the Pallas preprocess custom-call that materializes
+``a·x + c``; this module removes the materialization entirely by folding
+the affine into the stem conv (linearity):
+
+    conv(pad0(a·x + c·1_img), W) = conv(pad0(x), W·a) + conv(pad0(c·1), W)
+
+The first term scales each input-channel slice of the KERNEL (free: done
+in param dtype at apply time, [kh, kw, 3, F] work); the second is a
+constant map — computed as a conv over a single c-valued image, so the
+zero-padding borders match the unfolded path EXACTLY (the padded region
+contributes nothing in either form). The network then consumes the raw
+cropped uint8 values (cast to the compute dtype — integers 0..255 are
+exact in bf16), and the only elementwise op left at the boundary is that
+cast, which XLA fuses into the conv's input read.
+
+The PARAMETER stays the torchvision-shaped ``(kh, kw, 3, F)`` kernel (+
+bias where the family has one) under the family's usual stem name —
+converters, checkpoints and parity tests see an identical tree (same
+discipline as `models/resnet._S2DStem`). Folding changes only where the
+``a`` multiply happens (weights, in f32, vs activations), so outputs are
+mathematically identical and numerically equal to within bf16 rounding.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from idunno_tpu.ops.preprocess import IMAGENET_MEAN, IMAGENET_STD
+
+
+class FoldedStemConv(nn.Module):
+    """Drop-in stem conv over RAW 0..255 inputs, torchvision param tree.
+
+    Name it as the family's stem (``stem_conv``/``conv0``/``embed``) and it
+    creates the identical ``kernel`` (and ``bias``) params nn.Conv would,
+    but computes ``conv(normalize(x), kernel) [+ bias]`` from the raw
+    input via the folded form above."""
+
+    features: int
+    kernel_size: tuple[int, int]
+    strides: tuple[int, int]
+    padding: tuple[tuple[int, int], tuple[int, int]]
+    use_bias: bool
+    dtype: jnp.dtype
+    param_dtype: jnp.dtype
+    mean: tuple[float, ...] = IMAGENET_MEAN
+    std: tuple[float, ...] = IMAGENET_STD
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        if c != len(self.mean):
+            raise ValueError(f"folded stem expects {len(self.mean)} input "
+                             f"channels, got {c}")
+        kh, kw = self.kernel_size
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (kh, kw, c, self.features), self.param_dtype)
+        a = 1.0 / (255.0 * np.asarray(self.std))          # [C]
+        cc = -np.asarray(self.mean) / np.asarray(self.std)
+
+        def conv(inp, kern):
+            return jax.lax.conv_general_dilated(
+                inp, kern, window_strides=self.strides,
+                padding=self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        # scaled-kernel term in param dtype, cast once (exactly where
+        # nn.Conv casts its kernel)
+        ks = (kernel * jnp.asarray(a, self.param_dtype)[None, None, :, None]
+              ).astype(self.dtype)
+        y = conv(x.astype(self.dtype), ks)
+        # constant-map term: one c-valued image through the UNSCALED
+        # kernel; zero padding reproduces the unfolded borders exactly.
+        # [1, Ho, Wo, F] — broadcasts over the batch; XLA folds the tiny
+        # conv into a constant-per-dispatch when the params are donated
+        cimg = jnp.broadcast_to(jnp.asarray(cc, self.dtype), (1, h, w, c))
+        y = y + conv(cimg, kernel.astype(self.dtype))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), self.param_dtype)
+            y = y + bias.astype(self.dtype)
+        return y

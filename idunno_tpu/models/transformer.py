@@ -233,19 +233,27 @@ class MultiHeadAttention(nn.Module):
                 (k_st, k_sc), (v_st, v_sc) = q8(k), q8(v)
             else:
                 k_st, v_st = k, v
-            new_k = ck.value.at[rows[:, None], slot].set(k_st)
-            new_v = cv.value.at[rows[:, None], slot].set(v_st)
-            ovr = overflow[:, None, None, None]
-            new_k = jnp.where(ovr, ck.value, new_k)
-            new_v = jnp.where(ovr, cv.value, new_v)
+            # overflow gating happens on the VALUES before the scatter (an
+            # overflowing row re-writes its old cache entries — a no-op),
+            # never as a post-scatter jnp.where over the whole cache: that
+            # select would keep the pre-scatter cache live, forcing XLA to
+            # COPY the full [B, L, H, D] buffer every layer every decode
+            # step instead of scattering in place (the dominant cost of
+            # the 2026-07-31 capture's 6.8 ms decode step)
+            ovr_g = overflow[:, None, None, None]            # [B,1,1,1]
+            old_k = ck.value[rows[:, None], slot]            # [B,t,kv,d]
+            old_v = cv.value[rows[:, None], slot]
+            new_k = ck.value.at[rows[:, None], slot].set(
+                jnp.where(ovr_g, old_k, k_st))
+            new_v = cv.value.at[rows[:, None], slot].set(
+                jnp.where(ovr_g, old_v, v_st))
             new_ks = new_vs = None
             if quant:
-                new_ks = ks.value.at[rows[:, None], slot].set(k_sc)
-                new_vs = vs.value.at[rows[:, None], slot].set(v_sc)
-                new_ks = jnp.where(overflow[:, None, None], ks.value,
-                                   new_ks)
-                new_vs = jnp.where(overflow[:, None, None], vs.value,
-                                   new_vs)
+                ovr_s = overflow[:, None, None]
+                new_ks = ks.value.at[rows[:, None], slot].set(
+                    jnp.where(ovr_s, ks.value[rows[:, None], slot], k_sc))
+                new_vs = vs.value.at[rows[:, None], slot].set(
+                    jnp.where(ovr_s, vs.value[rows[:, None], slot], v_sc))
             if not self.is_initializing():  # init returns a CLEAN cache;
                 ck.value, cv.value = new_k, new_v   # cursors: caller-owned
                 if quant:
@@ -266,20 +274,29 @@ class MultiHeadAttention(nn.Module):
                 (k_st, k_sc), (v_st, v_sc) = q8(k), q8(v)
             else:
                 k_st, v_st = k, v
-            new_k = jax.lax.dynamic_update_slice(ck.value, k_st,
-                                                 (0, i, 0, 0))
-            new_v = jax.lax.dynamic_update_slice(cv.value, v_st,
-                                                 (0, i, 0, 0))
-            new_k = jnp.where(overflow, ck.value, new_k)
-            new_v = jnp.where(overflow, cv.value, new_v)
+            # same value-gating as the per-row branch: on overflow the
+            # update writes back the OLD slice (dynamic_slice/-update
+            # clamp the start identically, so the round-trip is a no-op)
+            # instead of post-selecting over the whole cache, which would
+            # block the in-place update and copy the full buffer
+            old_k = jax.lax.dynamic_slice(ck.value, (0, i, 0, 0),
+                                          k_st.shape)
+            old_v = jax.lax.dynamic_slice(cv.value, (0, i, 0, 0),
+                                          v_st.shape)
+            new_k = jax.lax.dynamic_update_slice(
+                ck.value, jnp.where(overflow, old_k, k_st), (0, i, 0, 0))
+            new_v = jax.lax.dynamic_update_slice(
+                cv.value, jnp.where(overflow, old_v, v_st), (0, i, 0, 0))
             new_ks = new_vs = None
             if quant:
-                new_ks = jax.lax.dynamic_update_slice(ks.value, k_sc,
-                                                      (0, i, 0))
-                new_vs = jax.lax.dynamic_update_slice(vs.value, v_sc,
-                                                      (0, i, 0))
-                new_ks = jnp.where(overflow, ks.value, new_ks)
-                new_vs = jnp.where(overflow, vs.value, new_vs)
+                old_ks = jax.lax.dynamic_slice(ks.value, (0, i, 0),
+                                               k_sc.shape)
+                old_vs = jax.lax.dynamic_slice(vs.value, (0, i, 0),
+                                               v_sc.shape)
+                new_ks = jax.lax.dynamic_update_slice(
+                    ks.value, jnp.where(overflow, old_ks, k_sc), (0, i, 0))
+                new_vs = jax.lax.dynamic_update_slice(
+                    vs.value, jnp.where(overflow, old_vs, v_sc), (0, i, 0))
             if not self.is_initializing():  # init must return a CLEAN cache
                 ck.value, cv.value, cur.value = new_k, new_v, i + t
                 if quant:
@@ -297,6 +314,10 @@ class MultiHeadAttention(nn.Module):
             new_k = new_k.astype(jnp.float32) * new_ks[..., None]
             new_v = new_v.astype(jnp.float32) * new_vs[..., None]
         q5 = q.reshape(b, t, kv_heads, group, d)
+        # f32 casts on the operands: they FUSE into the dot reads (HBM
+        # traffic stays at the cache's stored width), and XLA:CPU's
+        # emulated-bf16 dots make a native-dtype einsum measurably slower
+        # in the test/dev loop — measured 2026-07-31, 103→116 ms/step
         scores = jnp.einsum("bqhgd,bthd->bhgqt", q5.astype(jnp.float32),
                             new_k.astype(jnp.float32)) / (d ** 0.5)
         mask = mask[:, :, None]          # broadcast over the group axis

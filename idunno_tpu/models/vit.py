@@ -33,6 +33,10 @@ class ViT(nn.Module):
     attn_fn: AttnFn = full_attention
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    # fold the preprocess normalize affine into the patch embedding
+    # (models/stem_fold.py): the model then takes RAW cropped 0..255
+    # inputs; same parameter tree, mathematically identical outputs
+    fold_preprocess: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -40,10 +44,19 @@ class ViT(nn.Module):
         if h % self.patch or w % self.patch:
             raise ValueError(f"image {h}x{w} not divisible by "
                              f"patch {self.patch}")
-        x = nn.Conv(self.dim, (self.patch, self.patch),
-                    strides=(self.patch, self.patch), padding="VALID",
-                    dtype=self.dtype, param_dtype=self.param_dtype,
-                    name="embed")(x.astype(self.dtype))
+        if self.fold_preprocess:
+            from idunno_tpu.models.stem_fold import FoldedStemConv
+            x = FoldedStemConv(self.dim, (self.patch, self.patch),
+                               strides=(self.patch, self.patch),
+                               padding=((0, 0), (0, 0)), use_bias=True,
+                               dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               name="embed")(x.astype(self.dtype))
+        else:
+            x = nn.Conv(self.dim, (self.patch, self.patch),
+                        strides=(self.patch, self.patch), padding="VALID",
+                        dtype=self.dtype, param_dtype=self.param_dtype,
+                        name="embed")(x.astype(self.dtype))
         n = (h // self.patch) * (w // self.patch)
         x = x.reshape(b, n, self.dim)
         cls = self.param("cls", nn.initializers.zeros, (1, 1, self.dim),

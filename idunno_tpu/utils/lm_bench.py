@@ -270,11 +270,14 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
                                 "error": f"{type(e).__name__}: {e}"}
 
     # -- steady-state decode ----------------------------------------------
-    def measure_pool(m, p, slots=None, **server_kw):
+    def measure_pool(m, p, slots=None, trace_name=None, **server_kw):
         """Build a pool, pay its compiles on a warm-up request, then
         measure steady-state decode tokens/sec — the shared protocol for
         the plain/int8/GQA/slot-scaling points. Returns (tok/s, timed
-        dispatches, seconds/dispatch, compile seconds)."""
+        dispatches, seconds/dispatch, compile seconds). With
+        ``trace_name`` and BENCH_TRACE=1, one extra post-timing dispatch
+        runs under the profiler into ``.trace/<trace_name>`` (the decode
+        trace→apportion→fix loop; parse with tools/parse_trace.py)."""
         srv = DecodeServer(m, p, slots=slots or cfg["slots"],
                            prompt_len=cfg["prompt_len"],
                            max_len=cfg["max_len"],
@@ -284,9 +287,16 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
         srv.run_until_drained()
         c_s = time.perf_counter() - t0
         ts, kk, disp_s = _steady_decode_tok_s(srv, cfg)
+        if trace_name and os.environ.get("BENCH_TRACE") == "1":
+            from idunno_tpu.utils.tracing import trace
+            root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            with trace(os.path.join(root, ".trace", trace_name)):
+                srv.step()        # rows still live: k leaves budget over
         return ts, kk, disp_s, c_s
 
-    tok_s, k, dispatch_s, compile_s = measure_pool(model, params)
+    tok_s, k, dispatch_s, compile_s = measure_pool(
+        model, params, trace_name="lm_decode" if platform == "tpu" else None)
     out["decode_compile_s"] = round(compile_s, 2)
     out["decode"] = {
         "tokens_per_s": round(tok_s, 1),

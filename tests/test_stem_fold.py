@@ -1,0 +1,101 @@
+"""Folded-preprocess stem (models/stem_fold.py): the normalize affine
+folded into the stem conv must be a drop-in for preprocess-then-forward —
+identical parameter tree, near-identical outputs (the fold moves the `a`
+multiply from activations into the f32 kernel, so only rounding differs),
+including the zero-padding borders the constant-map term reproduces.
+Reference pipeline being folded: `alexnet_resnet.py:57-62`."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idunno_tpu.models import create_model
+from idunno_tpu.ops.preprocess import preprocess_batch, center_crop
+
+
+def _u8(shape, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, 256, size=shape), jnp.uint8)
+
+
+def _compare(name, resize, crop, *, rtol, atol, seed=1, **kwargs):
+    std = create_model(name, **kwargs)
+    fold = create_model(name, fold_preprocess=True, **kwargs)
+    u8 = _u8((2, resize, resize, 3), seed)
+    variables = std.init(jax.random.PRNGKey(seed),
+                         jnp.zeros((1, crop, crop, 3), jnp.float32),
+                         train=False)
+    # identical parameter tree: the folded stem creates the same params
+    assert (jax.tree.structure(variables) ==
+            jax.tree.structure(fold.init(
+                jax.random.PRNGKey(seed),
+                jnp.zeros((1, crop, crop, 3), jnp.float32), train=False)))
+    want = std.apply(variables, preprocess_batch(u8, crop=crop),
+                     train=False)
+    got = fold.apply(variables, center_crop(u8, crop), train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=rtol, atol=atol)
+    return np.asarray(want), np.asarray(got)
+
+
+def test_resnet18_folded_stem_matches():
+    # f32 compute: the fold is exact to reassociation-level rounding.
+    # 64² input exercises the 7x7/s2 stem's zero-padding borders heavily
+    _compare("resnet18", 64, 56, rtol=2e-4, atol=2e-4,
+             dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def test_resnet18_folded_stem_matches_bf16():
+    want, got = _compare("resnet18", 64, 56, rtol=0.1, atol=0.1)
+    assert np.array_equal(want.argmax(-1), got.argmax(-1))
+
+
+def test_resnet50_folded_stem_matches():
+    _compare("resnet50", 64, 56, rtol=2e-4, atol=2e-4,
+             dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def test_alexnet_folded_stem_matches():
+    _compare("alexnet", 256, 224, rtol=2e-4, atol=2e-4,
+             dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def test_vit_folded_patch_embed_matches():
+    _compare("vit_tiny", 64, 32, rtol=2e-4, atol=2e-4)
+
+
+def test_fold_and_s2d_conflict():
+    m = create_model("resnet18", fold_preprocess=True, stem_s2d=True)
+    with pytest.raises(ValueError, match="recast the stem"):
+        m.init(jax.random.PRNGKey(0), jnp.zeros((1, 56, 56, 3)),
+               train=False)
+
+
+def test_engine_fold_mode_matches_xla(tmp_path):
+    """Engine-level: preprocess='fold' serves the same top-1 stream as
+    'xla' from the same seed (same init → same params → same classes)."""
+    from idunno_tpu.config import EngineConfig
+    from idunno_tpu.engine.inference import InferenceEngine
+
+    imgs = np.asarray(_u8((8, 256, 256, 3), 7))
+    engines = {}
+    for mode in ("xla", "fold"):
+        eng = InferenceEngine(
+            EngineConfig(batch_size=8, preprocess=mode,
+                         compute_dtype="float32", param_dtype="float32"),
+            pretrained=False)
+        engines[mode] = eng.infer_batch("resnet18", imgs)
+    idx_x, prob_x = engines["xla"]
+    idx_f, prob_f = engines["fold"]
+    np.testing.assert_array_equal(idx_x, idx_f)
+    np.testing.assert_allclose(prob_x, prob_f, rtol=2e-3, atol=2e-3)
+
+
+def test_engine_fold_rejects_unsupported_combo():
+    from idunno_tpu.config import EngineConfig
+    from idunno_tpu.engine.inference import InferenceEngine
+
+    eng = InferenceEngine(EngineConfig(batch_size=8, preprocess="fold",
+                                       stem_s2d=True), pretrained=False)
+    with pytest.raises(ValueError, match="pick one"):
+        eng.load("resnet18")

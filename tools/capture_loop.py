@@ -35,8 +35,12 @@ OUTER_TIMEOUT_S = 1300
 # touched the chip; the headline CNN number exists and only needs a
 # refresh for provenance.
 STEPS = [
+    # BENCH_TRACE=1: the suite also writes .trace/lm_decode (one extra
+    # steady-state dispatch under the profiler) — the decode
+    # trace→apportion→fix evidence; parse with tools/parse_trace.py
     ("lm_suite",
-     {"BENCH_SUITE": "lm", "BENCH_TIME_BUDGET_S": "600"},
+     {"BENCH_SUITE": "lm", "BENCH_TIME_BUDGET_S": "600",
+      "BENCH_TRACE": "1"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_lm.json"),
     ("headline_resnet18",

@@ -1,0 +1,127 @@
+"""Apportion a captured ``.trace/<name>`` profile into per-op device time.
+
+Round 4 parsed the bs256 CNN trace into ``TRACE_BS256.json`` ad-hoc; this
+tool makes that step reproducible for every trace the bench writes
+(CNN sweep steps, LM decode dispatches). It reads the ``vm.xplane.pb``
+XSpace proto (via the tensorflow.tsl profiler protos already in the
+image), sums device time per XLA op over the ``XLA Ops`` line of the TPU
+device plane, and writes the same JSON shape the round-4 artifact used:
+
+    python tools/parse_trace.py .trace/lm_decode TRACE_LM_DECODE.json \
+        [--steps N]
+
+``--steps`` divides totals into per-step numbers (e.g. timed dispatches x
+decode_steps for a decode trace). The top entries plus anything >= 0.5%%
+of device time are kept; the rest aggregate into an "(other)" row.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def load_xspace(trace_dir: str):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    hits = sorted(glob.glob(os.path.join(
+        trace_dir, "**", "*.xplane.pb"), recursive=True))
+    if not hits:
+        raise FileNotFoundError(f"no *.xplane.pb under {trace_dir}")
+    sp = xplane_pb2.XSpace()
+    with open(hits[-1], "rb") as f:       # latest capture in the dir
+        sp.ParseFromString(f.read())
+    return sp, hits[-1]
+
+
+# region ops whose timeline span COVERS their body ops — counting them
+# alongside their leaves would double the total (the bs256 trace's outer
+# while alone is 50% of the raw line)
+_WRAPPERS = ("while", "conditional", "call", "fusion_wrapper", "tuple")
+
+
+def _short(name: str) -> str:
+    """'%fusion.295 = bf16[...] fusion(...)' → 'fusion.295'."""
+    head = name.split(" = ", 1)[0].strip()
+    return head[1:] if head.startswith("%") else head
+
+
+def device_op_times(sp) -> tuple[dict[str, tuple[float, int]], str]:
+    """{short op name: (total_seconds, count)} of LEAF ops from the first
+    device plane's "XLA Ops" line (device-side wall time per instance;
+    region wrappers like while/conditional excluded — their span covers
+    the leaves they contain)."""
+    for pl in sp.planes:
+        if not pl.name.startswith("/device:"):
+            continue
+        names = {m.id: m.name for m in pl.event_metadata.values()}
+        for ln in pl.lines:
+            if ln.name != "XLA Ops":
+                continue
+            agg: dict[str, list[float]] = defaultdict(lambda: [0.0, 0])
+            for ev in ln.events:
+                name = _short(names.get(ev.metadata_id,
+                                        str(ev.metadata_id)))
+                if name.split(".")[0] in _WRAPPERS:
+                    continue
+                row = agg[name]
+                row[0] += ev.duration_ps / 1e12
+                row[1] += 1
+            return ({k: (v[0], int(v[1])) for k, v in agg.items()},
+                    pl.name)
+    raise RuntimeError("no device plane with an 'XLA Ops' line in trace")
+
+
+def apportion(trace_dir: str, steps: int | None = None,
+              top: int = 40) -> dict:
+    sp, src = load_xspace(trace_dir)
+    ops, plane = device_op_times(sp)
+    total_s = sum(t for t, _ in ops.values())
+    rows = sorted(((name, t, c) for name, (t, c) in ops.items()),
+                  key=lambda r: -r[1])
+    out_rows, other_s, other_c = [], 0.0, 0
+    for i, (name, t, c) in enumerate(rows):
+        pct = 100.0 * t / total_s if total_s else 0.0
+        if i < top or pct >= 0.5:
+            out_rows.append({"op": name, "total_ms": round(t * 1e3, 3),
+                             "pct": round(pct, 2), "count": c})
+        else:
+            other_s += t
+            other_c += c
+    if other_c:
+        out_rows.append({"op": "(other)",
+                         "total_ms": round(other_s * 1e3, 3),
+                         "pct": round(100.0 * other_s / total_s, 2),
+                         "count": other_c})
+    out = {"source": src, "device_plane": plane,
+           "device_leaf_total_ms": round(total_s * 1e3, 3),
+           "ops": out_rows}
+    if steps:
+        out["steps"] = steps
+        out["per_step_ms"] = round(total_s * 1e3 / steps, 4)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("out_json", nargs="?")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="divide totals into per-step numbers")
+    ap.add_argument("--top", type=int, default=40)
+    args = ap.parse_args()
+    out = apportion(args.trace_dir, steps=args.steps, top=args.top)
+    text = json.dumps(out, indent=1)
+    if args.out_json:
+        with open(args.out_json, "w") as f:
+            f.write(text + "\n")
+    print(text if len(text) < 8000 else
+          json.dumps({k: out[k] for k in out if k != "ops"}
+                     | {"n_ops": len(out["ops"])}, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
