@@ -18,6 +18,8 @@ TINY = {
     "BENCH_LM_DECODE_STEPS": "4", "BENCH_LM_PREFILL_BATCH": "2",
     "BENCH_LM_PREFILL_SEQ": "32", "BENCH_LM_DRAFT_DIM": "32",
     "BENCH_LM_DRAFT_DEPTH": "1", "BENCH_LM_GQA_KV_HEADS": "1",
+    "BENCH_LM_TRAINED_DIM": "32", "BENCH_LM_TRAINED_DEPTH": "1",
+    "BENCH_LM_TRAINED_DRAFT_DIM": "16", "BENCH_LM_TRAINED_STEPS": "6",
 }
 
 
@@ -53,6 +55,13 @@ def test_full_suite_record_shape(tiny_env):
     # not silently become an {"error": ...} record in a live capture)
     assert rec["decode_slots_scaling"]["slots"] == 8
     assert rec["decode_slots_scaling"]["tokens_per_s"] > 0
+    # trained-draft speculative: a REAL train run (no constructed
+    # weights), commit per round within the mechanism's hard bounds
+    tr = rec["speculative_trained"]
+    assert "error" not in tr, tr
+    assert tr["train_steps"] == {"target": 6, "draft": 2}
+    assert tr["tokens_per_s"] > 0 and tr["plain_tokens_per_s"] > 0
+    assert 1.0 <= tr["avg_commit_per_round"] <= tr["draft_len"] + 1
     # tiled prefill: tokens/s must reflect tile*b*t tokens per dispatch
     assert rec["prefill"]["scan_tile"] == 1     # cpu default
 
@@ -62,6 +71,7 @@ def test_compact_skips_optional_phases(tiny_env):
                        deadline=time.perf_counter() + 600, compact=True)
     assert "speculative" not in rec and "int8_decode" not in rec
     assert "gqa_decode" not in rec and "decode_slots_scaling" not in rec
+    assert "speculative_trained" not in rec
     assert "xla_full_attention" not in rec["prefill"]
     assert rec["decode"]["tokens_per_s"] > 0
 
@@ -71,6 +81,7 @@ def test_deadline_skips_optional_phases(tiny_env):
                        deadline=time.perf_counter() - 1, compact=False)
     assert "speculative" not in rec and "int8_decode" not in rec
     assert "decode_slots_scaling" not in rec
+    assert "speculative_trained" not in rec
     assert rec["decode"]["tokens_per_s"] > 0
 
 

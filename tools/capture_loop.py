@@ -38,8 +38,11 @@ STEPS = [
     # BENCH_TRACE=1: the suite also writes .trace/lm_decode (one extra
     # steady-state dispatch under the profiler) — the decode
     # trace→apportion→fix evidence; parse with tools/parse_trace.py
+    # budget 700 (not 600): the round-5 suite adds the decode trace and
+    # the trained-draft speculative phase; watchdog = 1.8x700 = 1260 s
+    # stays inside the 1300 s outer kill
     ("lm_suite",
-     {"BENCH_SUITE": "lm", "BENCH_TIME_BUDGET_S": "600",
+     {"BENCH_SUITE": "lm", "BENCH_TIME_BUDGET_S": "700",
       "BENCH_TRACE": "1"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_lm.json"),
