@@ -327,7 +327,15 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
         return float(np.median(times)), c_s
 
     try:
-        attn = make_attn_fn("flash" if platform == "tpu" else "full")
+        # block sizes pinnable from a FLASH_SWEEP.json capture
+        # (tools/flash_sweep.py): the kernel's default must stay
+        # measurement-backed
+        fkw = {}
+        if os.environ.get("BENCH_LM_FLASH_BQ"):
+            fkw = {"block_q": _env_int("BENCH_LM_FLASH_BQ", 128),
+                   "block_k": _env_int("BENCH_LM_FLASH_BK", 128)}
+        attn = (make_attn_fn("flash", **fkw) if platform == "tpu"
+                else make_attn_fn("full"))
         fwd_model = TransformerLM(vocab=cfg["vocab"], dim=cfg["dim"],
                                   depth=cfg["depth"], num_heads=cfg["heads"],
                                   causal=True, attn_fn=attn,

@@ -54,6 +54,12 @@ STEPS = [
      {},
      [sys.executable, "tools/two_model_fairshare.py"],
      "TWO_MODEL_FAIRSHARE.json"),
+    # flash earn-it-or-swap evidence: XLA baseline + block-size sweep
+    # (writes incrementally — a window closing mid-sweep keeps its rows)
+    ("flash_sweep",
+     {"BENCH_TIME_BUDGET_S": "600"},
+     [sys.executable, "tools/flash_sweep.py"],
+     "FLASH_SWEEP.json"),
     # secondary-model records skip the compact LM sub-bench: lm_suite
     # already captures it in richer form, and a tunnel window is scarce
     ("resnet50",

@@ -1,0 +1,147 @@
+"""Flash-attention block-size sweep on the live chip (round-4 VERDICT
+weak #2 / next-7: prefill flash measured 10.3% MFU with untuned 128x128
+blocks and no captured XLA baseline — the kernel must EARN its default by
+measurement, same discipline as the s2d stem).
+
+Times the full LM-suite prefill forward (`utils/lm_bench.py` shapes,
+scan-tiled dispatch) through:
+
+  - stock XLA attention (the swap candidate),
+  - the Pallas flash kernel at several (block_q, block_k) configs,
+
+writing FLASH_SWEEP.json incrementally after EVERY variant (a window
+that closes mid-sweep still leaves the variants it measured). Each
+variant is one fresh compile through the tunnel (~40-75 s cold,
+disk-cached across windows via the persistent compile cache).
+
+    python tools/flash_sweep.py           # real TPU
+    python tools/flash_sweep.py --cpu     # machinery dry-run (interpret)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BLOCKS = [(128, 128), (256, 256), (512, 512), (128, 512), (256, 1024)]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--budget-s", type=float, default=float(
+        os.environ.get("BENCH_TIME_BUDGET_S", "600")))
+    ap.add_argument("--out", default=os.path.join(REPO, "FLASH_SWEEP.json"))
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import peak_bf16_for, provenance
+    from idunno_tpu.models.transformer import TransformerLM, make_attn_fn
+    from idunno_tpu.utils.compile_cache import enable_persistent_cache
+    from idunno_tpu.utils.lm_bench import lm_bench_config
+    enable_persistent_cache()
+
+    t_start = time.perf_counter()
+    dev = jax.devices()[0]
+    platform = dev.platform
+    if not args.cpu and platform != "tpu":
+        print(json.dumps({"error": f"need a TPU, got {platform}"}))
+        return 2
+
+    cfg = lm_bench_config(platform)
+    dt = jnp.bfloat16 if platform == "tpu" else jnp.float32
+    b, t, tile = cfg["prefill_batch"], cfg["prefill_seq"], max(
+        1, cfg["prefill_tile"])
+    base = dict(vocab=cfg["vocab"], dim=cfg["dim"], depth=cfg["depth"],
+                num_heads=cfg["heads"], causal=True, dtype=dt,
+                param_dtype=dt)
+    model0 = TransformerLM(**base)
+    params = model0.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    peak = peak_bf16_for(jax.devices()) if platform == "tpu" else None
+    toks = jnp.asarray(np.random.default_rng(0).integers(
+        1, cfg["vocab"], size=(tile, b, t)), jnp.int32)
+
+    out: dict = {"platform": platform,
+                 "device_kind": getattr(dev, "device_kind", platform),
+                 "batch": b, "seq": t, "scan_tile": tile,
+                 "model": {k: cfg[k] for k in
+                           ("dim", "depth", "heads", "vocab")},
+                 "variants": []}
+
+    def flush():
+        out["provenance"] = provenance()
+        if not args.cpu:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+
+    def timed(m):
+        f = jax.jit(lambda p, xs: jax.lax.scan(
+            lambda c, x: (c, m.apply({"params": p}, x)), None, xs)[1])
+        t0 = time.perf_counter()
+        np.asarray(f(params, toks)[0, 0, 0, 0])
+        c_s = time.perf_counter() - t0
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(f(params, toks)[0, 0, 0, 0])
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times)), c_s
+
+    def record(label, attn_kw):
+        try:
+            attn = make_attn_fn(**attn_kw)
+            m = TransformerLM(**base, attn_fn=attn)
+            sec, c_s = timed(m)
+            row = {"variant": label,
+                   "tokens_per_s": round(tile * b * t / sec, 1),
+                   "median_s": round(sec, 4), "compile_s": round(c_s, 2)}
+            if peak:
+                flops_tok = 2.0 * n_params + 4.0 * t * cfg["dim"] * \
+                    cfg["depth"]
+                row["mfu"] = round(
+                    (tile * b * t / sec) * flops_tok / peak, 4)
+        except Exception as e:  # noqa: BLE001
+            row = {"variant": label, "error": f"{type(e).__name__}: {e}"}
+        out["variants"].append(row)
+        flush()
+        print(json.dumps(row), flush=True)
+
+    record("xla_full", {"kind": "full"})
+    for bq, bk in BLOCKS:
+        if time.perf_counter() - t_start > args.budget_s:
+            out["variants"].append({"variant": f"flash_{bq}x{bk}",
+                                    "skipped": "time budget"})
+            flush()
+            continue
+        kw = {"kind": "flash", "block_q": bq, "block_k": bk}
+        if args.cpu:
+            kw["interpret"] = True
+        record(f"flash_{bq}x{bk}", kw)
+
+    ok = [v for v in out["variants"] if "tokens_per_s" in v]
+    if ok:
+        best = max(ok, key=lambda v: v["tokens_per_s"])
+        out["best"] = best["variant"]
+        out["recommendation"] = (
+            "swap prefill default to stock XLA attention"
+            if best["variant"] == "xla_full"
+            else f"keep flash; pin blocks via {best['variant']}")
+    flush()
+    print(json.dumps({k: out.get(k) for k in ("best", "recommendation")}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
