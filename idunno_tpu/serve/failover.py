@@ -46,12 +46,24 @@ class FailoverManager:
         self._received: dict[str, Any] | None = None
         self._received_seq = -1
         self._adopted = False
+        # standby-side per-query write-ahead deltas, (model, qnum) →
+        # {"tasks": [...wire...], "dataset": ...}; applied on adopt for
+        # queries the newest full snapshot predates, pruned as snapshots
+        # catch up (wal_append / _handle / adopt)
+        self._wal: dict[tuple[str, int], dict[str, Any]] = {}
         transport.serve(SERVICE, self._handle)
         membership.on_change(self._on_member_change)
 
     # -- master side: periodic replication --------------------------------
 
     def snapshot(self) -> dict[str, Any]:
+        # self._lock: seq order must match state order — two interleaved
+        # builders could otherwise deliver a STALE snapshot under a
+        # HIGHER seq and the standby would keep it
+        with self._lock:
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> dict[str, Any]:
         svc = self.service
         with svc._results_lock:
             results = {f"{m}\x00{q}": [list(r) for r in v]
@@ -84,17 +96,51 @@ class FailoverManager:
         except TransportError:
             return False
 
+    def wal_append(self, model: str, qnum: int, tasks, dataset) -> bool:
+        """Synchronous per-query write-ahead for the submit path: a query
+        the master has ACKed must survive an immediate coordinator death,
+        not just one that lands after the next periodic tick. Ships ONLY
+        the new query's task bookings (a few hundred bytes — the full
+        snapshot grows with cluster lifetime and belongs on the periodic
+        loop, not inside every client ack), on a short timeout so an
+        alive-but-degraded standby bounds ack latency. Skips (False) when
+        the standby is not currently ALIVE — a dead standby must not add
+        its timeout to every ack; the periodic loop resumes replication
+        when it returns."""
+        standby = self.config.standby_coordinator
+        if (standby == self.host or not self.membership.is_acting_master
+                or standby not in self.membership.members.alive_hosts()):
+            return False
+        msg = Message(MessageType.METADATA, self.host,
+                      {"wal": {"model": model, "qnum": int(qnum),
+                               "tasks": [t.to_wire() for t in tasks],
+                               "dataset": dataset}})
+        try:
+            return self.transport.call(standby, SERVICE, msg,
+                                       timeout=2.0) is not None
+        except TransportError:
+            return False
+
     # -- standby side ------------------------------------------------------
 
     def _handle(self, service: str, msg: Message) -> Message | None:
         if msg.type is not MessageType.METADATA:
             return None
         with self._lock:
+            if "wal" in msg.payload:        # per-query write-ahead delta
+                d = msg.payload["wal"]
+                self._wal[(d["model"], int(d["qnum"]))] = d
+                return Message(MessageType.ACK, self.host)
             seq = int(msg.payload.get("seq", 0))
             if seq > self._received_seq:
                 self._received = msg.payload
                 self._received_seq = seq
                 self._adopted = False
+                # deltas the snapshot has caught up with are durable in it
+                have = {(t["model"], int(t["qnum"]))
+                        for t in msg.payload.get("tasks", [])}
+                self._wal = {k: v for k, v in self._wal.items()
+                             if k not in have}
         return Message(MessageType.ACK, self.host)
 
     def _on_member_change(self, host: str, old: MemberStatus | None,
@@ -105,28 +151,43 @@ class FailoverManager:
             self.adopt()
 
     def adopt(self) -> None:
-        """Become the coordinator: load the newest replicated snapshot and
-        resume every unfinished range."""
+        """Become the coordinator: load the newest replicated snapshot,
+        apply any write-ahead deltas it predates, and resume every
+        unfinished range."""
         with self._lock:
-            if self._adopted or self._received is None:
+            if self._adopted or (self._received is None
+                                 and not self._wal):
                 return
             snap = self._received
             self._adopted = True
+            wal = dict(self._wal)
         svc = self.service
-        svc.scheduler.book.load_wire(snap["tasks"])
-        with svc._results_lock:
-            svc._qnum.update({m: max(int(q), svc._qnum.get(m, 0))
-                              for m, q in snap["qnum"].items()})
-        svc.metrics.load_wire(snap["metrics"])
-        with svc._results_lock:
-            for key, recs in snap["results"].items():
-                m, q = key.split("\x00")
-                existing = svc._results.setdefault((m, int(q)), [])
-                seen = {tuple(r) for r in existing}
-                existing.extend(tuple(r) for r in recs
-                                if tuple(r) not in seen)
+        if snap is not None:
+            svc.scheduler.book.load_wire(snap["tasks"])
+            with svc._results_lock:
+                svc._qnum.update({m: max(int(q), svc._qnum.get(m, 0))
+                                  for m, q in snap["qnum"].items()})
+            svc.metrics.load_wire(snap["metrics"])
+            with svc._results_lock:
+                for key, recs in snap["results"].items():
+                    m, q = key.split("\x00")
+                    existing = svc._results.setdefault((m, int(q)), [])
+                    seen = {tuple(r) for r in existing}
+                    existing.extend(tuple(r) for r in recs
+                                    if tuple(r) not in seen)
+        # write-ahead deltas: queries ACKed after the newest snapshot was
+        # built (possibly before ANY snapshot ran) — re-book their task
+        # assignments so resume_in_flight re-dispatches them
+        from idunno_tpu.scheduler.tasks import Task
+        for (m, q), d in sorted(wal.items()):
+            if not svc.scheduler.book.tasks_for_query(m, q):
+                svc.scheduler.book.record(
+                    [Task.from_wire(t) for t in d["tasks"]])
+            with svc._results_lock:
+                svc._qnum[m] = max(svc._qnum.get(m, 0), int(q))
         self.resume_in_flight()
-        if self.lm_manager is not None and "lm" in snap:
+        if self.lm_manager is not None and snap is not None \
+                and "lm" in snap:
             self.lm_manager.load_wire(snap["lm"])
             self.lm_manager.on_adopt()
 

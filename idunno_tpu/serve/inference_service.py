@@ -80,6 +80,11 @@ class InferenceService:
         self.metrics = metrics or MetricsTracker(clock=clock)
         self.scheduler = scheduler or FairScheduler(config, clock=clock)
         self.dataset_root = dataset_root
+        # synchronous standby write-ahead invoked at the end of every
+        # master-side submit as wal_hook(model, qnum, tasks, dataset)
+        # (serve/node.py wires it to FailoverManager.wal_append);
+        # None = periodic-only replication
+        self.wal_hook = None
 
         # coordinator state
         self._qnum: dict[str, int] = {}          # per-model counter (`:965-966`)
@@ -244,6 +249,14 @@ class InferenceService:
                                       dataset=dataset)
         for t in tasks:
             self._dispatch(t)
+        # write-ahead to the standby BEFORE the client sees the ack: an
+        # acked query must survive an immediate coordinator death, not
+        # only one that lands after the next periodic replication tick
+        # (FailoverManager.wal_append — a tiny per-query delta, never the
+        # full snapshot, so the ack path stays O(1); best-effort when the
+        # standby is down, like the periodic loop; wired by serve/node.py)
+        if self.wal_hook is not None:
+            self.wal_hook(model, qnum, tasks, dataset)
         return Message(MessageType.ACK, self.host, {"qnum": qnum})
 
     def _eligible_workers(self) -> list[str]:

@@ -55,6 +55,9 @@ class Node:
         self.failover = FailoverManager(host, config, transport,
                                         self.membership, self.inference,
                                         lm_manager=self.lm_manager)
+        # submit-path write-ahead: an acked query survives an immediate
+        # coordinator death (see InferenceService._master_submit)
+        self.inference.wal_hook = self.failover.wal_append
         self.grep = LogGrepService(host, config, transport, self.membership,
                                    log_dir or data_dir)
         self.control = ControlService(self)

@@ -255,6 +255,32 @@ def _count_params(params) -> tuple[int, int]:
     return n, b
 
 
+def timed_prefill_dispatch(model, params, tiled_toks) -> tuple[float, float]:
+    """(median seconds per scan-TILED prefill dispatch, compile seconds).
+    The single timing protocol for prefill points — the suite's prefill
+    phase AND tools/flash_sweep.py both call this, so a methodology tweak
+    (sync read, median count, tiling) can never make their numbers
+    silently incomparable."""
+    f = jax.jit(lambda p, xs: jax.lax.scan(
+        lambda c, x: (c, model.apply({"params": p}, x)), None, xs)[1])
+    t0 = time.perf_counter()
+    np.asarray(f(params, tiled_toks)[0, 0, 0, 0])      # compile + sync
+    c_s = time.perf_counter() - t0
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(f(params, tiled_toks)[0, 0, 0, 0])
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times)), c_s
+
+
+def prefill_flops_per_token(n_params: int, seq: int, dim: int,
+                            depth: int) -> float:
+    """Forward ≈ 2·params FLOPs/token + the attention quadratic term —
+    shared MFU denominator for the suite and the flash sweep."""
+    return 2.0 * n_params + 4.0 * seq * dim * depth
+
+
 def _steady_decode_tok_s(srv, cfg: dict) -> tuple[float, int, float]:
     """Fill every slot, then time K full-occupancy dispatches. Each
     `step()` ends in a host D2H read of the remaining counters
@@ -313,18 +339,7 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
             1, cfg["vocab"], size=(tile, b, t)), jnp.int32)
 
     def timed_prefill(m):
-        """(median seconds per TILED dispatch, compile seconds)."""
-        f = jax.jit(lambda p, xs: jax.lax.scan(
-            lambda c, x: (c, m.apply({"params": p}, x)), None, xs)[1])
-        t0 = time.perf_counter()
-        np.asarray(f(params, tiled_toks)[0, 0, 0, 0])    # compile + sync
-        c_s = time.perf_counter() - t0
-        times = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            np.asarray(f(params, tiled_toks)[0, 0, 0, 0])
-            times.append(time.perf_counter() - t0)
-        return float(np.median(times)), c_s
+        return timed_prefill_dispatch(m, params, tiled_toks)
 
     try:
         # block sizes pinnable from a FLASH_SWEEP.json capture
@@ -349,9 +364,8 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
                           else "full (xla; flash needs tpu)"),
         }
         if peak_bf16:
-            # forward ≈ 2·params FLOPs/token + attention quadratic term
-            flops_tok = 2.0 * n_params + (
-                4.0 * t * cfg["dim"] * cfg["depth"])
+            flops_tok = prefill_flops_per_token(
+                n_params, t, cfg["dim"], cfg["depth"])
             out["prefill"]["mfu"] = round(
                 (tile * b * t / pre_s) * flops_tok / peak_bf16, 4)
         # flash must EARN its place vs stock XLA attention on the same

@@ -66,10 +66,15 @@ def _count_params(params) -> int:
     return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
 
 
-def _timed_steps(step_fn, state, args: tuple, iters: int):
+def _timed_steps(step_fn, state, args: tuple, iters: int,
+                 trace_name: str | None = None):
     """Compile + sync on the first call, then ``iters`` timed steps (each
     synced by a D2H read of the loss — reliable through the tunnel where
-    `block_until_ready` is not). Returns (median_s, compile_s, last_loss)."""
+    `block_until_ready` is not). Returns (median_s, compile_s, last_loss).
+    With ``trace_name`` and BENCH_TRACE=1 one extra post-timing step runs
+    under the profiler into ``.trace/<trace_name>`` (the apportionment
+    evidence behind the train-MFU analysis; parse with
+    tools/parse_trace.py)."""
     t0 = time.perf_counter()
     state, metrics = step_fn(state, *args)
     loss = float(np.asarray(metrics["loss"]))
@@ -80,6 +85,13 @@ def _timed_steps(step_fn, state, args: tuple, iters: int):
         state, metrics = step_fn(state, *args)
         loss = float(np.asarray(metrics["loss"]))
         times.append(time.perf_counter() - t0)
+    if trace_name and os.environ.get("BENCH_TRACE") == "1":
+        from idunno_tpu.utils.tracing import trace
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        with trace(os.path.join(root, ".trace", trace_name)):
+            _, m = step_fn(state, *args)
+            float(np.asarray(m["loss"]))
     return float(np.median(times)), compile_s, loss
 
 
@@ -135,7 +147,8 @@ def run_train_bench(platform: str, device_kind: str, n_devices: int,
             NamedSharding(mesh, P(DATA_AXIS)))
         step = jit_lm_train_step(model, tx, mesh)
         per_step, compile_s, loss = _timed_steps(
-            step, state, (tokens,), cfg["iters"])
+            step, state, (tokens,), cfg["iters"],
+            trace_name="train_lm" if platform == "tpu" else None)
         tok_s = batch * cfg["seq"] / per_step
         out["lm"] = {
             "tokens_per_s": round(tok_s, 1),
@@ -211,7 +224,8 @@ def run_train_bench(platform: str, device_kind: str, n_devices: int,
             labels = jax.device_put(jnp.zeros((cb,), jnp.int32), bspec)
             cstep = jit_train_step(cnn, ctx, mesh)
             perc, cc, closs = _timed_steps(
-                cstep, cstate, (images, labels), cfg["iters"])
+                cstep, cstate, (images, labels), cfg["iters"],
+                trace_name="train_cnn" if platform == "tpu" else None)
             ips = cb / perc
             out["cnn"] = {
                 "model": "resnet18", "images_per_s": round(ips, 1),

@@ -64,6 +64,62 @@ def test_replication_and_takeover_resumes_unfinished(cluster):
         expected_names(0, 199)
 
 
+def test_wal_submit_survives_immediate_coordinator_death(cluster):
+    """Write-ahead on the submit path (round-5): with wal_hook wired the
+    way serve/node.py wires it, a query the master ACKED survives a
+    coordinator that dies IMMEDIATELY after the ack — no periodic
+    replication tick EVER ran (the delta path must work with no full
+    snapshot on the standby at all)."""
+    cfg, net, clock, members, services, failovers, engines = cluster
+    services["n0"].wal_hook = failovers["n0"].wal_append
+    qnum = services["n2"].submit_query("resnet", 0, 199)
+    net.kill("n0")                       # dies inside the same "tick"
+    pump(members, clock, waves=8, dt=0.3)
+    members["n1"].monitor_once()
+    assert members["n1"].is_acting_master
+    run_jobs({h: s for h, s in services.items() if h != "n0"})
+    assert services["n1"].query_done("resnet", qnum)
+    assert {r[0] for r in services["n1"].results("resnet", qnum)} == \
+        expected_names(0, 199)
+
+
+def test_wal_delta_applies_on_top_of_older_snapshot(cluster):
+    """A snapshot from BEFORE the acked query plus the query's WAL delta
+    must reconstruct it on adopt; a later snapshot that contains the
+    query prunes its delta (no double-booking either way)."""
+    cfg, net, clock, members, services, failovers, engines = cluster
+    services["n0"].wal_hook = failovers["n0"].wal_append
+    q1 = services["n2"].submit_query("resnet", 0, 99)
+    assert failovers["n0"].replicate_once()      # snapshot with q1 only
+    q2 = services["n2"].submit_query("resnet", 100, 199)   # delta only
+    assert (("resnet", q2) in failovers["n1"]._wal
+            and ("resnet", q1) not in failovers["n1"]._wal)
+    net.kill("n0")
+    pump(members, clock, waves=8, dt=0.3)
+    members["n1"].monitor_once()
+    run_jobs({h: s for h, s in services.items() if h != "n0"})
+    for q, lo, hi in ((q1, 0, 99), (q2, 100, 199)):
+        assert services["n1"].query_done("resnet", q)
+        assert {r[0] for r in services["n1"].results("resnet", q)} == \
+            expected_names(lo, hi)
+    # a fresh query on the new master continues the qnum sequence
+    assert services["n2"].submit_query("resnet", 200, 219) == q2 + 1
+
+
+def test_wal_skips_dead_standby(cluster):
+    """A dead standby must not stall submits: wal_append returns False
+    fast (no transport timeout) and the ack path proceeds."""
+    cfg, net, clock, members, services, failovers, engines = cluster
+    services["n0"].wal_hook = failovers["n0"].wal_append
+    net.kill("n1")
+    pump(members, clock, waves=8, dt=0.3)
+    members["n0"].monitor_once()          # mark the silent standby dead
+    assert "n1" not in members["n0"].members.alive_hosts()
+    qnum = services["n2"].submit_query("resnet", 0, 99)
+    run_jobs({h: s for h, s in services.items() if h != "n1"})
+    assert services["n0"].query_done("resnet", qnum)
+
+
 def test_qnum_continuity_after_failover(cluster):
     cfg, net, clock, members, services, failovers, engines = cluster
     services["n2"].submit_query("resnet", 0, 99)

@@ -77,8 +77,12 @@ STEPS = [
       "BENCH_LM": "0"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_vit.json"),
+    # BENCH_TRACE=1 also writes .trace/train_lm + .trace/train_cnn (one
+    # extra traced step each) — the apportionment behind the train-MFU
+    # why-note (round-4 VERDICT weak #6)
     ("train_suite",
-     {"BENCH_SUITE": "train", "BENCH_TIME_BUDGET_S": "600"},
+     {"BENCH_SUITE": "train", "BENCH_TIME_BUDGET_S": "600",
+      "BENCH_TRACE": "1"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_train.json"),
     # BENCH_NO_CACHE: this degraded single-point run must not clobber the
