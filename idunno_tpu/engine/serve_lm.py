@@ -500,6 +500,13 @@ class DecodeServer:
                                    cache_shapes)
         self._cursors = zeros((slots,), jnp.int32)
         self._remaining = zeros((slots,), jnp.int32)
+        # host cache of (remaining, cursors), fetched as ONE stacked D2H
+        # transfer and reused until a device-side mutation invalidates it:
+        # step() consults these arrays several times per dispatch, and
+        # through the tunnel every separate np.asarray is a full round
+        # trip — the fixed latency that dominated the 2026-07-31 decode
+        # capture (0.87 s/dispatch against ~0.6 s of device work)
+        self._rc_cache: np.ndarray | None = None
         self._temps = zeros((slots,), jnp.float32)
         self._top_ps = zeros((slots,), jnp.float32) + 1.0
         self._top_ks = zeros((slots,), jnp.int32)        # 0 = no k-filter
@@ -917,12 +924,13 @@ class DecodeServer:
                 # during the last dispatch and merely awaits retirement)
                 # is COMPLETE, not cancellable — labelling it cancelled
                 # would mislabel a full stream as a truncated partial
-                if int(np.asarray(self._remaining)[slot]) == 0:
+                if int(self._remaining_cursors()[0][slot]) == 0:
                     return "unknown"
                 # zeroing the row's budget makes the next
                 # `_retire_finished` pass retire it through the normal
                 # path; the freed slot admits the next queued prompt
                 self._remaining = self._remaining.at[slot].set(0)
+                self._rc_invalidate()
                 self._cancelled.add(rid)
                 self._stats["cancelled"] += 1
                 return "live"
@@ -935,7 +943,7 @@ class DecodeServer:
         (they have no progress)."""
         if not self._live:
             return []
-        cursors = np.asarray(self._cursors)
+        cursors = self._remaining_cursors()[1]
         tokens = np.asarray(self._tokens)
         return [{"id": req.id,
                  "tokens": [int(t) for t in tokens[slot][:cursors[slot] + 1]],
@@ -974,11 +982,22 @@ class DecodeServer:
 
     # -- serving loop -----------------------------------------------------
 
+    def _remaining_cursors(self) -> tuple[np.ndarray, np.ndarray]:
+        """Host view of (remaining, cursors) — one stacked D2H transfer,
+        cached until `_rc_invalidate` (every device-side mutation site:
+        dispatch, admission, cancel, stop-truncation)."""
+        if self._rc_cache is None:
+            self._rc_cache = np.asarray(
+                jnp.stack([self._remaining, self._cursors]))
+        return self._rc_cache[0], self._rc_cache[1]
+
+    def _rc_invalidate(self) -> None:
+        self._rc_cache = None
+
     def _retire_finished(self) -> None:
         if not self._live:
             return
-        remaining = np.asarray(self._remaining)
-        cursors = np.asarray(self._cursors)
+        remaining, cursors = self._remaining_cursors()
         for slot in [s for s, r in enumerate(remaining)
                      if r == 0 and s in self._live]:
             req = self._live.pop(slot)
@@ -1077,6 +1096,7 @@ class DecodeServer:
             if self.eos_id is not None and int(first) == self.eos_id:
                 rem = 0                   # the prompt's very next token
             self._remaining = self._remaining.at[slot].set(rem)
+            self._rc_invalidate()
             self._live[slot] = req
             self._stats["admitted"] += 1
             # max_new == 1: the prefill's token was the only one; the next
@@ -1103,7 +1123,7 @@ class DecodeServer:
             return
         bound = self.decode_steps * (
             self.draft_len + 1 if self._draft_model is not None else 1)
-        cursors = np.asarray(self._cursors)
+        cursors = self._remaining_cursors()[1]
         for slot, seqs in stops.items():
             gen_start = len(self._live[slot].tokens)
             end = int(cursors[slot]) + 1
@@ -1126,6 +1146,7 @@ class DecodeServer:
                 continue
             self._cursors = self._cursors.at[slot].set(best - 1)
             self._remaining = self._remaining.at[slot].set(0)
+            self._rc_invalidate()
 
     def step(self) -> int:
         """Retire finished rows, admit queued prompts into free slots, run
@@ -1155,6 +1176,7 @@ class DecodeServer:
                     self._top_ks, self._keys, self._logprobs,
                     self._pres, self._freq, self._counts)
             self._stats["dispatches"] += 1
+            self._rc_invalidate()         # the dispatch advanced the rows
             self._apply_stops()
             self._retire_finished()
         return len(self._live) + len(self._queue)
