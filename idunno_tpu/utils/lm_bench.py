@@ -342,13 +342,14 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
         return timed_prefill_dispatch(m, params, tiled_toks)
 
     try:
-        # block sizes pinnable from a FLASH_SWEEP.json capture
-        # (tools/flash_sweep.py): the kernel's default must stay
-        # measurement-backed
+        # kernel defaults are the 2026-08-01 FLASH_SWEEP.json winner
+        # (256x1024); BENCH_LM_FLASH_BQ/BK override per-key for re-sweeps
+        # — an unset key genuinely inherits the kernel signature default
         fkw = {}
         if os.environ.get("BENCH_LM_FLASH_BQ"):
-            fkw = {"block_q": _env_int("BENCH_LM_FLASH_BQ", 128),
-                   "block_k": _env_int("BENCH_LM_FLASH_BK", 128)}
+            fkw["block_q"] = _env_int("BENCH_LM_FLASH_BQ", 0)
+        if os.environ.get("BENCH_LM_FLASH_BK"):
+            fkw["block_k"] = _env_int("BENCH_LM_FLASH_BK", 0)
         attn = (make_attn_fn("flash", **fkw) if platform == "tpu"
                 else make_attn_fn("full"))
         fwd_model = TransformerLM(vocab=cfg["vocab"], dim=cfg["dim"],
@@ -363,6 +364,14 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
             "attention": ("flash (pallas, compiled)" if platform == "tpu"
                           else "full (xla; flash needs tpu)"),
         }
+        if platform == "tpu":
+            # the geometry that actually ran (env override or kernel
+            # default, lowered through resolve_blocks) — without this an
+            # overridden capture is indistinguishable from a default one
+            from idunno_tpu.ops.flash_attention import resolve_blocks
+            ebq, ebk, _ = resolve_blocks(t, **fkw) if fkw \
+                else resolve_blocks(t)
+            out["prefill"]["flash_blocks"] = f"{ebq}x{ebk}"
         if peak_bf16:
             flops_tok = prefill_flops_per_token(
                 n_params, t, cfg["dim"], cfg["depth"])

@@ -66,6 +66,16 @@ def _count_params(params) -> int:
     return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
 
 
+def _effective_flash_blocks(seq: int) -> str:
+    """The geometry `flash_attention` will actually run at this sequence
+    length (kernel defaults lowered through `resolve_blocks`) — derived,
+    not hardcoded, so neither a default re-tune nor a non-default
+    BENCH_TRAIN_SEQ can make this provenance field lie."""
+    from idunno_tpu.ops.flash_attention import resolve_blocks
+    bq, bk, _ = resolve_blocks(seq)
+    return f"{bq}x{bk} (kernel default resolved at seq {seq})"
+
+
 def _timed_steps(step_fn, state, args: tuple, iters: int,
                  trace_name: str | None = None):
     """Compile + sync on the first call, then ``iters`` timed steps (each
@@ -157,6 +167,12 @@ def run_train_bench(platform: str, device_kind: str, n_devices: int,
             "loss": round(loss, 4),
             "attention": ("flash (pallas fwd+bwd, compiled)"
                           if platform == "tpu" else "full (xla)"),
+            # record the block geometry: the FLASH_SWEEP that picked the
+            # current default measured the prefill FORWARD only, so a
+            # train capture at new blocks must be comparable-by-record
+            # against the 128x128-era 30,499 tok/s baseline
+            "flash_blocks": _effective_flash_blocks(cfg["seq"])
+                            if platform == "tpu" else None,
         }
         # fwd 2N + bwd 4N per token, plus the attention quadratic term
         # (fwd 4·T·d per layer per token, ×3 with backward)

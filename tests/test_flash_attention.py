@@ -49,6 +49,29 @@ def test_flash_ragged_seq_padded_and_masked(t, causal):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("t,causal", [(64, False), (64, True), (50, True),
+                                      (23, False)])
+def test_flash_wide_k_blocks(t, causal):
+    """block_k > block_q — the shipped default geometry (256×1024 per the
+    2026-08-01 FLASH_SWEEP) scaled down: rectangular intra-block masks and
+    the k-major accumulator order must stay exact, forward AND backward,
+    including ragged t (t=50: block_k lowers to a divisor of the padded
+    length; t=23: block_k clamps to t_pad=24 while block_q=8 stays)."""
+    q, k, v = _qkv(11, b=1, t=t, h=2, d=32)
+    flash = functools.partial(flash_attention, block_q=8, block_k=32,
+                              interpret=True)
+    want = full_attention(q, k, v, causal=causal)
+    got = flash(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    gq, gk, gv = jax.grad(_loss_of(flash, causal), argnums=(0, 1, 2))(q, k, v)
+    wq, wk, wv = jax.grad(_loss_of(full_attention, causal),
+                          argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in ((gq, wq, "dq"), (gk, wk, "dk"), (gv, wv, "dv")):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-4, rtol=2e-4, err_msg=name)
+
+
 def test_flash_as_transformer_attn_fn():
     """flash plugs into TransformerLM through the attn_fn seam."""
     from idunno_tpu.models.transformer import TransformerLM
@@ -109,7 +132,7 @@ def test_flash_grads_match_full(causal):
 @pytest.mark.parametrize("t,causal", [(17, True), (40, True), (40, False)])
 def test_flash_grads_ragged_seq(t, causal):
     """Gradients with internal padding: padded keys/queries must contribute
-    exactly zero (lcm(block_q, block_k) padding, ADVICE round-1 #3).
+    exactly zero (block_q-multiple padding, ADVICE round-1 #3).
     t=40 causal=False: seq_len divisible by block_k but t_pad > seq_len —
     the padded-key mask must key off the buffer size, not seq_len %
     block_k (review round-2 regression)."""

@@ -47,6 +47,7 @@ def main() -> int:
 
     from bench import peak_bf16_for, provenance
     from idunno_tpu.models.transformer import TransformerLM, make_attn_fn
+    from idunno_tpu.ops.flash_attention import resolve_blocks
     from idunno_tpu.utils.compile_cache import enable_persistent_cache
     from idunno_tpu.utils.lm_bench import (lm_bench_config,
                                            prefill_flops_per_token,
@@ -95,6 +96,13 @@ def main() -> int:
         path = args.out if final else args.out + ".partial.json"
         with open(path, "w") as f:
             json.dump(out, f, indent=1)
+        if final:
+            # the sidecar is progress insurance only — leaving it behind
+            # ships a stale mid-sweep record next to the real artifact
+            try:
+                os.remove(args.out + ".partial.json")
+            except OSError:
+                pass
 
     def record(label, attn_kw):
         try:
@@ -116,16 +124,33 @@ def main() -> int:
         print(json.dumps(row), flush=True)
 
     record("xla_full", {"kind": "full"})
+    measured_geom: set = set()
     for bq, bk in BLOCKS:
         if time.perf_counter() - t_start > args.budget_s:
             out["variants"].append({"variant": f"flash_{bq}x{bk}",
                                     "skipped": "time budget"})
             flush()
             continue
+        # label with the geometry that will actually execute: a request
+        # the padded length cannot host is lowered by the kernel
+        # (ops/flash_attention.py:resolve_blocks), never mislabeled here
+        # — and two requests lowering to the same geometry are the same
+        # measurement, not worth a second compile through the tunnel
+        ebq, ebk, _ = resolve_blocks(t, bq, bk)
+        if (ebq, ebk) in measured_geom:
+            out["variants"].append(
+                {"variant": f"flash_{bq}x{bk}",
+                 "skipped": f"duplicate effective geometry {ebq}x{ebk}"})
+            flush()
+            continue
+        measured_geom.add((ebq, ebk))
         kw = {"kind": "flash", "block_q": bq, "block_k": bk}
         if args.cpu:
             kw["interpret"] = True
-        record(f"flash_{bq}x{bk}", kw)
+        label = f"flash_{bq}x{bk}"
+        if (ebq, ebk) != (bq, bk):
+            label += f"_effective_{ebq}x{ebk}"
+        record(label, kw)
 
     ok = [v for v in out["variants"] if "tokens_per_s" in v]
     flash_ok = [v for v in ok if v["variant"].startswith("flash_")]
