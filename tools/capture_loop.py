@@ -85,6 +85,13 @@ STEPS = [
       "BENCH_TRACE": "1"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_train.json"),
+    # why is the fused-speculative ceiling 0.41x? — one traced plain
+    # dispatch + one traced spec dispatch, count-split into draft-loop vs
+    # verify/commit device time (tools/spec_trace.py docstring)
+    ("spec_trace",
+     {},
+     [sys.executable, "tools/spec_trace.py"],
+     "SPEC_TRACE.json"),
     # BENCH_NO_CACHE: this degraded single-point run must not clobber the
     # headline BENCH_LAST_GOOD.json captured by headline_resnet18 above.
     # bs256 (the headline's best point), not 1024: tracing overhead on top
