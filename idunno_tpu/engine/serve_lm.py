@@ -594,30 +594,46 @@ class DecodeServer:
                     {"params": params, "cache": cache}, tok,
                     mutable=["cache"])
                 cache = mutated["cache"]
-                # per-row key advance + greedy/sampled pick (row streams
-                # stay independent of co-resident rows and of admissions)
-                split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
                 l = logits[:, 0]
                 if pen:   # counts cover this row's GENERATED tokens only
                     l = (l - pres[:, None] * (counts > 0)
                          - freq[:, None] * counts.astype(l.dtype))
-                scaled = l / jnp.maximum(temps, 1e-6)[:, None]
-                # the full-vocab sort+cumsum only runs when some live row
-                # actually asked for a filter; inside that branch the
-                # PER-ROW select gives unfiltered rows the identical plain
-                # log-softmax the other branch computes, so no row's
-                # stream ever depends on its co-residents (token-exact
-                # journal replay)
-                sample_logits = jax.lax.cond(
-                    jnp.any((remaining > 0) & (temps > 0.0)
-                            & _filter_on(top_ps, top_ks)),
-                    lambda: _row_sample_logits(scaled, top_ps, top_ks),
-                    lambda: jax.nn.log_softmax(scaled, axis=-1))
-                drawn = jax.vmap(jax.random.categorical)(
-                    split[:, 0], sample_logits).astype(jnp.int32)
+
+                # sampling machinery (per-row key split, temperature
+                # scale, log-softmax, gumbel draw) runs only when a LIVE
+                # row actually samples — an all-greedy pool (the common
+                # serving and bench case) skips the whole branch. Stream
+                # exactness: with any sampled live row the branch is the
+                # byte-identical math as always; without one, no row's
+                # output reads `drawn` (greedy picks argmax) and frozen
+                # keys are harmless (a retired sampled row never draws
+                # again; admission re-seeds the slot's key).
+                def draw_sampled():
+                    # per-row key advance + sampled pick (row streams stay
+                    # independent of co-resident rows and of admissions)
+                    split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+                    scaled = l / jnp.maximum(temps, 1e-6)[:, None]
+                    # the full-vocab sort+cumsum only runs when some live
+                    # row actually asked for a filter; inside that branch
+                    # the PER-ROW select gives unfiltered rows the
+                    # identical plain log-softmax the other branch
+                    # computes, so no row's stream ever depends on its
+                    # co-residents (token-exact journal replay)
+                    sample_logits = jax.lax.cond(
+                        jnp.any((remaining > 0) & (temps > 0.0)
+                                & _filter_on(top_ps, top_ks)),
+                        lambda: _row_sample_logits(scaled, top_ps, top_ks),
+                        lambda: jax.nn.log_softmax(scaled, axis=-1))
+                    d = jax.vmap(jax.random.categorical)(
+                        split[:, 0], sample_logits).astype(jnp.int32)
+                    return d, split[:, 1]
+
+                drawn, keys = jax.lax.cond(
+                    jnp.any((remaining > 0) & (temps > 0.0)),
+                    draw_sampled,
+                    lambda: (jnp.zeros(tokens.shape[0], jnp.int32), keys))
                 nxt = jnp.where(temps > 0.0, drawn,
                                 jnp.argmax(l, axis=-1).astype(jnp.int32))
-                keys = split[:, 1]
                 wpos = jnp.clip(cursors + 1, 0, self.max_len - 1)
                 old = jnp.take_along_axis(tokens, wpos[:, None], axis=1)[:, 0]
                 rows = jnp.arange(tokens.shape[0])
