@@ -109,6 +109,13 @@ STEPS = [
       "BENCH_NO_CACHE": "1"},
      [sys.executable, "bench.py"],
      ".trace"),
+    # refresh the LM suite once more at the post-window tree: the
+    # sweep-tuned 256x1024 flash default and the all-greedy sampling
+    # fast path both landed AFTER the 02:20 window's lm_suite capture
+    ("lm_suite_refresh",
+     {"BENCH_SUITE": "lm", "BENCH_TIME_BUDGET_S": "700"},
+     [sys.executable, "bench.py"],
+     "BENCH_LAST_GOOD_lm.json"),
 ]
 
 
