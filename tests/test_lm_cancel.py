@@ -56,11 +56,18 @@ def _poll_until(loop, want_ids, deadline_s=120.0):
 
 # -- LMServingLoop ---------------------------------------------------------
 
-def test_loop_cancel_and_snapshot(lm):
-    model, params = lm
-    # a LONG stream (500 tokens) so the cancel reliably lands mid-decode
-    # even on a fast host — an 80-token request can complete before the
-    # cancel call reaches the loop
+def test_loop_cancel_and_snapshot():
+    # a LONG stream (500 tokens) through a deliberately BIGGER model than
+    # the shared fixture: once the decode program is compile-cached, the
+    # fixture-sized model drains 500 tokens faster than the 20 ms
+    # snapshot poll (observed as a flake on a loaded xdist box — snapshot
+    # returned [] because the stream finished between polls), and the
+    # cancel-lands-mid-stream asserts below share the same race. At
+    # dim 192 x depth 3 the stream takes ~1 s on CPU, so snapshot and
+    # cancel reliably catch it live with no timing assumptions.
+    model = TransformerLM(vocab=VOCAB, dim=192, depth=3, num_heads=4)
+    params = model.init(jax.random.PRNGKey(2),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
     loop = LMServingLoop(DecodeServer(model, params, slots=1, prompt_len=4,
                                       max_len=520))
     try:
