@@ -6,8 +6,33 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from idunno_tpu.ops.flash_attention import flash_attention
+from idunno_tpu.ops.flash_attention import flash_attention, resolve_blocks
 from idunno_tpu.parallel.ring_attention import full_attention
+
+
+def test_resolve_blocks_geometry():
+    """The single source of truth for effective block geometry: padding
+    is always a block_q multiple (never an lcm blowup), both effective
+    blocks divide it, and the block_k lowering picks the largest
+    multiple-of-8 divisor rather than collapsing to block_q."""
+    # (t, expected (bq, bk, t_pad)) at the shipped 256x1024 defaults
+    cases = {23: (23, 23, 23),        # both clamp to t
+             50: (50, 50, 50),
+             197: (197, 197, 197),    # ViT-style n_patches+1
+             300: (256, 512, 512),    # bk clamps to t_pad
+             768: (256, 768, 768),
+             1024: (256, 1024, 1024),  # the swept shape, exact
+             1100: (256, 640, 1280),  # divisor lowering, NOT 256
+             1500: (256, 768, 1536),
+             2048: (256, 1024, 2048)}
+    for t, want in cases.items():
+        got = resolve_blocks(t)
+        assert got == want, (t, got, want)
+        bq, bk, t_pad = got
+        assert t_pad % bq == 0 and t_pad % bk == 0 and t_pad >= t
+    # explicit-request path: a block_k that can never divide the padding
+    # lowers to the largest legal multiple of 8
+    assert resolve_blocks(1024, 256, 768) == (256, 512, 1024)
 
 
 def _qkv(key, b=2, t=128, h=4, d=64):

@@ -220,6 +220,39 @@ def test_per_request_sampling(lm):
     assert all(0 <= t < VOCAB for t in a[7][3:])
 
 
+def test_sampling_fast_path_boundary(lm):
+    """The decode step skips the whole sampling branch when no LIVE row
+    samples (the all-greedy fast path). This test crosses that boundary
+    mid-serving in both directions: a short sampled row retires while a
+    long greedy row keeps decoding (branch flips sampled→greedy), then a
+    NEW sampled request admits into the freed slot (greedy→sampled).
+    Greedy output must equal `generate` exactly across both flips, and
+    the late sampled stream must reproduce the same tokens it gets on a
+    fresh pool — its key chain depends only on its own admission seed."""
+    model, params = lm
+    prompt = [5, 11, 17]
+    srv = DecodeServer(model, params, slots=2, prompt_len=4, max_len=40)
+    gid = srv.submit(prompt, max_new=30)                  # long greedy
+    sid = srv.submit(prompt, max_new=4, temperature=1.0,  # short sampled
+                     seed=3)
+    done = {}
+    for _ in range(10):        # sampled row retires; steps run all-greedy
+        srv.step()
+        done.update({c.id: c.tokens for c in srv.poll()})
+        if sid in done:
+            break
+    assert sid in done and gid not in done
+    lid = srv.submit(prompt, max_new=6, temperature=1.0,  # late sampled
+                     seed=9)
+    done.update({c.id: c.tokens for c in srv.run_until_drained()})
+    assert done[gid] == expected(model, params, prompt, 30)
+
+    fresh = DecodeServer(model, params, slots=2, prompt_len=4, max_len=40)
+    fid = fresh.submit(prompt, max_new=6, temperature=1.0, seed=9)
+    fresh_tokens = {c.id: c.tokens for c in fresh.run_until_drained()}
+    assert done[lid] == fresh_tokens[fid]
+
+
 def test_speculative_decoding_exact_and_fewer_dispatches(lm):
     """Speculative decoding's contract: the committed stream is EXACTLY
     the target's own greedy sequence, for any draft. With draft == target
