@@ -260,6 +260,34 @@ def _insert_cache(cache: Any, row_cache: Any, slot: jnp.ndarray) -> Any:
     return _splice_rows(cache, row_cache, slot)
 
 
+def _fill_cand(proposals: jnp.ndarray, bonus: jnp.ndarray,
+               acc: jnp.ndarray) -> jnp.ndarray:
+    """[S, γ+1] candidate tokens from [S, γ] proposals: positions < acc
+    keep the (accepted) proposal, position acc carries the bonus token,
+    the rest are zero padding (never committed)."""
+    s, gamma = proposals.shape
+    jidx = jnp.arange(gamma + 1)[None, :]
+    props_pad = jnp.concatenate(
+        [proposals, jnp.zeros((s, 1), jnp.int32)], axis=1)
+    return jnp.where(jidx < acc[:, None], props_pad,
+                     jnp.where(jidx == acc[:, None], bonus[:, None], 0))
+
+
+def greedy_commit(proposals: jnp.ndarray,
+                  tpred: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy-lane speculative commit: accept the longest prefix where the
+    proposal equals the target argmax; bonus = the target argmax at the
+    first miss. The committed stream is exactly the target's own greedy
+    sequence. ONE definition shared by `spec_commit` (its greedy lane) and
+    the all-greedy fast path in `DecodeServer._build_spec_round`, so the
+    two can never drift."""
+    gamma = proposals.shape[1]
+    ok = proposals == tpred[:, :gamma]                       # [S, γ]
+    acc = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
+    bonus = jnp.take_along_axis(tpred, acc[:, None], axis=1)[:, 0]
+    return _fill_cand(proposals, bonus, acc), acc
+
+
 def spec_commit(proposals: jnp.ndarray, qdist: jnp.ndarray,
                 pdist: jnp.ndarray, tpred: jnp.ndarray,
                 sampled: jnp.ndarray, u: jnp.ndarray,
@@ -287,26 +315,30 @@ def spec_commit(proposals: jnp.ndarray, qdist: jnp.ndarray,
     Returns (cand [S, γ+1] int32 candidate tokens, acc [S] int32 accepted
     proposal count); callers commit ``cand[:, :acc+1]``.
     """
-    s, gamma = proposals.shape
-    # acceptance tests per position
-    greedy_ok = proposals == tpred[:, :gamma]                # [S, γ]
+    gamma = proposals.shape[1]
+    # greedy lane: the shared helper (row-wise identical to the previous
+    # merged formulation — cumprod/take/fill all commute with the per-row
+    # select below, and each row reads only its own lane)
+    cand_g, acc_g = greedy_commit(proposals, tpred)
+
+    # sampled lane: rejection acceptance per position
     p_at = jnp.take_along_axis(pdist[:, :gamma], proposals[..., None],
                                axis=2)[..., 0]               # [S, γ]
     q_at = jnp.take_along_axis(qdist, proposals[..., None],
                                axis=2)[..., 0]               # [S, γ]
     ratio = p_at / jnp.maximum(q_at, 1e-20)
     sampled_ok = u < ratio
-    ok = jnp.where(sampled[:, None], sampled_ok, greedy_ok)
-    acc = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)  # [S] 0..γ
+    acc_s = jnp.cumprod(sampled_ok.astype(jnp.int32),
+                        axis=1).sum(axis=1)                  # [S] 0..γ
 
     # bonus token at the first non-accepted position: residual sampling.
     # qdist zero-padded at position γ makes the all-accepted case fall out
     # of the same formula (residual = p_{γ+1} - 0 = the target dist).
     q_pad = jnp.concatenate([qdist, jnp.zeros_like(qdist[:, :1])], axis=1)
     p_acc = jnp.take_along_axis(
-        pdist, acc[:, None, None], axis=1)[:, 0]             # [S, V]
+        pdist, acc_s[:, None, None], axis=1)[:, 0]           # [S, V]
     q_acc = jnp.take_along_axis(
-        q_pad, acc[:, None, None], axis=1)[:, 0]             # [S, V]
+        q_pad, acc_s[:, None, None], axis=1)[:, 0]           # [S, V]
     resid = jnp.maximum(p_acc - q_acc, 0.0)
     mass = resid.sum(axis=1, keepdims=True)
     # p == q exactly → zero residual, but then rejection has probability
@@ -317,14 +349,10 @@ def spec_commit(proposals: jnp.ndarray, qdist: jnp.ndarray,
             k, jnp.where(r > 0.0, jnp.log(jnp.maximum(r, 1e-38)),
                          -jnp.inf)))(
             resid_keys, resid).astype(jnp.int32)             # [S]
-    bonus_greedy = jnp.take_along_axis(tpred, acc[:, None], axis=1)[:, 0]
-    bonus = jnp.where(sampled, bonus_sampled, bonus_greedy)  # [S]
+    cand_s = _fill_cand(proposals, bonus_sampled, acc_s)
 
-    jidx = jnp.arange(gamma + 1)[None, :]
-    props_pad = jnp.concatenate(
-        [proposals, jnp.zeros((s, 1), jnp.int32)], axis=1)
-    cand = jnp.where(jidx < acc[:, None], props_pad,
-                     jnp.where(jidx == acc[:, None], bonus[:, None], 0))
+    acc = jnp.where(sampled, acc_s, acc_g)
+    cand = jnp.where(sampled[:, None], cand_s, cand_g)
     return cand, acc
 
 
@@ -726,49 +754,107 @@ class DecodeServer:
                 active = remaining > 0
                 prev = jnp.take_along_axis(tokens, cursors[:, None],
                                            axis=1)[:, 0]    # [S]
-                any_filter = jnp.any(active & sampled
-                                     & _filter_on(top_ps, top_ks))
-                # per-row subkeys: γ draft draws + γ accept uniforms +
-                # 1 residual/bonus draw + 1 carried-forward key
-                subs = jax.vmap(
-                    lambda k: jax.random.split(k, 2 * gamma + 2))(
-                    keys)                                    # [S, 2γ+2, 2]
-                draft_keys = subs[:, :gamma]
-                accept_keys = subs[:, gamma:2 * gamma]
-                resid_keys = subs[:, 2 * gamma]
-                new_keys = subs[:, 2 * gamma + 1]
+                # sampling machinery (per-row key splits, the [S, γ, V]
+                # float32 draft-distribution carry, categorical draws, the
+                # [S, γ+1, V] target softmax, accept uniforms) runs only
+                # when a LIVE row actually samples — the all-greedy pool
+                # (the bench's constructed-ceiling point and the common
+                # serving case) skips all of it. Exactness mirrors the
+                # plain-decode fast path (`_build_decode`): with a sampled
+                # live row the branch is the byte-identical math as
+                # always; without one, greedy commits read only proposals/
+                # tpred, retired rows' state is fully gated on ``active``
+                # (their draft-cache writes land strictly past their final
+                # cursor), and frozen keys are harmless (a retired sampled
+                # row never draws again; admission re-seeds the slot).
+                any_sampling = jnp.any(active & sampled)
 
-                # -- 1. draft: gamma proposals + their full distributions ----
-                def dbody(j, carry):
-                    dcache, dcur, tok, props, qdist = carry
+                def draft_apply(dcache, dcur, tok):
+                    """One draft step shared by BOTH branches' loop bodies
+                    (cursor set, model apply, f32 logits) so the greedy
+                    fast path can never drift from the full path's model
+                    plumbing — only the sampling machinery around it is
+                    branch-local."""
                     dcache = _set_cursors(dcache, dcur)
                     logits, mutated = ddec.apply(
                         {"params": dparams, "cache": dcache},
                         tok[:, None], mutable=["cache"])
-                    l = logits[:, 0].astype(jnp.float32)         # [S, V]
-                    # per-row select inside the fast-path cond: an
-                    # unfiltered row's distribution is the plain softmax
-                    # in BOTH branches, so no row depends on co-residents
-                    q = jax.lax.cond(
-                        any_filter,
-                        lambda: jnp.where(
-                            _filter_on(top_ps, top_ks)[:, None],
-                            filtered_probs(l / safe_t, top_ps, top_ks),
-                            jax.nn.softmax(l / safe_t, axis=-1)),
-                        lambda: jax.nn.softmax(l / safe_t, axis=-1))
-                    greedy = jnp.argmax(l, axis=-1).astype(jnp.int32)
-                    draw = jax.vmap(jax.random.categorical)(
-                        draft_keys[:, j],
-                        _safe_log(q)).astype(jnp.int32)
-                    nxt = jnp.where(sampled, draw, greedy)
-                    return (mutated["cache"], dcur + 1, nxt,
-                            props.at[:, j].set(nxt),
-                            qdist.at[:, j].set(q))
+                    return mutated["cache"], logits[:, 0].astype(
+                        jnp.float32)                         # [S, V]
 
-                props0 = jnp.zeros((s, gamma), jnp.int32)
-                qdist0 = jnp.zeros((s, gamma, self.model.vocab), jnp.float32)
-                dcache, _, _, proposals, qdist = jax.lax.fori_loop(
-                    0, gamma, dbody, (dcache, cursors, prev, props0, qdist0))
+                # -- 1. draft: gamma proposals (+ full distributions and
+                # key bookkeeping only on the sampling branch) -------------
+                def draft_full():
+                    any_filter = jnp.any(active & sampled
+                                         & _filter_on(top_ps, top_ks))
+                    # per-row subkeys: γ draft draws + γ accept uniforms +
+                    # 1 residual/bonus draw + 1 carried-forward key
+                    subs = jax.vmap(
+                        lambda k: jax.random.split(k, 2 * gamma + 2))(
+                        keys)                                # [S, 2γ+2, 2]
+                    draft_keys = subs[:, :gamma]
+
+                    def dbody(j, carry):
+                        dcache, dcur, tok, props, qdist = carry
+                        dcache, l = draft_apply(dcache, dcur, tok)
+                        # per-row select inside the fast-path cond: an
+                        # unfiltered row's distribution is the plain
+                        # softmax in BOTH branches, so no row depends on
+                        # co-residents
+                        q = jax.lax.cond(
+                            any_filter,
+                            lambda: jnp.where(
+                                _filter_on(top_ps, top_ks)[:, None],
+                                filtered_probs(l / safe_t, top_ps, top_ks),
+                                jax.nn.softmax(l / safe_t, axis=-1)),
+                            lambda: jax.nn.softmax(l / safe_t, axis=-1))
+                        greedy = jnp.argmax(l, axis=-1).astype(jnp.int32)
+                        draw = jax.vmap(jax.random.categorical)(
+                            draft_keys[:, j],
+                            _safe_log(q)).astype(jnp.int32)
+                        nxt = jnp.where(sampled, draw, greedy)
+                        return (dcache, dcur + 1, nxt,
+                                props.at[:, j].set(nxt),
+                                qdist.at[:, j].set(q))
+
+                    props0 = jnp.zeros((s, gamma), jnp.int32)
+                    qdist0 = jnp.zeros((s, gamma, self.model.vocab),
+                                       jnp.float32)
+                    dc, _, _, proposals, qdist = jax.lax.fori_loop(
+                        0, gamma, dbody,
+                        (dcache, cursors, prev, props0, qdist0))
+                    return (dc, proposals, qdist,
+                            subs[:, gamma:2 * gamma],    # accept_keys
+                            subs[:, 2 * gamma],          # resid_keys
+                            subs[:, 2 * gamma + 1])      # new_keys
+
+                def draft_greedy():
+                    def dbody(j, carry):
+                        dcache, dcur, tok, props = carry
+                        dcache, l = draft_apply(dcache, dcur, tok)
+                        nxt = jnp.argmax(l, axis=-1).astype(jnp.int32)
+                        return (dcache, dcur + 1, nxt,
+                                props.at[:, j].set(nxt))
+
+                    props0 = jnp.zeros((s, gamma), jnp.int32)
+                    dc, _, _, proposals = jax.lax.fori_loop(
+                        0, gamma, dbody, (dcache, cursors, prev, props0))
+                    # the zero qdist/key stand-ins exist because cond
+                    # branches must return one pytree; the [S, γ, V] fill
+                    # is ~10 µs/round at bench shapes — accepted so the
+                    # BIG target-verify apply stays OUTSIDE the cond (one
+                    # cond spanning draft+verify+commit would compile the
+                    # verify body into both branches)
+                    return (dc, proposals,
+                            jnp.zeros((s, gamma, self.model.vocab),
+                                      jnp.float32),
+                            jnp.zeros((s, gamma) + keys.shape[1:],
+                                      keys.dtype),
+                            jnp.zeros_like(keys), keys)
+
+                (dcache, proposals, qdist, accept_keys, resid_keys,
+                 new_keys) = jax.lax.cond(any_sampling, draft_full,
+                                          draft_greedy)
 
                 # -- 2. target: verify the whole chunk in one apply ----------
                 cache = _set_cursors(cache, cursors)
@@ -778,21 +864,33 @@ class DecodeServer:
                 cache = mutated["cache"]
                 logits = logits.astype(jnp.float32)
                 tpred = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S,γ+1]
-                pdist = jax.lax.cond(
-                    any_filter,
-                    lambda: jnp.where(
-                        _filter_on(top_ps, top_ks)[:, None, None],
-                        filtered_probs(logits / safe_t[..., None],
-                                       top_ps[:, None], top_ks[:, None]),
-                        jax.nn.softmax(logits / safe_t[..., None], axis=-1)),
-                    lambda: jax.nn.softmax(logits / safe_t[..., None],
-                                           axis=-1))
 
-                # -- 3. acceptance + commit (`spec_commit`) ------------------
-                u = jax.vmap(lambda ks: jax.vmap(jax.random.uniform)(ks))(
-                    accept_keys)                                 # [S, γ]
-                cand, acc = spec_commit(proposals, qdist, pdist, tpred,
-                                        sampled, u, resid_keys)
+                # -- 3. acceptance + commit (`spec_commit`; pure greedy
+                # prefix-match commit on the all-greedy branch) -------------
+                def commit_full():
+                    any_filter = jnp.any(active & sampled
+                                         & _filter_on(top_ps, top_ks))
+                    pdist = jax.lax.cond(
+                        any_filter,
+                        lambda: jnp.where(
+                            _filter_on(top_ps, top_ks)[:, None, None],
+                            filtered_probs(logits / safe_t[..., None],
+                                           top_ps[:, None], top_ks[:, None]),
+                            jax.nn.softmax(logits / safe_t[..., None],
+                                           axis=-1)),
+                        lambda: jax.nn.softmax(logits / safe_t[..., None],
+                                               axis=-1))
+                    u = jax.vmap(
+                        lambda ks: jax.vmap(jax.random.uniform)(ks))(
+                        accept_keys)                             # [S, γ]
+                    return spec_commit(proposals, qdist, pdist, tpred,
+                                       sampled, u, resid_keys)
+
+                # greedy branch: `greedy_commit` — the same function
+                # spec_commit's greedy lane calls, so the two cannot drift
+                cand, acc = jax.lax.cond(
+                    any_sampling, commit_full,
+                    lambda: greedy_commit(proposals, tpred))
                 jidx = jnp.arange(gamma + 1)[None, :]
                 commit = jnp.minimum(acc + 1, remaining)         # [S] ≥1 active
                 if self.eos_id is not None:

@@ -253,6 +253,43 @@ def test_sampling_fast_path_boundary(lm):
     assert done[lid] == fresh_tokens[fid]
 
 
+def test_spec_fast_path_boundary(lm):
+    """The speculative round has the same all-greedy fast path as plain
+    decode (no live row samples → the draft-distribution/key/uniform
+    machinery is skipped). Cross that boundary mid-serving on a SPEC pool
+    in both directions: a short sampled row retires while a long greedy
+    row keeps decoding (rounds flip full→greedy), then a NEW sampled
+    request admits into the freed slot (greedy→full). The greedy stream
+    must equal `generate` exactly across both flips, and the late sampled
+    stream must reproduce its fresh-pool tokens — its rejection-scheme
+    key chain depends only on its own admission seed, not on which branch
+    earlier rounds took."""
+    model, params = lm
+    prompt = [5, 11, 17]
+    kw = dict(slots=2, prompt_len=4, max_len=40,
+              draft=(model, params), draft_len=3)
+    srv = DecodeServer(model, params, **kw)
+    gid = srv.submit(prompt, max_new=30)                  # long greedy
+    sid = srv.submit(prompt, max_new=4, temperature=1.0,  # short sampled
+                     seed=3)
+    done = {}
+    for _ in range(10):      # sampled row retires; rounds run all-greedy
+        srv.step()
+        done.update({c.id: c.tokens for c in srv.poll()})
+        if sid in done:
+            break
+    assert sid in done and gid not in done
+    lid = srv.submit(prompt, max_new=6, temperature=1.0,  # late sampled
+                     seed=9)
+    done.update({c.id: c.tokens for c in srv.run_until_drained()})
+    assert done[gid] == expected(model, params, prompt, 30)
+
+    fresh = DecodeServer(model, params, **kw)
+    fid = fresh.submit(prompt, max_new=6, temperature=1.0, seed=9)
+    fresh_tokens = {c.id: c.tokens for c in fresh.run_until_drained()}
+    assert done[lid] == fresh_tokens[fid]
+
+
 def test_speculative_decoding_exact_and_fewer_dispatches(lm):
     """Speculative decoding's contract: the committed stream is EXACTLY
     the target's own greedy sequence, for any draft. With draft == target
