@@ -93,9 +93,11 @@ STEPS = [
       "BENCH_TRACE": "1"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_train.json"),
-    # why is the fused-speculative ceiling 0.41x? — one traced plain
-    # dispatch + one traced spec dispatch, count-split into draft-loop vs
-    # verify/commit device time (tools/spec_trace.py docstring)
+    # why is the fused-speculative ceiling 0.41x? — three traced
+    # dispatches (plain, spec all-greedy at the fast path, the SAME spec
+    # program with sampled rows live), count-split into draft-loop vs
+    # verify/commit device time per branch (tools/spec_trace.py
+    # docstring)
     ("spec_trace",
      {},
      [sys.executable, "tools/spec_trace.py"],

@@ -15,9 +15,16 @@ rounds with draft length g, draft-loop ops run R*g times, verify/commit
 ops run R times, so `device_op_times` counts split the round cost into
 draft-loop vs verify/commit vs residual without any op-name guessing.
 
-Writes SPEC_TRACE.json (+ raw .trace/lm_spec{,_plain}); wired into
-tools/capture_loop.py. Smoke-testable off-TPU: --cpu runs tiny shapes
-with the same pool wiring but skips the profiler and artifact.
+Three traced dispatches: plain decode, the speculative pool all-greedy
+(the fast path introduced with `greedy_commit` — the constructed
+ceiling), and the SAME compiled speculative program with sampled rows
+live (the runtime cond takes the full sampling branch), so one window
+apportions both branches and the greedy-vs-sampled delta IS the cost of
+the machinery the fast path skips.
+
+Writes SPEC_TRACE.json (+ raw .trace/lm_spec{,_plain,_sampled}); wired
+into tools/capture_loop.py. Smoke-testable off-TPU: --cpu runs tiny
+shapes with the same pool wiring but skips the profiler and artifact.
 """
 from __future__ import annotations
 
@@ -85,14 +92,18 @@ def main() -> int:
                              "draft_dim", "draft_depth", "draft_len")},
                  "rounds_per_dispatch": n_rounds}
 
-    def traced_dispatch(srv, steps_label: str):
+    def traced_dispatch(srv, steps_label: str, temperature: float = 0.0):
         """Warm the pool, load every slot, run one compiled dispatch, then
-        ONE more under the profiler; returns (trace_dir, wall_s)."""
+        ONE more under the profiler; returns (trace_dir, wall_s).
+        ``temperature`` > 0 loads SAMPLED rows, forcing the spec round's
+        full sampling branch (the all-greedy fast path otherwise skips
+        the draft-distribution/uniform machinery entirely)."""
         srv.submit([1, 2, 3], max_new=2)
         srv.run_until_drained()                      # compile
         for _ in range(cfg["slots"]):
             srv.submit(list(range(1, cfg["prompt_len"] + 1)),
-                       max_new=spec_max_new(cfg))
+                       max_new=spec_max_new(cfg),
+                       temperature=temperature)
         srv.step()                                   # admission + warm step
         tdir = os.path.join(REPO, ".trace", steps_label)
         t0 = time.perf_counter()
@@ -116,6 +127,11 @@ def main() -> int:
                         draft=(draft_model, zd), draft_len=gamma,
                         decode_steps=n_rounds)
     sdir, s_wall = traced_dispatch(spec, "lm_spec")
+    # same compiled program, sampled rows live → the runtime cond takes
+    # the FULL sampling branch: one extra traced dispatch (seconds, no
+    # recompile) apportions the machinery the greedy fast path skips
+    ssdir, ss_wall = traced_dispatch(spec, "lm_spec_sampled",
+                                     temperature=1.0)
     del spec
 
     out["plain"] = {"wall_s": round(p_wall, 4),
@@ -124,36 +140,60 @@ def main() -> int:
                                               / cfg["decode_steps"], 3)}
     out["spec"] = {"wall_s": round(s_wall, 4), "rounds": n_rounds,
                    "wall_ms_per_round": round(1e3 * s_wall / n_rounds, 3)}
+    out["spec_sampled"] = {
+        "wall_s": round(ss_wall, 4), "rounds": n_rounds,
+        "wall_ms_per_round": round(1e3 * ss_wall / n_rounds, 3)}
 
     if not args.cpu:
         from tools.parse_trace import apportion, device_op_times, \
             load_xspace
+
+        def count_split(tdir):
+            # count-based split of a spec dispatch: R*gamma-count ops are
+            # the draft loop, R-count ops are verify+commit, everything
+            # else is residual (entry staging, retirement, odd fusions).
+            # gamma == 1 makes the two counts identical — the split can't
+            # distinguish the lanes, so report them combined rather than
+            # silently attributing everything to the draft loop
+            ops, _ = device_op_times(load_xspace(tdir)[0])
+            if gamma == 1:
+                split = {"round_ops_ms": 0.0, "residual_ms": 0.0,
+                         "note": "gamma=1: draft and verify execution "
+                                 "counts coincide; lanes not separable"}
+                for name, (sec, count) in ops.items():
+                    key = ("round_ops_ms"
+                           if count % n_rounds == 0 and count > 0
+                           else "residual_ms")
+                    split[key] += sec * 1e3
+                return split
+            split = {"draft_loop_ms": 0.0, "verify_commit_ms": 0.0,
+                     "residual_ms": 0.0}
+            for name, (sec, count) in ops.items():
+                if count % (n_rounds * gamma) == 0 and count > 0:
+                    split["draft_loop_ms"] += sec * 1e3
+                elif count % n_rounds == 0 and count > 0:
+                    split["verify_commit_ms"] += sec * 1e3
+                else:
+                    split["residual_ms"] += sec * 1e3
+            return split
+
         out["plain"]["apportion"] = apportion(pdir,
                                               steps=cfg["decode_steps"])
-        out["spec"]["apportion"] = apportion(sdir, steps=n_rounds)
-        # count-based split of the spec dispatch: R*gamma-count ops are the
-        # draft loop, R-count ops are verify+commit, everything else is
-        # residual (entry staging, retirement, odd-count fusions)
-        ops, _ = device_op_times(load_xspace(sdir)[0])
-        split = {"draft_loop_ms": 0.0, "verify_commit_ms": 0.0,
-                 "residual_ms": 0.0}
-        for name, (sec, count) in ops.items():
-            if count % (n_rounds * gamma) == 0 and count > 0:
-                split["draft_loop_ms"] += sec * 1e3
-            elif count % n_rounds == 0 and count > 0:
-                split["verify_commit_ms"] += sec * 1e3
-            else:
-                split["residual_ms"] += sec * 1e3
-        out["spec"]["count_split"] = {
-            k: round(v, 2) for k, v in split.items()}
-        out["spec"]["count_split_per_round_ms"] = {
-            k: round(v / n_rounds, 3) for k, v in split.items()}
+        for key, tdir in (("spec", sdir), ("spec_sampled", ssdir)):
+            out[key]["apportion"] = apportion(tdir, steps=n_rounds)
+            split = count_split(tdir)
+            out[key]["count_split"] = {
+                k: round(v, 2) if isinstance(v, float) else v
+                for k, v in split.items()}
+            out[key]["count_split_per_round_ms"] = {
+                k: round(v / n_rounds, 3)
+                for k, v in split.items() if isinstance(v, float)}
 
     out["provenance"] = provenance()
     if not args.cpu:
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)
-    print(json.dumps({k: out[k] for k in ("plain", "spec")
+    print(json.dumps({k: out[k] for k in ("plain", "spec", "spec_sampled")
                       if k in out}, default=str)[:2000])
     return 0
 
