@@ -33,6 +33,30 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
 
 
+def flat_tx(inner: "optax.GradientTransformation"
+            ) -> "optax.GradientTransformation":
+    """Run an elementwise optimizer over ONE flattened parameter vector
+    instead of per-tensor leaves (`optax.flatten`).
+
+    Why: the 2026-08-01 traced LM train step (`TRACE_TRAIN_LM.json`)
+    apportioned ~55% of device time to a 5,504-event small-op tail
+    dominated by the per-tensor adamw update stream — XLA does not fuse
+    elementwise updates across differently-shaped buffers, so every
+    param leaf pays its own fixed per-op costs. Raveling params, grads
+    and moments into a single buffer lowers the whole update to a
+    handful of large fused elementwise ops (`tests/test_train_flat_tx.py`
+    pins the compiled-instruction drop).
+
+    Exact for elementwise transforms (adam/adamw, sgd+momentum): the
+    same per-element math in a different layout — the numerics test
+    asserts bit-identical training trajectories. Trade-off: the flat
+    optimizer state is one [N] vector, which `fsdp_param_spec` can only
+    shard over the data axis when N divides it — keep per-tensor layout
+    for ZeRO-3 runs where opt-state sharding matters more than update
+    fusion."""
+    return optax.flatten(inner)
+
+
 def create_train_state(model: nn.Module, rng: jax.Array, image_size: int,
                        tx: optax.GradientTransformation,
                        batch: int = 1) -> TrainState:

@@ -89,17 +89,44 @@ class LMTrainJob:
             restore_train_state, save_train_state)
         from idunno_tpu.engine.data_lm import TokenDataset, load_corpus
         from idunno_tpu.engine.generate import save_lm
+        from idunno_tpu.engine.train import flat_tx
         from idunno_tpu.engine.train_lm import (
             create_lm_train_state, make_lm_train_step)
         from idunno_tpu.models.transformer import TransformerLM
 
         tokens = load_corpus(self.store, self.corpus)
         model = TransformerLM(**self.model_config)
-        tx = optax.adam(self.lr)
+        # flat layout: the whole adam update fuses into a few large ops
+        # instead of a per-tensor op stream (engine/train.py:flat_tx);
+        # checkpoints save/restore the flat opt_state self-consistently
+        tx = flat_tx(optax.adam(self.lr))
         state = create_lm_train_state(model, jax.random.PRNGKey(self.seed),
                                       self.seq_len, tx)
         if self.resume:
-            state, _ = restore_train_state(self.store, self.name, state)
+            def restore_checked(template):
+                # flax's from_state_dict splices whatever tree the
+                # checkpoint holds into the template WITHOUT validating
+                # structure (a per-tensor mu dict lands where the flat
+                # [N] array belongs and only explodes mid-step), so the
+                # layout probe must compare structures itself
+                restored, _ = restore_train_state(self.store, self.name,
+                                                  template)
+                if (jax.tree_util.tree_structure(restored.opt_state)
+                        != jax.tree_util.tree_structure(
+                            template.opt_state)):
+                    raise ValueError("opt_state layout mismatch")
+                return restored
+            try:
+                state = restore_checked(state)
+            except Exception:  # noqa: BLE001 - layout probe, see below
+                # checkpoint from the per-tensor era (pre-flat_tx): keep
+                # THIS job on its original layout — a bit-identical
+                # continuation beats a moment-migration — and let any
+                # genuine restore failure re-raise from this attempt.
+                tx = optax.adam(self.lr)
+                state = restore_checked(create_lm_train_state(
+                    model, jax.random.PRNGKey(self.seed), self.seq_len,
+                    tx))
         start = int(state.step)
         self._set(step=start, start_step=start)
         step_fn = jax.jit(make_lm_train_step(model, tx))

@@ -150,6 +150,7 @@ def _trained_spec_point(platform: str, cfg: dict, base_tok_s_note: str
     import optax
 
     from idunno_tpu.engine.serve_lm import DecodeServer
+    from idunno_tpu.engine.train import flat_tx
     from idunno_tpu.engine.train_lm import (create_lm_train_state,
                                             make_lm_train_step)
     from idunno_tpu.models.transformer import TransformerLM
@@ -168,7 +169,10 @@ def _trained_spec_point(platform: str, cfg: dict, base_tok_s_note: str
                           causal=True, dtype=dt, param_dtype=dt)
 
     def train(model, steps, seed):
-        tx = optax.adam(3e-4)
+        # flat layout (engine/train.py:flat_tx): at these tiny dims the
+        # per-tensor adam stream dominates step time, and these 600+200
+        # on-chip steps run inside the scarce tunnel window
+        tx = flat_tx(optax.adam(3e-4))
         state = create_lm_train_state(model, jax.random.PRNGKey(seed),
                                       seq, tx)
         step = jax.jit(make_lm_train_step(model, tx))

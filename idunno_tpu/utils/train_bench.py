@@ -113,9 +113,9 @@ def run_train_bench(platform: str, device_kind: str, n_devices: int,
     fresh compile through a slow tunnel; the core LM point always runs."""
     import optax
 
-    from idunno_tpu.engine.train import (create_train_state, jit_train_step,
+    from idunno_tpu.engine.train import (create_train_state, flat_tx,
                                          fsdp_shard_train_state,
-                                         shard_train_state)
+                                         jit_train_step, shard_train_state)
     from idunno_tpu.engine.train_lm import (create_lm_train_state,
                                             jit_lm_train_step)
     from idunno_tpu.models.resnet import resnet18
@@ -145,7 +145,9 @@ def run_train_bench(platform: str, device_kind: str, n_devices: int,
                                depth=cfg["depth"], num_heads=cfg["heads"],
                                causal=True,
                                dtype=jnp.bfloat16, param_dtype=jnp.float32)
-    tx = optax.adamw(3e-4)
+    # flat layout: the traced per-tensor adamw stream was ~55% of the
+    # 2026-07-31 device step (TRACE_TRAIN_LM.json); engine/train.py:flat_tx
+    tx = flat_tx(optax.adamw(3e-4))
     try:
         state = create_lm_train_state(init_model, jax.random.PRNGKey(0),
                                       8, tx, batch=1)
@@ -167,6 +169,10 @@ def run_train_bench(platform: str, device_kind: str, n_devices: int,
             "loss": round(loss, 4),
             "attention": ("flash (pallas fwd+bwd, compiled)"
                           if platform == "tpu" else "full (xla)"),
+            # records at/after this field measure the flat-optimizer
+            # layout; its absence marks the per-tensor-adamw era (the
+            # 2026-07-31 30,499 tok/s baseline)
+            "optimizer_layout": "flat (optax.flatten(adamw))",
             # record the block geometry: the FLASH_SWEEP that picked the
             # current default measured the prefill FORWARD only, so a
             # train capture at new blocks must be comparable-by-record
@@ -204,20 +210,29 @@ def run_train_bench(platform: str, device_kind: str, n_devices: int,
             out["accum"] = {"error": f"{type(e).__name__}: {e}"}
 
     # -- FSDP (ZeRO-3) point: only meaningful with >1 device on the data
-    # axis (the single-chip TPU run skips it; CPU-mesh tests cover it) ----
+    # axis (the single-chip TPU run skips it; CPU-mesh tests cover it).
+    # PER-TENSOR optimizer on purpose: ZeRO-3's point is sharded opt
+    # state, and a flat [N] leaf only shards when N divides the axis —
+    # so this point keeps the layout tests/test_fsdp.py covers, pays its
+    # own step compile, and stamps the record (engine/train.py:flat_tx) --
     if n_data > 1 and time.perf_counter() < deadline:
         try:
+            tx_pt = optax.adamw(3e-4)
             # init through the plain-attention twin at tiny seq, same as
             # the main point — re-initing with the flash model at full seq
             # would pay exactly the compile the twin exists to avoid
             fstate = create_lm_train_state(init_model, jax.random.PRNGKey(0),
-                                           8, tx, batch=1)
+                                           8, tx_pt, batch=1)
             fstate = fsdp_shard_train_state(fstate, mesh)
-            perf, cf, _ = _timed_steps(step, fstate, (tokens,), cfg["iters"])
+            fstep = jit_lm_train_step(model, tx_pt, mesh)
+            perf, cf, _ = _timed_steps(fstep, fstate, (tokens,),
+                                       cfg["iters"])
             out["fsdp"] = {
                 "tokens_per_s": round(batch * cfg["seq"] / perf, 1),
                 "vs_plain": round(per_step / perf, 2),
                 "compile_s": round(cf, 2),
+                "optimizer_layout":
+                    "per-tensor (ZeRO-3 shards opt-state leaves)",
             }
         except Exception as e:  # noqa: BLE001
             out["fsdp"] = {"error": f"{type(e).__name__}: {e}"}
@@ -228,7 +243,7 @@ def run_train_bench(platform: str, device_kind: str, n_devices: int,
             cb = -(-cfg["cnn_batch"] // n_data) * n_data
             size = cfg["cnn_image"]
             cnn = resnet18()
-            ctx = optax.sgd(0.1, momentum=0.9)
+            ctx = flat_tx(optax.sgd(0.1, momentum=0.9))
             # global-avg-pool makes param shapes size-independent: init at
             # 64px to keep the init compile cheap through the tunnel
             cstate = create_train_state(cnn, jax.random.PRNGKey(0),
@@ -248,6 +263,7 @@ def run_train_bench(platform: str, device_kind: str, n_devices: int,
                 "batch": cb, "image_size": size,
                 "step_s": round(perc, 4), "compile_s": round(cc, 2),
                 "loss": round(closs, 4),
+                "optimizer_layout": "flat (optax.flatten(sgd+momentum))",
             }
             if peak_bf16 and cnn_flops_per_image:
                 out["cnn"]["mfu"] = round(

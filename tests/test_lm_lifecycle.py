@@ -15,6 +15,7 @@ from idunno_tpu.engine.checkpoint import (
     checkpoint_holders, restore_train_state, restore_variables,
     restore_version, save_train_state, save_variables)
 from idunno_tpu.engine.generate import generate
+from idunno_tpu.engine.train import flat_tx
 from idunno_tpu.engine.train_lm import (
     create_lm_train_state, make_lm_train_step)
 from idunno_tpu.membership.service import MembershipService
@@ -458,12 +459,53 @@ def test_train_job_stop_and_resume(stores):
     assert st["step"] == stopped_at + 3
 
 
+def test_train_job_resumes_per_tensor_era_checkpoint(stores):
+    """A checkpoint written BEFORE the flat-optimizer layout (per-tensor
+    adam opt_state trees) must still resume: the job detects the
+    structure mismatch against its flat template and continues on the
+    checkpoint's original layout instead of erroring (train_job.py's
+    layout-probe fallback)."""
+    import time
+
+    from idunno_tpu.engine.data_lm import save_corpus
+    from idunno_tpu.engine.train_job import LMTrainJob
+
+    rng = np.random.default_rng(5)
+    save_corpus(stores["n0"], "corpus/era",
+                rng.integers(0, 32, size=4000).astype(np.int32))
+    cfg = {"vocab": 32, "dim": 16, "depth": 1, "num_heads": 2}
+
+    # hand-write a per-tensor-era checkpoint under the job's name: the
+    # exact save path train_job used before flat_tx landed
+    model = TransformerLM(**cfg)
+    tx_pt = optax.adam(1e-2)
+    state = create_lm_train_state(model, jax.random.PRNGKey(0), 16, tx_pt)
+    step = jax.jit(make_lm_train_step(model, tx_pt))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, 32)
+    for _ in range(3):
+        state, _ = step(state, toks[:, :16])
+    save_train_state(stores["n0"], "eralm", state)
+
+    resumed = LMTrainJob(stores["n1"], "eralm", corpus="corpus/era",
+                         model_config=cfg, steps=5, batch_size=4,
+                         seq_len=16, checkpoint_every=100, resume=True)
+    resumed.join(timeout=300.0)
+    st = resumed.status()
+    assert st["error"] is None, st
+    assert st["done"], st
+    assert st["start_step"] == 3, st      # continued from the checkpoint
+    assert st["step"] == 5, st
+
+
 def test_training_resume_is_exact(stores):
     """Full TrainState checkpoint/resume: train 5 steps, checkpoint, train
     5 more — a resume from the checkpoint on ANOTHER node must land on
-    bit-identical losses and params (adam moments and step survive)."""
+    bit-identical losses and params (adam moments and step survive).
+    Uses the FLAT optimizer layout `train_job` ships
+    (engine/train.py:flat_tx), so the flat opt_state's store roundtrip is
+    covered by the same exactness bar."""
     model = TransformerLM(vocab=32, dim=32, depth=1, num_heads=4)
-    tx = optax.adam(1e-2)
+    tx = flat_tx(optax.adam(1e-2))
     toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 32)
     step = jax.jit(make_lm_train_step(model, tx))
 
