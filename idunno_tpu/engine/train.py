@@ -49,7 +49,10 @@ def flat_tx(inner: "optax.GradientTransformation"
 
     Exact for elementwise transforms (adam/adamw, sgd+momentum): the
     same per-element math in a different layout — the numerics test
-    asserts bit-identical training trajectories. Trade-off: the flat
+    asserts bit-identical training trajectories. Assumes a UNIFORM param
+    dtype (every tree this repo trains is all-f32 or all-bf16):
+    `ravel_pytree` would silently upcast a mixed tree into one buffer,
+    changing the low-precision leaves' update arithmetic. Trade-off: the flat
     optimizer state is one [N] vector, which `fsdp_param_spec` can only
     shard over the data axis when N divides it — keep per-tensor layout
     for ZeRO-3 runs where opt-state sharding matters more than update
