@@ -29,7 +29,12 @@ PROBE_INTERVAL_S = float(os.environ.get("CAPTURE_PROBE_INTERVAL_S", "180"))
 # (max(1100, budget*1.8)); see bench.py:start_hard_deadline_watchdog
 OUTER_TIMEOUT_S = 1300
 
-# (name, argv-env pairs, artifact whose refresh marks success)
+# (name, env, argv, artifact[, post]) — ``post`` is a list of
+# tools/parse_trace.py argv tails run after the step SUCCEEDS, turning
+# the raw gitignored .trace/ capture into its committed-shape JSON
+# immediately (a window that opens unattended still yields parse-ready
+# artifacts for the round-end commit, and the shared .trace/bs256 dir is
+# parsed before the next model's capture lands in it)
 # Round-5 priority (VERDICT next-1): lm_suite FIRST — the fused
 # speculative rounds, flash-vs-XLA and slot-scaling points have never
 # touched the chip; the headline CNN number exists and only needs a
@@ -37,7 +42,10 @@ OUTER_TIMEOUT_S = 1300
 STEPS = [
     # BENCH_TRACE=1: the suite also writes .trace/lm_decode (one extra
     # steady-state dispatch under the profiler) — the decode
-    # trace→apportion→fix evidence; parse with tools/parse_trace.py
+    # trace→apportion→fix evidence. No auto-post: its --steps (timed
+    # dispatches × decode_steps) is run-dependent, so the TRACE_LM_DECODE
+    # .json refresh stays a manual tools/parse_trace.py call against the
+    # record's own config
     # budget 700 (not 600): the round-5 suite adds the decode trace and
     # the trained-draft speculative phase; watchdog = 1.8x700 = 1260 s
     # stays inside the 1300 s outer kill
@@ -92,7 +100,11 @@ STEPS = [
      {"BENCH_SUITE": "train", "BENCH_TIME_BUDGET_S": "600",
       "BENCH_TRACE": "1"},
      [sys.executable, "bench.py"],
-     "BENCH_LAST_GOOD_train.json"),
+     "BENCH_LAST_GOOD_train.json",
+     # --steps 1: one traced train step — reproduces the committed
+     # TRACE_TRAIN_LM.json shape exactly
+     [[".trace/train_lm", "TRACE_TRAIN_LM.json", "--steps", "1"],
+      [".trace/train_cnn", "TRACE_TRAIN_CNN.json", "--steps", "1"]]),
     # why is the fused-speculative ceiling 0.41x? — three traced
     # dispatches (plain, spec all-greedy at the fast path, the SAME spec
     # program with sampled rows live), count-split into draft-loop vs
@@ -110,7 +122,15 @@ STEPS = [
      {"BENCH_TRACE": "1", "BENCH_SWEEP": "256", "BENCH_ITERS": "2",
       "BENCH_LM": "0", "BENCH_TIME_BUDGET_S": "400", "BENCH_NO_CACHE": "1"},
      [sys.executable, "bench.py"],
-     ".trace"),
+     # success = the PARSED artifact (run_step posts run first): a trace
+     # whose parse failed is lost at session end, so it must retry.
+     # _AUTO, not TRACE_BS256.json: the tracked artifact carries hand
+     # enrichment (device_side_images_per_s, data-movement note,
+     # provenance) a bare parse would clobber; promotion stays a
+     # deliberate act. --steps 32 = the timed dispatch's scan length at
+     # BENCH_SWEEP=256 (n_images 8192 / batch 256, the round-4 geometry)
+     "TRACE_BS256_AUTO.json",
+     [[".trace/bs256", "TRACE_BS256_AUTO.json", "--steps", "32"]]),
     # last (scarce-window priority): the trace that apportions AlexNet's
     # measured 30.8% MFU against its ~91% shape ceiling (RESULTS.md)
     ("traced_alexnet",
@@ -118,7 +138,8 @@ STEPS = [
       "BENCH_ITERS": "2", "BENCH_LM": "0", "BENCH_TIME_BUDGET_S": "400",
       "BENCH_NO_CACHE": "1"},
      [sys.executable, "bench.py"],
-     ".trace"),
+     "TRACE_ALEXNET_BS256.json",
+     [[".trace/bs256", "TRACE_ALEXNET_BS256.json", "--steps", "32"]]),
 ]
 
 
@@ -167,7 +188,7 @@ def artifact_mtime(path: str) -> float:
         return 0.0
 
 
-def run_step(name, env_extra, argv, artifact) -> bool:
+def run_step(name, env_extra, argv, artifact, post=()) -> bool:
     t0 = time.time()
     log(f"step {name}: starting (outer timeout {OUTER_TIMEOUT_S}s)")
     env = dict(os.environ, **env_extra)
@@ -179,6 +200,27 @@ def run_step(name, env_extra, argv, artifact) -> bool:
         log(f"step {name}: rc={r.returncode} out={tail}")
     except subprocess.TimeoutExpired:
         log(f"step {name}: outer timeout hit")
+    # posts run BEFORE the success check (for the traced_* steps the
+    # success artifact IS the parse output, so a failed parse keeps the
+    # step pending and the scarce-window capture gets retried instead of
+    # silently lost) and even on a deadline-hit attempt (a partial run's
+    # trace is still evidence at the current tree) — but each post only
+    # fires when ITS source dir refreshed during this attempt, so a step
+    # that died before tracing can never parse a predecessor's capture
+    # into the wrong artifact (.trace/bs256 is shared across models)
+    for tail_args in post:
+        if artifact_mtime(tail_args[0]) <= t0:
+            continue
+        try:
+            pr = subprocess.run(
+                [sys.executable, "tools/parse_trace.py", *tail_args],
+                cwd=ROOT, capture_output=True, text=True, timeout=300)
+            log(f"step {name}: post parse {tail_args[0]} -> "
+                f"{tail_args[1]} rc={pr.returncode}"
+                + ("" if pr.returncode == 0
+                   else f" err={pr.stderr.strip()[-200:]}"))
+        except Exception as e:  # noqa: BLE001 - post is best-effort
+            log(f"step {name}: post parse failed: {e}")
     ok = artifact_mtime(artifact) > t0
     log(f"step {name}: {'SUCCESS' if ok else 'no artifact refresh'}")
     return ok
@@ -196,10 +238,11 @@ def main() -> None:
             # fewest-attempts first so one stubborn step can't starve the
             # rest of the queue within a window; original order tiebreaks
             pending.sort(key=lambda s: st["attempts"].get(s[0], 0))
-            name, env_extra, argv, artifact = pending[0]
+            step = pending[0]
+            name = step[0]
             st["attempts"][name] = st["attempts"].get(name, 0) + 1
             save_state(st)
-            if run_step(name, env_extra, argv, artifact):
+            if run_step(*step):
                 st["done"][name] = time.time()
                 save_state(st)
             # window may still be open — re-probe immediately either way
