@@ -93,6 +93,15 @@ STEPS = [
      {"BENCH_SUITE": "lm", "BENCH_TIME_BUDGET_S": "700"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_lm.json"),
+    # why is the fused-speculative ceiling 0.41x? — three traced
+    # dispatches (plain, spec all-greedy at the fast path, the SAME spec
+    # program with sampled rows live), count-split into draft-loop vs
+    # verify/commit device time per branch (tools/spec_trace.py
+    # docstring)
+    ("spec_trace",
+     {},
+     [sys.executable, "tools/spec_trace.py"],
+     "SPEC_TRACE.json"),
     # BENCH_TRACE=1 also writes .trace/train_lm + .trace/train_cnn (one
     # extra traced step each) — the apportionment behind the train-MFU
     # why-note (round-4 VERDICT weak #6)
@@ -105,15 +114,6 @@ STEPS = [
      # TRACE_TRAIN_LM.json shape exactly
      [[".trace/train_lm", "TRACE_TRAIN_LM.json", "--steps", "1"],
       [".trace/train_cnn", "TRACE_TRAIN_CNN.json", "--steps", "1"]]),
-    # why is the fused-speculative ceiling 0.41x? — three traced
-    # dispatches (plain, spec all-greedy at the fast path, the SAME spec
-    # program with sampled rows live), count-split into draft-loop vs
-    # verify/commit device time per branch (tools/spec_trace.py
-    # docstring)
-    ("spec_trace",
-     {},
-     [sys.executable, "tools/spec_trace.py"],
-     "SPEC_TRACE.json"),
     # BENCH_NO_CACHE: this degraded single-point run must not clobber the
     # headline BENCH_LAST_GOOD.json captured by headline_resnet18 above.
     # bs256 (the headline's best point), not 1024: tracing overhead on top
