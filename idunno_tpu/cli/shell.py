@@ -402,15 +402,18 @@ class Shell:
             return ("usage: lm-serve <name> <prompt_len> <max_len> "
                     "[slots= decode_steps= quantize=int8 "
                     "kv_cache_dtype=int8 eos_id=N logprobs=1 penalties=1 "
-                    "prefix=7,2,19 "
+                    "prefix=7,2,19 kv_block_size=N kv_cache_blocks=N "
                     "draft=<lm> draft_len=N place=1 reload=1]\n"
                     "note: draft (speculative) pools serve greedy "
                     "requests token-exact and sampled requests "
-                    "distribution-exact (speculative sampling)")
+                    "distribution-exact (speculative sampling); "
+                    "kv_block_size>0 enables the paged cross-request "
+                    "prefix cache (token-exact, block-aligned hits)")
         kv = self._kv(args[3:])
         payload = {k: int(kv.pop(k))
                    for k in ("slots", "decode_steps", "eos_id",
-                             "draft_len") if k in kv}
+                             "draft_len", "kv_block_size",
+                             "kv_cache_blocks") if k in kv}
         if "quantize" in kv:
             payload["quantize"] = kv.pop("quantize")
         if "kv_cache_dtype" in kv:
@@ -519,6 +522,17 @@ class Shell:
                     + (f" draft_len={cfg['speculative_draft_len']}"
                        if cfg["speculative_draft_len"] else ""))
 
+        def prefix_line(stats: dict) -> str:
+            pc = stats.get("prefix_cache")
+            if not pc:
+                return ""
+            return (f"\n  prefix_cache: hit_rate="
+                    f"{pc['prefix_hit_rate']:.2f} "
+                    f"saved={pc['cached_tokens_saved']}tok "
+                    f"blocks={pc['kv_blocks_used']}/"
+                    f"{pc['kv_blocks_used'] + pc['kv_blocks_free']} "
+                    f"evictions={pc['evictions']}")
+
         if "journal" in s:              # cluster-managed pool
             j = s["journal"]
             head = (f"{args[0]}: node={s['node']} "
@@ -532,13 +546,14 @@ class Shell:
             return (head + f" | live={p['live']}/{p['slots']} "
                     f"completed={p['completed']} "
                     f"tokens_generated={p['tokens_generated']}"
-                    + config_line(p))
+                    + config_line(p) + prefix_line(p))
         return (f"{args[0]}: live={s['live']}/{s['slots']} "
                 f"queued={s['queued']} inbox={s['inbox']} "
                 f"unpolled={s['unpolled']} admitted={s['admitted']} "
                 f"completed={s['completed']} "
                 f"tokens_generated={s['tokens_generated']} "
-                f"dispatches={s['dispatches']}" + config_line(s))
+                f"dispatches={s['dispatches']}" + config_line(s)
+                + prefix_line(s))
 
     def cmd_lm_stop(self, args: list[str]) -> str:
         if len(args) != 1:

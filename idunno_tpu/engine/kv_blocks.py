@@ -1,0 +1,206 @@
+"""Paged KV-cache block pool (vLLM PagedAttention-style, PAPERS.md).
+
+The decode-cache leaves (`cached_k`/`cached_v`, plus `k_scale`/`v_scale`
+on int8 caches — `models/transformer.py`) are contiguous per sequence:
+token position t lives at index t of the cache's token axis. That layout
+is what the serving tier's static-shape programs want, but it makes KV
+reuse all-or-nothing — the single pool-level `prefix=` cache in
+`engine/serve_lm.py` is paid once at pool build and shared by every
+request, and nothing else is ever reused.
+
+This module adds the missing granularity: a pool of fixed-size TOKEN
+BLOCKS over the same leaves. Each block holds `block_size` consecutive
+token positions of every K/V leaf; a prompt's KV is then a CHAIN of
+blocks that other requests with the same token prefix (at the same
+absolute positions) can splice into their own prefill via the existing
+`_prefill_suffix` path. Ownership/eviction policy lives one level up in
+`serve/prefix_cache.py` (the radix tree); this pool only does storage:
+
+  alloc/free     — free-list, O(1), no compaction (blocks are uniform)
+  incref/decref  — per-block reference counts: a block is pinned while
+                   any admitted request's chain holds it, so the tree
+                   can only evict refcount-0 chains
+  write_block    — copy one block's worth of a prefill row cache's K/V
+                   into a block (one compiled scatter per leaf shape;
+                   the block id and token offset are traced, so block
+                   churn never recompiles)
+  gather         — assemble a chain back into a batch-1, length-n·bs
+                   cache tree whose leaf paths match `init_cache`'s, so
+                   `_prefill_suffix` can splice it verbatim
+
+Correctness note: the transformer is causal, so a token's K/V depends
+only on the tokens at and before its position — KV written by ONE
+request is bit-identical to what any other request with the same token
+prefix (and the same pool-level static prefix ahead of it) would
+compute at those positions. That is the whole reason cross-request
+sharing can keep greedy decode token-exact (`tests/test_prefix_cache.py`
+pins this against `engine/generate.py`).
+
+The block stores are allocated unsharded (replicated under a mesh):
+blocks are batch-1 slivers the admission path gathers/scatters on the
+host-facing side of the pool; the big [slots, max_len] decode cache in
+`DecodeServer` keeps its mesh sharding unchanged.
+
+The reference has no KV reuse at any granularity — every query
+recomputes from scratch (`mp4_machinelearning.py:541-616`).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from idunno_tpu.engine.generate import init_cache
+
+# cache leaves that carry per-token K/V state (int8 caches add scales);
+# must stay in lockstep with `serve_lm._prefill_suffix`'s splice filter
+KV_LEAF_KEYS = ("cached_k", "cached_v", "k_scale", "v_scale")
+
+
+def _is_kv(path) -> bool:
+    return bool(path) and getattr(path[-1], "key", None) in KV_LEAF_KEYS
+
+
+@jax.jit
+def _write_block(store: jnp.ndarray, row_leaf: jnp.ndarray,
+                 bid: jnp.ndarray, off: jnp.ndarray) -> jnp.ndarray:
+    """store[bid] = row_leaf[0, off:off+block_size]. bid/off are traced:
+    one compile per (store shape, row length), not per block or offset."""
+    bs = store.shape[1]
+    chunk = jax.lax.dynamic_slice_in_dim(row_leaf[0], off, bs, axis=0)
+    return store.at[bid].set(chunk.astype(store.dtype))
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _gather_blocks(store: jnp.ndarray, bids: jnp.ndarray,
+                   n: int) -> jnp.ndarray:
+    """[n blocks] → one [1, n·block_size, ...] contiguous leaf."""
+    return store[bids].reshape((1, n * store.shape[1]) + store.shape[2:])
+
+
+def concat_kv_prefix(front: Any, back: Any) -> Any:
+    """Concatenate two batch-1 cache trees along the token axis at the
+    K/V leaves (static pool prefix + gathered radix chain → one combined
+    prefix for `_prefill_suffix`). Non-K/V leaves (cursors) are taken
+    from ``front`` — the consumer overwrites them anyway. Leaves match
+    by keystr path, not container identity, so a flax-mutated cache and
+    an `init_cache` template compose regardless of dict flavor."""
+    src = {jax.tree_util.keystr(p): leaf for p, leaf
+           in jax.tree_util.tree_flatten_with_path(back)[0] if _is_kv(p)}
+
+    def f(path, x):
+        if _is_kv(path):
+            return jnp.concatenate(
+                [x, src[jax.tree_util.keystr(path)]], axis=1)
+        return x
+    return jax.tree_util.tree_map_with_path(f, front)
+
+
+class KVBlockPool:
+    """Fixed-size token-block storage over a model's decode-cache K/V
+    leaves, with free-list allocation and per-block refcounts. Policy-
+    free: see `serve/prefix_cache.py` for the radix tree that decides
+    what the blocks mean and when they are evicted."""
+
+    def __init__(self, model, num_blocks: int, block_size: int) -> None:
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks {num_blocks} must be >= 1")
+        if block_size < 1:
+            raise ValueError(f"block_size {block_size} must be >= 1")
+        self.model = model
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # batch-1 length-block_size template names the K/V leaves and
+        # their per-token shapes; the stores add a leading block axis
+        shapes = jax.eval_shape(lambda: init_cache(model, 1, block_size))
+        self._stores: dict[str, jnp.ndarray] = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(shapes)[0]:
+            if _is_kv(path):
+                self._stores[jax.tree_util.keystr(path)] = jnp.zeros(
+                    (num_blocks, block_size) + leaf.shape[2:], leaf.dtype)
+        if not self._stores:
+            raise ValueError("model's decode cache has no K/V leaves")
+        self._free: list[int] = list(range(num_blocks - 1, -1, -1))
+        self._refs: dict[int, int] = {}       # allocated block → refcount
+        # eval_shape templates for gather output trees, keyed by length
+        self._tree_templates: dict[int, Any] = {}
+
+    # -- allocation -------------------------------------------------------
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def alloc(self) -> int | None:
+        """One free block (refcount 0) or None when the pool is full —
+        the caller decides whether to evict or skip."""
+        if not self._free:
+            return None
+        bid = self._free.pop()
+        self._refs[bid] = 0
+        return bid
+
+    def free(self, bid: int) -> None:
+        refs = self._refs.get(bid)
+        if refs is None:
+            raise ValueError(f"block {bid} is not allocated")
+        if refs:
+            # refused free must leave the block tracked (still allocated)
+            raise ValueError(f"block {bid} freed with refcount {refs}")
+        del self._refs[bid]
+        self._free.append(bid)
+
+    def incref(self, bid: int) -> None:
+        self._refs[bid] += 1
+
+    def decref(self, bid: int) -> None:
+        if self._refs[bid] < 1:
+            raise ValueError(f"block {bid} decref below zero")
+        self._refs[bid] -= 1
+
+    def refcount(self, bid: int) -> int:
+        return self._refs[bid]
+
+    # -- data movement ----------------------------------------------------
+
+    def write_block(self, bid: int, row_cache: Any, offset: int) -> None:
+        """Copy token positions [offset, offset+block_size) of a batch-1
+        prefill cache's K/V leaves into block ``bid``. The offset is an
+        ABSOLUTE cache position — with a pool-level static prefix ahead
+        of the request tokens, the caller passes prefix_len + i."""
+        src = {jax.tree_util.keystr(p): leaf for p, leaf
+               in jax.tree_util.tree_flatten_with_path(row_cache)[0]
+               if _is_kv(p)}
+        b = jnp.int32(bid)
+        off = jnp.int32(offset)
+        for key, store in self._stores.items():
+            self._stores[key] = _write_block(store, src[key], b, off)
+
+    def gather(self, blocks: list[int]) -> Any:
+        """Chain → a batch-1, length-``len(blocks)·block_size`` cache
+        tree (leaf paths identical to `init_cache`'s, non-K/V leaves
+        zeroed) ready for `_prefill_suffix`'s prefix splice."""
+        n = len(blocks)
+        if n < 1:
+            raise ValueError("empty block chain")
+        total = n * self.block_size
+        template = self._tree_templates.get(total)
+        if template is None:
+            template = jax.eval_shape(
+                lambda: init_cache(self.model, 1, total))
+            self._tree_templates[total] = template
+        bids = jnp.asarray(blocks, jnp.int32)
+        parts = {key: _gather_blocks(store, bids, n)
+                 for key, store in self._stores.items()}
+
+        def fill(path, leaf):
+            if _is_kv(path):
+                return parts[jax.tree_util.keystr(path)]
+            return jnp.zeros(leaf.shape, leaf.dtype)
+        return jax.tree_util.tree_map_with_path(fill, template)

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from idunno_tpu.engine.generate import decode_model, init_cache
+from idunno_tpu.engine.kv_blocks import concat_kv_prefix
 from idunno_tpu.models.transformer import TransformerLM
 from idunno_tpu.ops.quantize import dequantize_tree, quantize_tree
 from idunno_tpu.ops.sampling import filtered_probs
@@ -138,11 +139,16 @@ def _prefill_suffix(model: TransformerLM, params: Any, prefix_cache: Any,
     """[1, P] suffix after a length-``prefix_len`` CACHED prefix →
     (length-(prefix_len+P) cache rows, first generated token's logits).
 
-    The pool-level prefix cache (paid once at pool build) is spliced
-    into the head of a fresh cache and the chunk applies from cursor
-    ``prefix_len`` — positions/RoPE and the causal mask then match a
-    from-scratch prefill of prefix+suffix exactly (the scalar-cursor
-    t>1 branch, `models/transformer.py` chunked prefill)."""
+    The cached prefix is spliced into the head of a fresh cache and the
+    chunk applies from cursor ``prefix_len`` — positions/RoPE and the
+    causal mask then match a from-scratch prefill of prefix+suffix
+    exactly (the scalar-cursor t>1 branch, `models/transformer.py`
+    chunked prefill). Two callers: the pool-level static ``prefix=``
+    cache (paid once at pool build) and, generalized per request, the
+    radix prefix cache (`serve/prefix_cache.py`) whose block-chain
+    gathers arrive here as ``prefix_cache`` with ``prefix_len`` =
+    static prefix + block-aligned hit. Hits are block multiples, so the
+    static ``prefix_len`` values stay a bounded compile set."""
     total = prefix_len + prompt_len
     dec = decode_model(model, total)
     cache = init_cache(model, 1, total)
@@ -384,7 +390,9 @@ class DecodeServer:
                  prompt_buckets: tuple[int, ...] | None = None,
                  track_logprobs: bool = False,
                  penalties: bool = False,
-                 prefix: list[int] | None = None) -> None:
+                 prefix: list[int] | None = None,
+                 kv_block_size: int = 0,
+                 kv_cache_blocks: int = 0) -> None:
         if not model.causal:
             raise ValueError("continuous batching needs a causal LM")
         if prompt_len > max_len:
@@ -405,6 +413,19 @@ class DecodeServer:
             self.prompt_buckets = (prompt_len,)
         if decode_steps < 1:
             raise ValueError(f"decode_steps {decode_steps} must be >= 1")
+        # cross-request radix prefix cache (engine/kv_blocks.py +
+        # serve/prefix_cache.py): kv_block_size > 0 enables it; hits are
+        # block-aligned so the `_prefill_suffix` static prefix lengths
+        # stay a bounded set (block multiples) instead of one compile
+        # per distinct hit length
+        self.kv_block_size = int(kv_block_size)
+        if self.kv_block_size < 0:
+            raise ValueError(
+                f"kv_block_size {kv_block_size} must be >= 0 (0 = off)")
+        if kv_cache_blocks and not self.kv_block_size:
+            raise ValueError("kv_cache_blocks needs kv_block_size > 0")
+        self._block_pool = self._radix = None
+        self._held: dict[int, list] = {}   # live request id → pinned chain
         # cheap argument validation BEFORE any device allocation or
         # weight quantization: a bad prefix must fail in microseconds
         self.prefix = list(prefix) if prefix else None
@@ -569,7 +590,13 @@ class DecodeServer:
         self._next_id = 0
         self._cancelled: set[int] = set()     # ids cancelled while live
         self._stats = {"dispatches": 0, "admitted": 0, "completed": 0,
-                       "tokens_generated": 0, "cancelled": 0}
+                       "tokens_generated": 0, "cancelled": 0,
+                       # padded suffix tokens actually computed by
+                       # admission prefills — the work the prefix cache
+                       # exists to shrink (bench comparison counter)
+                       "prefill_tokens": 0}
+        # prefix-cache counters (zero-cost when the cache is off)
+        self._pc_lookups = self._pc_hits = self._pc_tokens_saved = 0
 
         if self._draft_model is not None:
             self._decode_spec = self._build_spec_round(draft_len,
@@ -590,6 +617,19 @@ class DecodeServer:
                 self._draft_prefix_cache, _ = _prefill(
                     self._draft_model, self._draft_params, pf,
                     jnp.int32(pl), pl)
+
+        # paged KV block pool + radix tree over PER-REQUEST prompt
+        # prefixes (the static prefix above is shared by construction
+        # and sits in front of every chain). Deferred imports: the serve
+        # package pulls this module back in via lm_pool.
+        if self.kv_block_size:
+            from idunno_tpu.engine.kv_blocks import KVBlockPool
+            from idunno_tpu.serve.prefix_cache import RadixPrefixCache
+            nblocks = int(kv_cache_blocks) or slots * (
+                (prompt_len + self.kv_block_size - 1) // self.kv_block_size)
+            self._block_pool = KVBlockPool(model, nblocks,
+                                           self.kv_block_size)
+            self._radix = RadixPrefixCache(self._block_pool)
 
     @staticmethod
     def _per_row_decode(model: TransformerLM,
@@ -1089,10 +1129,34 @@ class DecodeServer:
             "speculative_draft_len": (self.draft_len
                                       if self._draft_model is not None
                                       else None),
+            "kv_block_size": self.kv_block_size,
+            "kv_cache_blocks": (self._block_pool.num_blocks
+                                if self._block_pool is not None else 0),
         }
-        return dict(self._stats, live=len(self._live),
-                    queued=len(self._queue), slots=self.slots,
-                    config=config)
+        out = dict(self._stats, live=len(self._live),
+                   queued=len(self._queue), slots=self.slots,
+                   config=config)
+        if self._radix is not None:
+            out["prefix_cache"] = self.prefix_cache_stats()
+        return out
+
+    def prefix_cache_stats(self) -> dict:
+        """Radix prefix-cache gauges (only meaningful on kv_block_size
+        pools): hit rate over admissions, prompt tokens whose prefill
+        was skipped, block-pool occupancy, tree churn counters."""
+        return {
+            "prefix_hit_rate": (self._pc_hits / self._pc_lookups
+                                if self._pc_lookups else 0.0),
+            "lookups": self._pc_lookups,
+            "hits": self._pc_hits,
+            "cached_tokens_saved": self._pc_tokens_saved,
+            "kv_blocks_free": self._block_pool.num_free,
+            "kv_blocks_used": self._block_pool.num_used,
+            "evictions": self._radix.evictions,
+            "insert_skips": self._radix.insert_skips,
+            "inserted_blocks": self._radix.inserted_blocks,
+            "nodes": self._radix.num_nodes(),
+        }
 
     # -- serving loop -----------------------------------------------------
 
@@ -1131,6 +1195,10 @@ class DecodeServer:
             if not was_cancelled:
                 self._stats["completed"] += 1
             self._stats["tokens_generated"] += total - len(req.tokens)
+            if self._radix is not None:       # unpin the request's chain
+                chain = self._held.pop(req.id, None)
+                if chain:
+                    self._radix.release(chain)
 
     def _admit(self) -> None:
         free = [s for s in range(self.slots) if s not in self._live]
@@ -1138,30 +1206,84 @@ class DecodeServer:
             slot = free.pop(0)
             req = self._queue.popleft()
             req.t_admit = time.monotonic()
-            suffix_true = len(req.tokens)
-            suffix_bucket = next(b for b in self.prompt_buckets
-                                 if b >= suffix_true)
+            per_req = list(req.tokens)      # pre-prefix request tokens
+            suffix_true = len(per_req)
+            pl = len(self.prefix) if self.prefix else 0
+            # radix prefix cache: longest block-aligned cached chain for
+            # this prompt. The hit is capped one block short of the full
+            # prompt so the suffix apply always has ≥ 1 real token (the
+            # first-token logits come from it), and shrunk block-by-
+            # block until prefix+hit+bucket fits max_len (hit 0 always
+            # fits — the plain path's own guarantee).
+            hit, hit_chain = 0, []
+            if self._radix is not None:
+                self._pc_lookups += 1
+                hit_chain = self._radix.lookup(per_req)
+                bs = self.kv_block_size
+                hit = min(len(hit_chain) * bs,
+                          ((suffix_true - 1) // bs) * bs)
+            while True:
+                rest = suffix_true - hit
+                suffix_bucket = next(
+                    (b for b in self.prompt_buckets
+                     if b >= rest and pl + hit + b <= self.max_len), None)
+                if suffix_bucket is not None:
+                    break
+                if hit <= 0:   # unreachable: validate()/__init__ checks
+                    raise RuntimeError(
+                        f"no prompt bucket fits {suffix_true} tokens")
+                hit -= self.kv_block_size
+            if hit:
+                hit_chain = hit_chain[:hit // self.kv_block_size]
+                # pin before gather: eviction (from a concurrent-looking
+                # insert later this admission) must not free these
+                self._radix.acquire(hit_chain)
+                self._pc_hits += 1
+                self._pc_tokens_saved += hit
+            elif hit_chain:
+                hit_chain = []
             suffix = np.zeros((1, suffix_bucket), np.int32)
-            suffix[0, :suffix_true] = req.tokens
-            if self.prefix:
-                pl = len(self.prefix)
+            suffix[0, :suffix_true - hit] = per_req[hit:]
+            self._stats["prefill_tokens"] += suffix_bucket
+            if hit:
+                gathered = self._block_pool.gather(
+                    [nd.block for nd in hit_chain])
+                pre = (concat_kv_prefix(self._prefix_cache, gathered)
+                       if self.prefix else gathered)
+                row_cache, last_logits = _prefill_suffix(
+                    self._prefill_model, self.params, pre,
+                    jnp.asarray(suffix), jnp.int32(suffix_true - hit),
+                    pl + hit, suffix_bucket)
+            elif self.prefix:
                 row_cache, last_logits = _prefill_suffix(
                     self._prefill_model, self.params, self._prefix_cache,
                     jnp.asarray(suffix), jnp.int32(suffix_true), pl,
                     suffix_bucket)
-                # downstream state (tokens row, cursors, prompt_len,
-                # stop/logprob regions) sees the FULL prompt
-                full = np.zeros((1, pl + suffix_bucket), np.int32)
-                full[0, :pl] = self.prefix
-                full[0, pl:pl + suffix_true] = req.tokens
-                req = dataclasses.replace(
-                    req, tokens=self.prefix + req.tokens)
-                prompt, true_len = full, pl + suffix_true
-                bucket = pl + suffix_bucket
             else:
                 row_cache, last_logits = _prefill(
                     self._prefill_model, self.params, jnp.asarray(suffix),
                     jnp.int32(suffix_true), suffix_bucket)
+            if self._radix is not None:
+                # seed/extend the tree from this prefill's row cache and
+                # pin the request's full chain for its lifetime (insert
+                # returns it acquired); the temporary hit pins drop
+                chain = self._radix.insert(per_req, row_cache, pl)
+                if hit_chain:
+                    self._radix.release(hit_chain)
+                if chain:
+                    self._held[req.id] = chain
+            if hit or self.prefix:
+                # downstream state (tokens row, cursors, prompt_len,
+                # stop/logprob regions) sees the FULL prompt
+                full = np.zeros((1, pl + hit + suffix_bucket), np.int32)
+                if self.prefix:
+                    full[0, :pl] = self.prefix
+                    req = dataclasses.replace(
+                        req, tokens=self.prefix + per_req)
+                full[0, pl:pl + suffix_true] = per_req
+                prompt, true_len = full, pl + suffix_true
+                bucket = pl + hit + suffix_bucket
+            else:
                 prompt, true_len, bucket = suffix, suffix_true, suffix_bucket
             temp = jnp.float32(req.temperature)
             topp = jnp.float32(req.top_p)
@@ -1173,19 +1295,25 @@ class DecodeServer:
                 self._tokens, self._cache, row_cache, jnp.asarray(prompt),
                 first, jnp.int32(true_len), jnp.int32(slot), bucket)
             if self._draft_model is not None:
-                # the draft needs the prompt through ITS OWN weights
-                # (suffix-only when the pool caches a shared prefix)
+                # the draft needs the FULL request prompt through ITS
+                # OWN weights (a radix hit only covers the target's
+                # cache; suffix-only applies just past the pool's shared
+                # static prefix)
+                dbucket = next(b for b in self.prompt_buckets
+                               if b >= suffix_true)
+                dsuffix = np.zeros((1, dbucket), np.int32)
+                dsuffix[0, :suffix_true] = per_req
                 if self.prefix:
                     drow, _ = _prefill_suffix(
                         self._draft_model, self._draft_params,
-                        self._draft_prefix_cache, jnp.asarray(suffix),
+                        self._draft_prefix_cache, jnp.asarray(dsuffix),
                         jnp.int32(suffix_true), len(self.prefix),
-                        suffix_bucket)
+                        dbucket)
                 else:
                     drow, _ = _prefill(
                         self._draft_model, self._draft_params,
-                        jnp.asarray(suffix), jnp.int32(suffix_true),
-                        suffix_bucket)
+                        jnp.asarray(dsuffix), jnp.int32(suffix_true),
+                        dbucket)
                 self._draft_cache = _insert_cache(self._draft_cache, drow,
                                                   jnp.int32(slot))
             self._cursors = self._cursors.at[slot].set(true_len)

@@ -23,6 +23,13 @@ import jax.numpy as jnp
 import numpy as np
 
 
+class OptStateLayoutMismatch(ValueError):
+    """Restored opt_state's tree structure does not match the template's
+    (checkpoint from the other optimizer-layout era). The resume path
+    catches exactly this to fall back to the checkpoint's own layout —
+    any other restore failure propagates untouched."""
+
+
 class LMTrainJob:
     """Background training of a dense `TransformerLM` on one node."""
 
@@ -114,19 +121,26 @@ class LMTrainJob:
                 if (jax.tree_util.tree_structure(restored.opt_state)
                         != jax.tree_util.tree_structure(
                             template.opt_state)):
-                    raise ValueError("opt_state layout mismatch")
+                    raise OptStateLayoutMismatch(
+                        "opt_state layout mismatch")
                 return restored
             try:
                 state = restore_checked(state)
-            except Exception:  # noqa: BLE001 - layout probe, see below
+            except OptStateLayoutMismatch as first_exc:
                 # checkpoint from the per-tensor era (pre-flat_tx): keep
                 # THIS job on its original layout — a bit-identical
-                # continuation beats a moment-migration — and let any
-                # genuine restore failure re-raise from this attempt.
+                # continuation beats a moment-migration. Only the layout
+                # probe lands here; a genuine restore failure (missing
+                # object, corrupt bytes) propagates from the first
+                # attempt. If the retry fails too, chain the probe so
+                # the RPC error names both layouts' failures.
                 tx = optax.adam(self.lr)
-                state = restore_checked(create_lm_train_state(
-                    model, jax.random.PRNGKey(self.seed), self.seq_len,
-                    tx))
+                try:
+                    state = restore_checked(create_lm_train_state(
+                        model, jax.random.PRNGKey(self.seed), self.seq_len,
+                        tx))
+                except Exception as e:
+                    raise e from first_exc
         start = int(state.step)
         self._set(step=start, start_step=start)
         step_fn = jax.jit(make_lm_train_step(model, tx))

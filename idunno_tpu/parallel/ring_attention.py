@@ -27,10 +27,11 @@ from idunno_tpu.parallel._compat import pvary, shard_map
 
 
 def _ring_attention_shard(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                          *, axis_name: str, causal: bool,
+                          *, axis_name: str, p: int, causal: bool,
                           scale: float) -> jnp.ndarray:
-    """Per-shard body. q/k/v: [B, T_local, H, D]."""
-    p = jax.lax.axis_size(axis_name)
+    """Per-shard body. q/k/v: [B, T_local, H, D]. ``p`` is the concrete
+    ring size (= mesh.shape[axis_name]; jax.lax.axis_size is not available
+    on every supported jax)."""
     my = jax.lax.axis_index(axis_name)
     b, t_q, h, d = q.shape
     t_k = k.shape[1]
@@ -86,7 +87,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     scale = 1.0 / (q.shape[-1] ** 0.5)
     spec = P(None, seq_axis, None, None)
     fn = functools.partial(_ring_attention_shard, axis_name=seq_axis,
-                           causal=causal, scale=scale)
+                           p=mesh.shape[seq_axis], causal=causal, scale=scale)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec)(q, k, v)
 

@@ -276,7 +276,13 @@ class ControlService:
                     draft_len=int(p.get("draft_len", 4)),
                     prompt_buckets=(tuple(int(b) for b
                                           in p["prompt_buckets"])
-                                    if p.get("prompt_buckets") else None))
+                                    if p.get("prompt_buckets") else None),
+                    # paged KV blocks + cross-request radix prefix cache
+                    # (0 = off); the keys ride the journaled spec, so a
+                    # manager recovery rebuild gets the same pool with an
+                    # EMPTY tree — cold misses, never stale KV
+                    kv_block_size=int(p.get("kv_block_size", 0)),
+                    kv_cache_blocks=int(p.get("kv_cache_blocks", 0)))
                 loop = LMServingLoop(server, name=f"{node.host}-{name}")
             except BaseException:
                 with self._reg_lock:
@@ -326,7 +332,13 @@ class ControlService:
             # draining completions (lm_poll keeps that role)
             return {"partial": self._lm_loop(p["name"]).snapshot()}
         if verb == "lm_stats":
-            return {"stats": self._lm_loop(p["name"]).stats()}
+            stats = self._lm_loop(p["name"]).stats()
+            pc = stats.get("prefix_cache")
+            if pc is not None:
+                # surface the prefix-cache gauges on the node's C8
+                # metrics tracker so the cluster metrics plane sees them
+                node.metrics.record_lm_gauges(p["name"], pc)
+            return {"stats": stats}
         if verb == "lm_stop":
             with self._reg_lock:
                 loop = self._lm_loops.pop(p["name"], None)

@@ -962,6 +962,13 @@ class LMPoolManager:
         """Re-establish an orphaned pool on a survivor and resubmit every
         unfinished request (token-exact: seeds were pinned at admission).
 
+        Prefix-cache pools recover the same way: kv_block_size /
+        kv_cache_blocks ride the journaled spec, so the rebuilt pool has
+        the same paged-cache config but an EMPTY radix tree — resubmitted
+        requests cold-miss and recompute their own KV (never replaying
+        another node's blocks), keeping the token-exactness contract
+        (`tests/test_prefix_cache.py` rebuild test).
+
         Serialized per pool: the membership-change thread, the adoption
         thread and the pump can all reach here concurrently, and a second
         ``lm_serve reload=True`` landing on the same node would replace

@@ -56,6 +56,10 @@ class MetricsTracker:
         self._proc: dict[str, list[tuple[float, float]]] = {}
         # (finish_time, n_images) per model for the rate window (`:649-652`)
         self._images: dict[str, list[tuple[float, int]]] = {}
+        # last-seen LM serving gauges per pool (prefix_hit_rate,
+        # cached_tokens_saved, kv_blocks_free/used — serve/prefix_cache.py);
+        # point-in-time values, not windowed series
+        self._lm_gauges: dict[str, dict] = {}
 
     # -- recording --------------------------------------------------------
 
@@ -73,6 +77,13 @@ class MetricsTracker:
         with self._lock:
             self._finished_queries[model] = (
                 self._finished_queries.get(model, 0) + 1)
+
+    def record_lm_gauges(self, pool: str, gauges: dict) -> None:
+        """Latest LM prefix-cache gauges for ``pool`` (overwritten per
+        read — gauges, not counters; the C8 surface reads them back via
+        `lm_gauges`)."""
+        with self._lock:
+            self._lm_gauges[pool] = dict(gauges)
 
     # -- reading ----------------------------------------------------------
 
@@ -119,6 +130,11 @@ class MetricsTracker:
             stddev=statistics.pstdev(vals) if len(vals) > 1 else 0.0,
             n=len(vals))
 
+    def lm_gauges(self, pool: str) -> dict | None:
+        with self._lock:
+            g = self._lm_gauges.get(pool)
+            return dict(g) if g is not None else None
+
     def avg_query_time(self, model: str) -> float:
         """Feed for the fair scheduler (`model_average_inference_time`,
         `:504-506`). 0.0 = no history yet."""
@@ -134,7 +150,9 @@ class MetricsTracker:
                     "proc": {m: [list(x) for x in v]
                              for m, v in self._proc.items()},
                     "images": {m: [list(x) for x in v]
-                               for m, v in self._images.items()}}
+                               for m, v in self._images.items()},
+                    "lm_gauges": {m: dict(g) for m, g
+                                  in self._lm_gauges.items()}}
 
     def load_wire(self, d: dict) -> None:
         with self._lock:
@@ -146,3 +164,5 @@ class MetricsTracker:
                           for m, v in d.get("proc", {}).items()}
             self._images = {m: [(float(a), int(b)) for a, b in v]
                             for m, v in d.get("images", {}).items()}
+            self._lm_gauges = {m: dict(g) for m, g
+                               in d.get("lm_gauges", {}).items()}

@@ -626,3 +626,124 @@ def run_lm_bench(platform: str, device_kind: str, n_devices: int,
                 "error": f"{type(e).__name__}: {e}"}
 
     return out
+
+
+def prefix_bench_workload(cfg: dict, block_size: int
+                          ) -> tuple[list[list[int]], int, tuple[int, ...]]:
+    """(prompts, shared_prefix_len, prompt_buckets) for the shared-prefix
+    serving workload: ``3·slots`` full-length prompts sharing a block-
+    aligned head of ~3/4 prompt_len (a system/few-shot prompt) with
+    unique tails. The bucket ladder lets a radix hit prefill only its
+    tail at the small bucket — the FLOPs the cache exists to skip — while
+    the cache-off pool pays the full bucket every admission. Single
+    source of truth for the bench phase and its CPU record-shape test."""
+    pl = cfg["prompt_len"]
+    shared_len = max(block_size, (pl * 3 // 4) // block_size * block_size)
+    if shared_len >= pl:
+        shared_len = max(0, pl - block_size)
+    buckets = tuple(sorted({pl, max(1, pl // 2), max(1, pl - shared_len)}))
+    rng = np.random.default_rng(7)
+    head = [int(t) for t in rng.integers(1, cfg["vocab"], size=shared_len)]
+    prompts = []
+    for _ in range(cfg["slots"] * 3):
+        tail = [int(t) for t in rng.integers(1, cfg["vocab"],
+                                             size=pl - shared_len)]
+        prompts.append(head + tail)
+    return prompts, shared_len, buckets
+
+
+def run_lm_prefix_bench(platform: str, device_kind: str, n_devices: int,
+                        peak_bf16: float | None, *, deadline: float,
+                        compact: bool = False) -> dict:
+    """BENCH_SUITE=lm_prefix: the shared-prefix serving workload through
+    the paged KV block pool + radix prefix cache (`engine/kv_blocks.py`,
+    `serve/prefix_cache.py`), cache-on vs cache-off on the SAME pool
+    config. The comparable pair is (tokens/sec to drain, admission
+    prefill tokens actually computed): the cache turns each admission's
+    full-bucket prefill into a tail-bucket prefill after a block-aligned
+    radix hit, token-exactly. ``cache_on`` is the headline record
+    (captured into BENCH_LAST_GOOD_lm_prefix.json by the capture loop's
+    ``prefix_suite`` step)."""
+    from idunno_tpu.engine.serve_lm import DecodeServer
+    from idunno_tpu.models.transformer import TransformerLM
+
+    cfg = lm_bench_config(platform)
+    tpu = platform == "tpu"
+    block = _env_int("BENCH_LM_KV_BLOCK", 16 if tpu else 4)
+    out: dict = {"config": {k: v for k, v in cfg.items()},
+                 "platform": platform, "device_kind": device_kind,
+                 "n_devices": n_devices, "kv_block_size": block}
+    dt = jnp.bfloat16
+    model = TransformerLM(vocab=cfg["vocab"], dim=cfg["dim"],
+                          depth=cfg["depth"], num_heads=cfg["heads"],
+                          causal=True, dtype=dt, param_dtype=dt)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    n_params, param_bytes = _count_params(params)
+    out["n_params"] = n_params
+    out["param_bytes"] = param_bytes
+
+    prompts, shared_len, buckets = prefix_bench_workload(cfg, block)
+    max_new = min(cfg["decode_steps"] + 1,
+                  cfg["max_len"] - cfg["prompt_len"])
+    out["workload"] = {"n_requests": len(prompts),
+                       "shared_prefix_len": shared_len,
+                       "prompt_len": cfg["prompt_len"],
+                       "prompt_buckets": list(buckets),
+                       "max_new": max_new}
+
+    def run_pool(**server_kw) -> dict:
+        srv = DecodeServer(model, params, slots=cfg["slots"],
+                           prompt_len=cfg["prompt_len"],
+                           max_len=cfg["max_len"],
+                           decode_steps=cfg["decode_steps"],
+                           prompt_buckets=buckets, **server_kw)
+        # warm-up pays every compile the timed region will hit: the
+        # first request compiles the cold full-bucket path (and, cache-
+        # on, seeds the tree); the second compiles the hit path (tail
+        # bucket + spliced radix prefix)
+        for _ in range(2):
+            srv.submit(prompts[0], max_new=2)
+            srv.run_until_drained()
+        s0 = srv.stats()
+        t0 = time.perf_counter()
+        for p in prompts:
+            srv.submit(p, max_new=max_new)
+        srv.run_until_drained()
+        drain_s = time.perf_counter() - t0
+        s1 = srv.stats()
+        gen = s1["tokens_generated"] - s0["tokens_generated"]
+        rec = {
+            "tokens_per_s": round(gen / drain_s, 1),
+            "drain_s": round(drain_s, 3),
+            "tokens_generated": gen,
+            "prefill_tokens": s1["prefill_tokens"] - s0["prefill_tokens"],
+            "dispatches": s1["dispatches"] - s0["dispatches"],
+        }
+        if "prefix_cache" in s1:
+            rec["prefix_cache"] = s1["prefix_cache"]
+        return rec
+
+    # headline first: a deadline hit must cost the baseline, not the
+    # cache-on record the suite exists to capture. Pool sized one chain
+    # above peak pinned capacity so the shared head isn't competing
+    # with live chains for blocks.
+    per_chain = -(-cfg["prompt_len"] // block)
+    out["cache_on"] = run_pool(
+        kv_block_size=block,
+        kv_cache_blocks=(cfg["slots"] + 1) * per_chain)
+    if time.perf_counter() < deadline:
+        try:
+            out["cache_off"] = run_pool()
+            on, off = out["cache_on"], out["cache_off"]
+            out["speedup_vs_off"] = round(
+                on["tokens_per_s"] / off["tokens_per_s"], 2)
+            out["prefill_tokens_ratio"] = round(
+                on["prefill_tokens"] / max(off["prefill_tokens"], 1), 3)
+        except Exception as e:  # noqa: BLE001
+            out["cache_off"] = {"error": f"{type(e).__name__}: {e}"}
+    if peak_bf16:
+        out["cache_on"]["mfu"] = round(
+            out["cache_on"]["tokens_per_s"] * 2.0 * n_params / peak_bf16,
+            4)
+    return out

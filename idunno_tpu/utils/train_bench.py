@@ -233,6 +233,10 @@ def run_train_bench(platform: str, device_kind: str, n_devices: int,
                 "compile_s": round(cf, 2),
                 "optimizer_layout":
                     "per-tensor (ZeRO-3 shards opt-state leaves)",
+                "note": "vs_plain's numerator is the FLAT-layout plain "
+                        "step (the shipped default) — it folds the "
+                        "per-tensor layout cost in with the sharding "
+                        "cost, not a same-layout A/B",
             }
         except Exception as e:  # noqa: BLE001
             out["fsdp"] = {"error": f"{type(e).__name__}: {e}"}

@@ -8,8 +8,10 @@ import time
 
 import pytest
 
-from idunno_tpu.utils.lm_bench import (lm_bench_config, run_lm_bench,
-                                        spec_max_new, spec_rounds)
+from idunno_tpu.utils.lm_bench import (lm_bench_config,
+                                        prefix_bench_workload, run_lm_bench,
+                                        run_lm_prefix_bench, spec_max_new,
+                                        spec_rounds)
 
 TINY = {
     "BENCH_LM_DIM": "64", "BENCH_LM_DEPTH": "1", "BENCH_LM_HEADS": "2",
@@ -110,3 +112,43 @@ def test_default_config_phases_fit_serving_limits(platform, monkeypatch):
     assert cfg["max_new"] >= 2 * cfg["decode_steps"] + 1
     assert cfg["heads"] % max(cfg["gqa_kv_heads"], 1) == 0
     assert cfg["dim"] % cfg["heads"] == 0
+
+
+def test_prefix_suite_record_shape_and_saves_prefill(tiny_env):
+    """BENCH_SUITE=lm_prefix (`run_lm_prefix_bench`): on the shared-
+    prefix workload the cache-on pool must compute strictly fewer
+    admission prefill tokens than cache-off with a nonzero hit rate and
+    identical decode output volume — the acceptance bar for the paged
+    KV pool + radix prefix cache: prefill work actually reduced, not
+    just counters present."""
+    rec = run_lm_prefix_bench("cpu", "cpu", 1, None,
+                              deadline=time.perf_counter() + 600,
+                              compact=False)
+    for k in ("config", "kv_block_size", "workload", "cache_on",
+              "cache_off"):
+        assert k in rec, f"missing {k}"
+    on, off = rec["cache_on"], rec["cache_off"]
+    assert on["tokens_per_s"] > 0 and off["tokens_per_s"] > 0
+    assert on["tokens_generated"] == off["tokens_generated"], \
+        "both pools must produce the same decode volume"
+    assert on["prefill_tokens"] < off["prefill_tokens"], \
+        "the cache's whole point: less admission prefill work"
+    assert rec["prefill_tokens_ratio"] < 1.0
+    pc = on["prefix_cache"]
+    assert pc["prefix_hit_rate"] > 0 and pc["cached_tokens_saved"] > 0
+    assert "prefix_cache" not in off
+
+
+def test_prefix_workload_shape(tiny_env):
+    """The workload helper must emit block-aligned shared heads shorter
+    than the prompt and a bucket ladder whose smallest rung fits the
+    unique tail (otherwise a hit can't shrink the prefill bucket)."""
+    cfg = lm_bench_config("cpu")
+    prompts, shared, buckets = prefix_bench_workload(cfg, 4)
+    assert len(prompts) == cfg["slots"] * 3
+    assert 0 < shared < cfg["prompt_len"] and shared % 4 == 0
+    assert all(len(p) == cfg["prompt_len"] for p in prompts)
+    head = prompts[0][:shared]
+    assert all(p[:shared] == head for p in prompts)
+    assert min(buckets) <= cfg["prompt_len"] - shared
+    assert max(buckets) == cfg["prompt_len"]

@@ -1,0 +1,408 @@
+"""Paged KV block pool + cross-request radix prefix cache
+(`engine/kv_blocks.py`, `serve/prefix_cache.py`).
+
+Exactness oracle: a radix hit splices KV another request computed — greedy
+decode through a `kv_block_size` pool must stay token-for-token identical
+to `engine.generate.generate` at EVERY hit depth (empty, partial-block,
+multi-block, full-prompt), for MHA, GQA/MQA, penalties pools, int8
+caches, a pool-level static prefix, and a speculative draft. The
+reference has no counterpart: every query recomputes from scratch
+(`mp4_machinelearning.py:541-616`).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idunno_tpu.engine.generate import generate
+from idunno_tpu.engine.kv_blocks import (
+    KVBlockPool, _is_kv, concat_kv_prefix)
+from idunno_tpu.engine.serve_lm import DecodeServer, _prefill
+from idunno_tpu.models.transformer import TransformerLM
+from idunno_tpu.serve.prefix_cache import RadixPrefixCache
+
+VOCAB = 61
+BS = 2          # kv_block_size under test: small → multi-block chains
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = TransformerLM(vocab=VOCAB, dim=32, depth=2, num_heads=4)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def expected(model, params, prompt, max_new, **kw):
+    out = generate(model, params, jnp.asarray([prompt], jnp.int32),
+                   prompt_len=len(prompt), max_new=max_new, **kw)
+    return [int(t) for t in np.asarray(out[0])]
+
+
+def kv_leaves(tree) -> dict:
+    return {jax.tree_util.keystr(p): leaf for p, leaf
+            in jax.tree_util.tree_flatten_with_path(tree)[0] if _is_kv(p)}
+
+
+def row_cache_for(model, params, tokens):
+    cache, _ = _prefill(model, params,
+                        jnp.asarray([tokens], jnp.int32),
+                        jnp.int32(len(tokens)), len(tokens))
+    return cache
+
+
+# -- KVBlockPool unit -------------------------------------------------------
+
+def test_pool_alloc_free_refcount(lm):
+    model, _ = lm
+    pool = KVBlockPool(model, num_blocks=3, block_size=BS)
+    bids = [pool.alloc() for _ in range(3)]
+    assert sorted(bids) == [0, 1, 2] and pool.num_free == 0
+    assert pool.alloc() is None, "exhausted pool must return None, not raise"
+    pool.incref(bids[0])
+    with pytest.raises(ValueError, match="refcount"):
+        pool.free(bids[0])                      # pinned block can't be freed
+    pool.decref(bids[0])
+    with pytest.raises(ValueError, match="below zero"):
+        pool.decref(bids[0])
+    pool.free(bids[0])
+    with pytest.raises(ValueError, match="not allocated"):
+        pool.free(bids[0])                      # double free
+    assert pool.num_free == 1 and pool.num_used == 2
+
+
+def test_pool_validation(lm):
+    model, _ = lm
+    with pytest.raises(ValueError):
+        KVBlockPool(model, num_blocks=0, block_size=BS)
+    with pytest.raises(ValueError):
+        KVBlockPool(model, num_blocks=2, block_size=0)
+
+
+def test_write_gather_roundtrip(lm):
+    """Blocks written from a real prefill cache must gather back into a
+    tree whose K/V leaves equal the contiguous source slice — this is
+    the storage half of the token-exactness argument."""
+    model, params = lm
+    cache = row_cache_for(model, params, [5, 11, 17, 23, 2, 44])
+    pool = KVBlockPool(model, num_blocks=4, block_size=BS)
+    bids = [pool.alloc() for _ in range(3)]
+    for j, bid in enumerate(bids):
+        pool.write_block(bid, cache, j * BS)
+    got = kv_leaves(pool.gather(bids))
+    src = kv_leaves(cache)
+    assert set(got) == set(src)
+    for key, leaf in got.items():
+        np.testing.assert_array_equal(
+            np.asarray(leaf), np.asarray(src[key][:, :3 * BS]),
+            err_msg=f"gather mismatch at {key}")
+    # gathering a permuted chain reorders the token axis accordingly
+    perm = kv_leaves(pool.gather([bids[1], bids[0]]))
+    for key, leaf in perm.items():
+        np.testing.assert_array_equal(
+            np.asarray(leaf[:, :BS]), np.asarray(src[key][:, BS:2 * BS]))
+
+
+def test_concat_kv_prefix_matches_contiguous(lm):
+    """static-prefix cache ++ gathered chain ≈ one contiguous prefill
+    of the concatenated tokens (K/V leaves only; cursors come from
+    ``front`` and are overwritten by the consumer). allclose, not
+    array_equal: the length-2 and length-6 prefills are DIFFERENT
+    compiled programs whose accumulations may round differently — the
+    serving tier splices the same arrays a previous prefill produced,
+    which is why the hit-depth tests below are token-EXACT."""
+    model, params = lm
+    front_tokens, back_tokens = [7, 3], [9, 1, 4, 6]
+    whole = row_cache_for(model, params, front_tokens + back_tokens)
+    front = row_cache_for(model, params, front_tokens)
+    pool = KVBlockPool(model, num_blocks=2, block_size=BS)
+    bids = [pool.alloc(), pool.alloc()]
+    for j, bid in enumerate(bids):
+        # absolute offsets: the chain sits AFTER the static prefix
+        pool.write_block(bid, whole, len(front_tokens) + j * BS)
+    combined = kv_leaves(concat_kv_prefix(front, pool.gather(bids)))
+    ref = kv_leaves(whole)
+    for key, leaf in combined.items():
+        np.testing.assert_allclose(np.asarray(leaf), np.asarray(ref[key]),
+                                   rtol=1e-4, atol=1e-6,
+                                   err_msg=f"concat mismatch at {key}")
+        # the spliced back half is the very same stored data — exact
+        np.testing.assert_array_equal(
+            np.asarray(leaf[:, len(front_tokens):]),
+            np.asarray(ref[key][:, len(front_tokens):]))
+
+
+# -- RadixPrefixCache semantics --------------------------------------------
+
+def test_radix_insert_lookup_sharing(lm):
+    model, params = lm
+    pool = KVBlockPool(model, num_blocks=8, block_size=BS)
+    tree = RadixPrefixCache(pool)
+    assert tree.lookup([1, 2, 3, 4]) == []
+
+    a = [1, 2, 3, 4, 9]                  # 2 full blocks + 1 partial token
+    chain = tree.insert(a, row_cache_for(model, params, a), 0)
+    assert len(chain) == 2, "partial tail block must not be inserted"
+    assert all(pool.refcount(nd.block) == 1 for nd in chain), \
+        "insert must return the chain acquired"
+    tree.release(chain)
+
+    b = [1, 2, 7, 8]                     # shares only the first block
+    chain_b = tree.insert(b, row_cache_for(model, params, b), 0)
+    assert chain_b[0] is chain[0], "shared head chunk must reuse the node"
+    assert chain_b[1] is not chain[1]
+    assert tree.num_nodes() == 3 and tree.inserted_blocks == 3
+    tree.release(chain_b)
+
+    hit = tree.lookup([1, 2, 3, 4, 5, 6])
+    assert [nd.chunk for nd in hit] == [(1, 2), (3, 4)]
+
+
+def test_radix_lru_eviction_leaves_only(lm):
+    """Eviction frees the LRU refcount-0 LEAF; inner nodes survive while
+    a child pins their position in some chain."""
+    model, params = lm
+    pool = KVBlockPool(model, num_blocks=3, block_size=BS)
+    tree = RadixPrefixCache(pool)
+    a = [1, 2, 3, 4]                     # chain: (1,2) -> (3,4)
+    tree.release(tree.insert(a, row_cache_for(model, params, a), 0))
+    b = [1, 2, 5, 6]                     # adds leaf (5,6) under (1,2)
+    tree.release(tree.insert(b, row_cache_for(model, params, b), 0))
+    tree.lookup(a)                       # a's leaf is now most recent
+
+    c = [9, 8, 7, 6]                     # needs 2 blocks, pool has 0 free
+    chain_c = tree.insert(c, row_cache_for(model, params, c), 0)
+    assert len(chain_c) == 2 and tree.evictions == 2
+    # LRU leaf (5,6) went first, then (3,4); inner (1,2) still cached
+    assert tree.lookup(b) == [] or tree.lookup(b)[0].chunk == (1, 2)
+    assert [nd.chunk for nd in tree.lookup(a)] == [(1, 2)], \
+        "inner node with no children left should still serve a 1-block hit"
+    tree.release(chain_c)
+
+
+def test_radix_pinned_chains_never_evicted(lm):
+    model, params = lm
+    pool = KVBlockPool(model, num_blocks=2, block_size=BS)
+    tree = RadixPrefixCache(pool)
+    a = [1, 2, 3, 4]
+    held = tree.insert(a, row_cache_for(model, params, a), 0)  # acquired
+    b = [5, 6, 7, 8]
+    chain_b = tree.insert(b, row_cache_for(model, params, b), 0)
+    assert chain_b == [] and tree.insert_skips == 1 and tree.evictions == 0, \
+        "a fully-pinned pool must skip the insert, never evict a held chain"
+    assert [nd.chunk for nd in tree.lookup(a)] == [(1, 2), (3, 4)]
+    tree.release(held)
+    # released chain becomes evictable: the same insert now succeeds
+    chain_b = tree.insert(b, row_cache_for(model, params, b), 0)
+    assert len(chain_b) == 2 and tree.evictions == 2
+    tree.release(chain_b)
+
+
+# -- serving-tier exactness across hit depths -------------------------------
+
+def hit_depth_prompts(rng):
+    """(prompt, expected_hit_tokens) pairs driven in order through one
+    pool: empty tree, partial-block overlap (block-aligned down to 2),
+    multi-block, and an identical resubmit (full-prompt, capped one
+    block short so ≥ 1 suffix token feeds the prefill)."""
+    base = [int(t) for t in rng.integers(0, VOCAB, size=8)]
+    return [
+        (base, 0),                                    # cold tree
+        (base[:3] + [base[3] ^ 1] + base[4:], 2),     # diverges in block 2
+        (base[:6] + [59, 58], 6),                     # 3 shared blocks
+        (base, 6),                                    # full prompt, capped
+    ]
+
+
+@pytest.mark.parametrize("kind", ["mha", "gqa", "mqa", "penalties"])
+def test_hit_depths_token_exact(lm, kind):
+    if kind in ("gqa", "mqa"):
+        model = TransformerLM(vocab=VOCAB, dim=32, depth=2, num_heads=4,
+                              num_kv_heads=2 if kind == "gqa" else 1)
+        params = model.init(jax.random.PRNGKey(1),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+    else:
+        model, params = lm
+    gen_kw = ({"presence_penalty": 0.5, "frequency_penalty": 0.3}
+              if kind == "penalties" else {})
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                       penalties=kind == "penalties", kv_block_size=BS,
+                       kv_cache_blocks=16)
+    saved = 0
+    for prompt, hit in hit_depth_prompts(np.random.default_rng(3)):
+        rid = srv.submit(prompt, max_new=6, **gen_kw)
+        done = {c.id: c for c in srv.run_until_drained()}
+        assert done[rid].tokens == expected(model, params, prompt, 6,
+                                            **gen_kw), \
+            f"{kind}: diverged at expected hit depth {hit}"
+        saved += hit
+        assert srv.prefix_cache_stats()["cached_tokens_saved"] == saved, \
+            f"{kind}: wrong hit depth for {prompt}"
+    pc = srv.prefix_cache_stats()
+    assert pc["lookups"] == 4 and pc["hits"] == 3
+    assert pc["prefix_hit_rate"] == pytest.approx(0.75)
+
+
+def test_hit_depths_with_static_prefix_and_int8(lm):
+    """Radix chains sit at absolute positions AFTER the pool-level static
+    prefix; int8 caches add k_scale/v_scale leaves to every block."""
+    model = TransformerLM(vocab=VOCAB, dim=32, depth=2, num_heads=4,
+                          kv_cache_dtype="int8")
+    params = model.init(jax.random.PRNGKey(2),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    pre = [20, 21, 22]
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=32,
+                       prefix=pre, kv_block_size=BS, kv_cache_blocks=16)
+    for prompt, _ in hit_depth_prompts(np.random.default_rng(5)):
+        rid = srv.submit(prompt, max_new=5)
+        done = {c.id: c for c in srv.run_until_drained()}
+        assert done[rid].tokens == expected(model, params, pre + prompt, 5)
+    assert srv.prefix_cache_stats()["hits"] == 3
+
+
+def test_hit_depths_speculative(lm):
+    """The radix cache covers the TARGET only; the draft prefills its own
+    full prompt — fused spec rounds must stay greedy token-exact."""
+    model, params = lm
+    draft = TransformerLM(vocab=VOCAB, dim=16, depth=1, num_heads=2)
+    dparams = draft.init(jax.random.PRNGKey(9),
+                         jnp.zeros((1, 4), jnp.int32))["params"]
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=32,
+                       draft=(draft, dparams), draft_len=3, decode_steps=2,
+                       kv_block_size=BS, kv_cache_blocks=16)
+    for prompt, _ in hit_depth_prompts(np.random.default_rng(11)):
+        rid = srv.submit(prompt, max_new=8)
+        done = {c.id: c for c in srv.run_until_drained()}
+        assert done[rid].tokens == expected(model, params, prompt, 8)
+    assert srv.prefix_cache_stats()["hits"] == 3
+
+
+def test_prompt_bucket_shrinks_after_hit(lm):
+    """A radix hit must move the suffix into a SMALLER prompt bucket —
+    the prefill-FLOPs reduction the cache exists for — visible in the
+    ``prefill_tokens`` counter."""
+    model, params = lm
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                       prompt_buckets=(2, 4, 8), kv_block_size=BS,
+                       kv_cache_blocks=16)
+    p = [4, 9, 14, 19, 24, 29, 34, 39]
+    srv.submit(p, max_new=2)
+    srv.run_until_drained()
+    cold = srv.stats()["prefill_tokens"]
+    assert cold == 8
+    rid = srv.submit(p, max_new=2)             # full-prompt hit (capped 6)
+    done = {c.id: c for c in srv.run_until_drained()}
+    assert done[rid].tokens == expected(model, params, p, 2)
+    assert srv.stats()["prefill_tokens"] - cold == 2, \
+        "6-token hit should drop the 8-bucket prefill to the 2-bucket"
+
+
+# -- eviction under slot churn (satellite: cache pressure never corrupts) --
+
+def test_eviction_under_churn_token_exact(lm):
+    """A pool far too small for the workload: every admission evicts or
+    skips, long-lived co-resident rows pin their chains the whole time,
+    and every stream must stay exact with nonzero eviction traffic."""
+    model, params = lm
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                       kv_block_size=BS, kv_cache_blocks=4)
+    rng = np.random.default_rng(17)
+    reqs = {}
+    long_prompt = [int(t) for t in rng.integers(0, VOCAB, size=7)]
+    reqs[srv.submit(long_prompt, max_new=14)] = (long_prompt, 14)
+    for _ in range(8):                          # churn the second slot
+        p = [int(t) for t in rng.integers(0, VOCAB, size=6)]
+        reqs[srv.submit(p, max_new=2)] = (p, 2)
+    done = {c.id: c for c in srv.run_until_drained()}
+    assert set(done) == set(reqs)
+    for rid, (p, mn) in reqs.items():
+        assert done[rid].tokens == expected(model, params, p, mn), \
+            f"stream {rid} corrupted under eviction pressure"
+    pc = srv.prefix_cache_stats()
+    assert pc["evictions"] > 0, "4-block pool must have evicted"
+    assert pc["kv_blocks_used"] + pc["kv_blocks_free"] == 4
+    # every request retired → every chain released → nothing stays pinned
+    assert all(srv._block_pool.refcount(b) == 0
+               for b in list(srv._block_pool._refs))
+
+
+def test_admission_survives_unallocatable_pool(lm):
+    """Two live rows can pin the entire pool; later admissions must
+    serve exactly (cache-off path) with ``insert_skips`` counted —
+    never blocked, never corrupted."""
+    model, params = lm
+    srv = DecodeServer(model, params, slots=3, prompt_len=8, max_len=24,
+                       kv_block_size=BS, kv_cache_blocks=2)
+    a, b, c = ([1, 2, 3, 4], [5, 6, 7, 8], [9, 10, 11, 12])
+    ra = srv.submit(a, max_new=12)              # pins 2 blocks for a while
+    rb = srv.submit(b, max_new=12)              # pool now unallocatable
+    rc = srv.submit(c, max_new=3)
+    done = {x.id: x for x in srv.run_until_drained()}
+    assert done[ra].tokens == expected(model, params, a, 12)
+    assert done[rb].tokens == expected(model, params, b, 12)
+    assert done[rc].tokens == expected(model, params, c, 3)
+    assert srv.prefix_cache_stats()["insert_skips"] >= 1
+
+
+# -- recovery / rebuild -----------------------------------------------------
+
+def test_rebuild_cold_miss_token_exact(lm):
+    """`lm_manager` node-death recovery rebuilds a pool from its
+    journaled spec (kv_block_size/kv_cache_blocks ride the spec —
+    `serve/control.py`): the new pool starts with an EMPTY tree, so
+    resubmitted requests cold-miss and recompute rather than replaying
+    another node's blocks. Cited from `serve/lm_manager.py:_recover_pool`."""
+    model, params = lm
+    spec = dict(slots=2, prompt_len=8, max_len=24, kv_block_size=BS,
+                kv_cache_blocks=8)
+    prompt = [3, 1, 4, 1, 5, 9]
+    first = DecodeServer(model, params, **spec)
+    for _ in range(2):                          # seed + hit on the old node
+        first.submit(prompt, max_new=4)
+        first.run_until_drained()
+    assert first.prefix_cache_stats()["hits"] == 1
+    assert first.stats()["config"]["kv_block_size"] == BS, \
+        "spec must carry the cache config or recovery rebuilds cache-off"
+
+    rebuilt = DecodeServer(model, params, **spec)   # recovery path
+    rid = rebuilt.submit(prompt, max_new=4)
+    done = {c.id: c for c in rebuilt.run_until_drained()}
+    pc = rebuilt.prefix_cache_stats()
+    assert pc["hits"] == 0 and pc["lookups"] == 1, "rebuild must cold-miss"
+    assert done[rid].tokens == expected(model, params, prompt, 4)
+
+
+# -- stats plumbing ---------------------------------------------------------
+
+def test_stats_surface(lm):
+    model, params = lm
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                       kv_block_size=BS, kv_cache_blocks=8)
+    assert "prefix_cache" not in DecodeServer(
+        model, params, slots=2, prompt_len=8, max_len=24).stats(), \
+        "cache-off pools must not grow a prefix_cache stats section"
+    srv.submit([1, 2, 3, 4], max_new=2)
+    srv.run_until_drained()
+    s = srv.stats()
+    pc = s["prefix_cache"]
+    for k in ("prefix_hit_rate", "lookups", "hits", "cached_tokens_saved",
+              "kv_blocks_free", "kv_blocks_used", "evictions",
+              "insert_skips", "inserted_blocks", "nodes"):
+        assert k in pc, f"missing gauge {k}"
+    assert s["config"]["kv_block_size"] == BS
+    assert s["config"]["kv_cache_blocks"] == 8
+
+
+def test_metrics_lm_gauges_roundtrip():
+    """`lm_stats` pushes the gauges into the C8 tracker; they must ride
+    the failover wire format (`serve/metrics.py`)."""
+    from idunno_tpu.serve.metrics import MetricsTracker
+    m = MetricsTracker()
+    assert m.lm_gauges("pool") is None
+    g = {"prefix_hit_rate": 0.5, "cached_tokens_saved": 12,
+         "kv_blocks_free": 3, "kv_blocks_used": 5}
+    m.record_lm_gauges("pool", g)
+    assert m.lm_gauges("pool") == g
+    m2 = MetricsTracker()
+    m2.load_wire(m.to_wire())
+    assert m2.lm_gauges("pool") == g

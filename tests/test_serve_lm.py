@@ -1191,3 +1191,44 @@ def test_snapshot_streams_prefixes(lm):
         assert row["tokens"] == full[:len(row["tokens"])]
     srv.run_until_drained()
     assert srv.snapshot() == []               # drained pool has no live rows
+
+
+@pytest.mark.parametrize("kv_heads", [None, 2, 1])
+def test_prefix_cache_pool_stays_exact_under_staggered_admission(kv_heads):
+    """kv_block_size>0 turns on the cross-request radix prefix cache
+    (`serve/prefix_cache.py`): the ORIGINAL exactness oracle must keep
+    holding under staggered admission and slot reuse while requests
+    share prompt heads at every hit depth (cold, partial-block,
+    multi-block, full-prompt resubmit), for MHA and GQA/MQA pools.
+    The full cache-semantics matrix lives in `tests/test_prefix_cache.py`."""
+    model = TransformerLM(vocab=VOCAB, dim=32, depth=2, num_heads=4,
+                          num_kv_heads=kv_heads)
+    params = model.init(jax.random.PRNGKey(4),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(13)
+    base = [int(t) for t in rng.integers(0, VOCAB, size=8)]
+    reqs = [(base, 6),                                  # cold
+            (base[:2] + [59, 58, 57], 5),               # 1-block hit
+            (base[:6] + [55], 4),                       # 3-block hit
+            (base, 6),                                  # full-prompt hit
+            ([53, 52, 51], 7)]                          # miss, short
+
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                       kv_block_size=2, kv_cache_blocks=12)
+    ids = {}
+    for prompt, max_new in reqs[:3]:
+        ids[srv.submit(prompt, max_new)] = (prompt, max_new)
+    for _ in range(3):                        # mid-flight...
+        srv.step()
+    for prompt, max_new in reqs[3:]:          # ...new arrivals are admitted
+        ids[srv.submit(prompt, max_new)] = (prompt, max_new)
+    done = srv.run_until_drained()
+
+    assert {c.id for c in done} == set(ids)
+    for c in done:
+        prompt, max_new = ids[c.id]
+        assert c.tokens == expected(model, params, prompt, max_new), \
+            f"request {c.id} diverged with the prefix cache on"
+    pc = srv.prefix_cache_stats()
+    assert pc["lookups"] == 5 and pc["hits"] >= 2
+    assert pc["cached_tokens_saved"] > 0

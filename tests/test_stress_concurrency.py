@@ -69,3 +69,64 @@ def test_parallel_clients_with_worker_churn(tmp_path):
     finally:
         for n in nodes.values():
             n.stop()
+
+
+def test_lm_prefix_cache_under_threaded_churn():
+    """Parallel clients against ONE serving loop whose radix prefix
+    cache rides a pool far too small for the workload (constant
+    eviction + pinned-pool insert skips). Every stream must complete
+    exactly once and stay token-identical to standalone `generate` —
+    cache pressure may only cost hits, never correctness
+    (`serve/prefix_cache.py`; unit matrix in `tests/test_prefix_cache.py`)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from idunno_tpu.engine.generate import generate
+    from idunno_tpu.engine.serve_lm import DecodeServer
+    from idunno_tpu.models.transformer import TransformerLM
+    from idunno_tpu.serve.lm_pool import LMServingLoop
+
+    vocab = 31
+    model = TransformerLM(vocab=vocab, dim=16, depth=1, num_heads=2)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = DecodeServer(model, params, slots=3, prompt_len=8, max_len=16,
+                       kv_block_size=2, kv_cache_blocks=4)
+    loop = LMServingLoop(srv, name="prefix-stress")
+    rng = np.random.default_rng(23)
+    head = [int(t) for t in rng.integers(0, vocab, size=4)]
+    prompts = []
+    for i in range(24):
+        # half share a prompt head (radix hits), half are distinct
+        # (eviction traffic); lengths vary to churn the buckets
+        tail = [int(t) for t in rng.integers(0, vocab, size=2 + i % 3)]
+        prompts.append(head + tail if i % 2 else tail + head[: 2 + i % 2])
+
+    def client(p):
+        return loop.submit(p, max_new=4), p
+
+    try:
+        with ThreadPoolExecutor(max_workers=6) as pool:
+            ids = dict(f.result() for f in
+                       [pool.submit(client, p) for p in prompts])
+        done = {}
+        deadline = time.time() + 120.0
+        while len(done) < len(ids) and time.time() < deadline:
+            for c in loop.poll():
+                assert c.id not in done, f"request {c.id} completed twice"
+                done[c.id] = c
+            time.sleep(0.01)
+        assert len(done) == len(ids), \
+            f"lost {len(ids) - len(done)} requests under churn"
+        for rid, p in ids.items():
+            want = generate(model, params, jnp.asarray([p], jnp.int32),
+                            prompt_len=len(p), max_new=4)
+            assert done[rid].tokens == [int(t) for t in np.asarray(want[0])], \
+                f"stream for {p} corrupted under cache churn"
+        pc = srv.prefix_cache_stats()
+        assert pc["hits"] > 0, "shared heads should have hit"
+        assert pc["evictions"] > 0, "4-block pool must have evicted"
+        assert pc["kv_blocks_used"] + pc["kv_blocks_free"] == 4
+    finally:
+        loop.stop()

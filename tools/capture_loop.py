@@ -54,6 +54,13 @@ STEPS = [
       "BENCH_TRACE": "1"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_lm.json"),
+    # shared-prefix serving workload through the paged KV pool + radix
+    # prefix cache (engine/kv_blocks.py): cache-on vs cache-off on chip —
+    # the prefill-token reduction has only been measured on the CPU mesh
+    ("prefix_suite",
+     {"BENCH_SUITE": "lm_prefix", "BENCH_TIME_BUDGET_S": "600"},
+     [sys.executable, "bench.py"],
+     "BENCH_LAST_GOOD_lm_prefix.json"),
     ("headline_resnet18",
      {"BENCH_TIME_BUDGET_S": "600"},
      [sys.executable, "bench.py"],
