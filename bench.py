@@ -40,9 +40,9 @@ REFERENCE_IMAGES_PER_S = 400 / 9.0   # ≈44.4, whole reference cluster
 # training is a beyond-parity capability and carries its own surface,
 # utils/train_bench.py).
 BENCH_SUITE = os.environ.get("BENCH_SUITE", "cnn")
-if BENCH_SUITE not in ("cnn", "lm", "lm_prefix", "train"):
+if BENCH_SUITE not in ("cnn", "lm", "lm_prefix", "lm_slots", "train"):
     raise SystemExit(
-        f"BENCH_SUITE={BENCH_SUITE!r}: want cnn|lm|lm_prefix|train")
+        f"BENCH_SUITE={BENCH_SUITE!r}: want cnn|lm|lm_prefix|lm_slots|train")
 # BENCH_MODEL selects the measured network: resnet18 (headline, matches the
 # reference's "resnet"), resnet50 (bottleneck — ~4x the FLOPs/image, the
 # MXU-utilisation probe), alexnet (the other half of the reference's
@@ -60,6 +60,7 @@ if BENCH_MODEL not in ("resnet18", "resnet50", "alexnet", "vit",
 METRIC = {"cnn": f"{BENCH_MODEL}_imagenet_inference_throughput",
           "lm": "lm_decode_throughput",
           "lm_prefix": "lm_prefix_cache_throughput",
+          "lm_slots": "lm_slot_scaling_throughput",
           "train": "lm_train_throughput"}[BENCH_SUITE]
 
 # The TPU sits behind a tunnel that is intermittently down; a successful TPU
@@ -72,6 +73,7 @@ _LAST_GOOD = os.path.join(
      if BENCH_SUITE == "cnn" and BENCH_MODEL == "resnet18"
      else "BENCH_LAST_GOOD_lm.json" if BENCH_SUITE == "lm"
      else "BENCH_LAST_GOOD_lm_prefix.json" if BENCH_SUITE == "lm_prefix"
+     else "BENCH_LAST_GOOD_lm_slots.json" if BENCH_SUITE == "lm_slots"
      else "BENCH_LAST_GOOD_train.json" if BENCH_SUITE == "train"
      else f"BENCH_LAST_GOOD_{BENCH_MODEL}.json"))
 # the compact LM sub-record captured during a default cnn run caches here
@@ -725,6 +727,16 @@ def run_lm_prefix_suite(devices) -> None:
                       "lm prefix-cache measurement failed", compact=False)
 
 
+def run_lm_slots_suite(devices) -> None:
+    """BENCH_SUITE=lm_slots: the decode slot-scaling curve (16/32/64 on
+    TPU) behind the blessed serving slot default; headline is the curve's
+    best tokens/sec, the blessed pick and per-point dispatch latencies
+    ride in details."""
+    from idunno_tpu.utils.lm_bench import run_lm_slots_bench
+    _run_record_suite(devices, run_lm_slots_bench, "best",
+                      "lm slot-scaling measurement failed", compact=False)
+
+
 def run_train_suite(devices) -> None:
     """BENCH_SUITE=train: LM + CNN train-step throughput (trained
     tokens/sec; accum/fsdp/cnn points in details)."""
@@ -775,6 +787,8 @@ def main() -> None:
             run_lm_suite(devices)
         elif BENCH_SUITE == "lm_prefix":
             run_lm_prefix_suite(devices)
+        elif BENCH_SUITE == "lm_slots":
+            run_lm_slots_suite(devices)
         elif BENCH_SUITE == "train":
             run_train_suite(devices)
         else:

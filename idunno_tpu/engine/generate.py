@@ -22,7 +22,9 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from idunno_tpu.models.transformer import TransformerLM
+from idunno_tpu.models.transformer import (TransformerLM, decode_apply,
+                                           scan_compatible,
+                                           stack_block_params)
 
 
 def decode_model(model: TransformerLM, max_len: int) -> TransformerLM:
@@ -34,8 +36,20 @@ def decode_model(model: TransformerLM, max_len: int) -> TransformerLM:
 def init_cache(model: TransformerLM, batch: int, max_len: int) -> Any:
     """Zeroed per-layer KV caches for a [batch] decode of ≤ max_len tokens.
     Shapes come from `jax.eval_shape` (no parameter init or forward compute
-    is traced — the cache is zeros by construction)."""
+    is traced — the cache is zeros by construction).
+
+    ``scan_layers=True`` models get the scanned layout: ONE per-block
+    subtree whose leaves carry a leading depth axis (shapes from the
+    unscanned twin's block0 — scan-compatible models have homogeneous
+    blocks, so block0 names every layer's shapes)."""
     dec = decode_model(model, max_len)
+    if getattr(model, "scan_layers", False):
+        flat = dataclasses.replace(dec, scan_layers=False)
+        shapes = jax.eval_shape(flat.init, jax.random.PRNGKey(0),
+                                jnp.zeros((batch, 1), jnp.int32))
+        return jax.tree.map(
+            lambda s: jnp.zeros((model.depth,) + s.shape, s.dtype),
+            shapes["cache"]["block0"])
     shapes = jax.eval_shape(dec.init, jax.random.PRNGKey(0),
                             jnp.zeros((batch, 1), jnp.int32))
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
@@ -81,7 +95,17 @@ def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
     b = prompt.shape[0]
     total = prompt_len + max_new
     dec = decode_model(model, total)
-    cache = init_cache(model, b, total)
+    if scan_compatible(model) and not getattr(model, "scan_layers", False):
+        # run the SAME scanned step the serving pool runs (decode_apply),
+        # so the pool's token-exactness tests compare like with like; the
+        # one-time param stack is traced into the program ahead of the
+        # decode loop — one weight copy per generate call
+        dec = dataclasses.replace(dec, scan_layers=True)
+        if "blocks" in params and "block0" not in params:
+            pass    # already in the stacked layout (e.g. a pool's params)
+        else:
+            params = stack_block_params(params, model.depth)
+    cache = init_cache(dec, b, total)
     tokens = jnp.concatenate(
         [prompt.astype(jnp.int32),
          jnp.zeros((b, max_new), jnp.int32)], axis=1)       # [B, total]
@@ -96,8 +120,7 @@ def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
     def step(t, carry):
         tokens, cache, rng, counts = carry
         tok = jax.lax.dynamic_slice(tokens, (0, t), (b, 1))  # current input
-        logits, mutated = dec.apply({"params": params, "cache": cache},
-                                    tok, mutable=["cache"])
+        logits, cache = decode_apply(dec, params, cache, tok)
         logits = logits[:, 0]                                # [B, vocab]
         if penalized:   # static: counts over generated tokens only
             logits = (logits
@@ -129,7 +152,7 @@ def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
         if penalized:   # teacher-forced (prompt) tokens never count
             counts = counts.at[jnp.arange(b), nxt].add(
                 jnp.where(keep_prompt, 0, 1))
-        return tokens, mutated["cache"], rng, counts
+        return tokens, cache, rng, counts
 
     tokens, _, _, _ = jax.lax.fori_loop(0, total - 1, step,
                                         (tokens, cache, rng, counts0))

@@ -63,37 +63,54 @@ def _is_kv(path) -> bool:
     return bool(path) and getattr(path[-1], "key", None) in KV_LEAF_KEYS
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("stacked",))
 def _write_block(store: jnp.ndarray, row_leaf: jnp.ndarray,
-                 bid: jnp.ndarray, off: jnp.ndarray) -> jnp.ndarray:
+                 bid: jnp.ndarray, off: jnp.ndarray,
+                 stacked: bool = False) -> jnp.ndarray:
     """store[bid] = row_leaf[0, off:off+block_size]. bid/off are traced:
-    one compile per (store shape, row length), not per block or offset."""
-    bs = store.shape[1]
-    chunk = jax.lax.dynamic_slice_in_dim(row_leaf[0], off, bs, axis=0)
+    one compile per (store shape, row length), not per block or offset.
+    ``stacked`` is a STATIC flag, not rank-inferred: a scanned-cache
+    k_scale leaf [L, 1, T, kvh] has the same rank as an unscanned
+    cached_k [1, T, h, d], so only the caller knows the layout."""
+    if stacked:
+        # row_leaf is [L, 1, T, ...]; block slivers keep the depth axis
+        bs = store.shape[2]
+        chunk = jax.lax.dynamic_slice_in_dim(row_leaf[:, 0], off, bs,
+                                             axis=1)
+    else:
+        bs = store.shape[1]
+        chunk = jax.lax.dynamic_slice_in_dim(row_leaf[0], off, bs, axis=0)
     return store.at[bid].set(chunk.astype(store.dtype))
 
 
-@partial(jax.jit, static_argnames=("n",))
+@partial(jax.jit, static_argnames=("n", "stacked"))
 def _gather_blocks(store: jnp.ndarray, bids: jnp.ndarray,
-                   n: int) -> jnp.ndarray:
-    """[n blocks] → one [1, n·block_size, ...] contiguous leaf."""
+                   n: int, stacked: bool = False) -> jnp.ndarray:
+    """[n blocks] → one contiguous leaf: [1, n·block_size, ...] per-block,
+    [L, 1, n·block_size, ...] stacked (depth leads, batch-1 second)."""
+    if stacked:
+        picked = jnp.moveaxis(store[bids], 0, 1)   # [L, n, bs, ...]
+        return picked.reshape(
+            (store.shape[1], 1, n * store.shape[2]) + store.shape[3:])
     return store[bids].reshape((1, n * store.shape[1]) + store.shape[2:])
 
 
-def concat_kv_prefix(front: Any, back: Any) -> Any:
+def concat_kv_prefix(front: Any, back: Any, token_axis: int = 1) -> Any:
     """Concatenate two batch-1 cache trees along the token axis at the
     K/V leaves (static pool prefix + gathered radix chain → one combined
     prefix for `_prefill_suffix`). Non-K/V leaves (cursors) are taken
     from ``front`` — the consumer overwrites them anyway. Leaves match
     by keystr path, not container identity, so a flax-mutated cache and
-    an `init_cache` template compose regardless of dict flavor."""
+    an `init_cache` template compose regardless of dict flavor.
+    ``token_axis`` is 1 for the per-block layout, 2 for depth-stacked
+    scanned caches ([L, 1, T, ...])."""
     src = {jax.tree_util.keystr(p): leaf for p, leaf
            in jax.tree_util.tree_flatten_with_path(back)[0] if _is_kv(p)}
 
     def f(path, x):
         if _is_kv(path):
             return jnp.concatenate(
-                [x, src[jax.tree_util.keystr(path)]], axis=1)
+                [x, src[jax.tree_util.keystr(path)]], axis=token_axis)
         return x
     return jax.tree_util.tree_map_with_path(f, front)
 
@@ -112,14 +129,23 @@ class KVBlockPool:
         self.model = model
         self.num_blocks = num_blocks
         self.block_size = block_size
+        # scanned models carry depth-stacked caches ([L, 1, bs, ...]);
+        # the stores keep the depth axis inside each block so one
+        # write/gather moves every layer's sliver at once
+        self._stacked = bool(getattr(model, "scan_layers", False))
         # batch-1 length-block_size template names the K/V leaves and
         # their per-token shapes; the stores add a leading block axis
         shapes = jax.eval_shape(lambda: init_cache(model, 1, block_size))
         self._stores: dict[str, jnp.ndarray] = {}
         for path, leaf in jax.tree_util.tree_flatten_with_path(shapes)[0]:
             if _is_kv(path):
+                if self._stacked:
+                    shape = ((num_blocks, leaf.shape[0], block_size)
+                             + leaf.shape[3:])
+                else:
+                    shape = (num_blocks, block_size) + leaf.shape[2:]
                 self._stores[jax.tree_util.keystr(path)] = jnp.zeros(
-                    (num_blocks, block_size) + leaf.shape[2:], leaf.dtype)
+                    shape, leaf.dtype)
         if not self._stores:
             raise ValueError("model's decode cache has no K/V leaves")
         self._free: list[int] = list(range(num_blocks - 1, -1, -1))
@@ -180,7 +206,8 @@ class KVBlockPool:
         b = jnp.int32(bid)
         off = jnp.int32(offset)
         for key, store in self._stores.items():
-            self._stores[key] = _write_block(store, src[key], b, off)
+            self._stores[key] = _write_block(store, src[key], b, off,
+                                             stacked=self._stacked)
 
     def gather(self, blocks: list[int]) -> Any:
         """Chain → a batch-1, length-``len(blocks)·block_size`` cache
@@ -196,7 +223,8 @@ class KVBlockPool:
                 lambda: init_cache(self.model, 1, total))
             self._tree_templates[total] = template
         bids = jnp.asarray(blocks, jnp.int32)
-        parts = {key: _gather_blocks(store, bids, n)
+        parts = {key: _gather_blocks(store, bids, n,
+                                     stacked=self._stacked)
                  for key, store in self._stores.items()}
 
         def fill(path, leaf):

@@ -44,9 +44,23 @@ import numpy as np
 
 from idunno_tpu.engine.generate import decode_model, init_cache
 from idunno_tpu.engine.kv_blocks import concat_kv_prefix
-from idunno_tpu.models.transformer import TransformerLM
+from idunno_tpu.models.transformer import (TransformerLM, decode_apply,
+                                           scan_compatible,
+                                           stack_block_params)
 from idunno_tpu.ops.quantize import dequantize_tree, quantize_tree
-from idunno_tpu.ops.sampling import filtered_probs
+from idunno_tpu.ops.sampling import (filter_on as _filter_on,
+                                     filtered_probs, fused_decode_tail,
+                                     row_sample_logits as _row_sample_logits,
+                                     safe_log as _safe_log)
+
+# slot default shared with the serving control plane (`serve/control.py`,
+# `serve/lm_manager.py`). 16 is the measured knee of the BENCH_SUITE=
+# lm_slots scaling curve (RESULTS.md decode section / BENCH_LAST_GOOD_
+# lm_slots.json): throughput still rises toward 64 slots (~1.6x) but
+# sub-linearly, while KV-cache HBM and time-to-first-token grow linearly
+# — 16 is the balanced serving default; operators chasing batch
+# throughput pass slots=64 explicitly (tests pin their own sizes).
+DEFAULT_SLOTS = 16
 
 
 @dataclass
@@ -97,10 +111,12 @@ class Completion:
 def _set_cursors(cache: Any, cursors: jnp.ndarray) -> Any:
     """Overwrite every per-layer ``cursors`` leaf with the server's single
     source of truth (the layers never disagree; per-row cursors are
-    caller-owned — `MultiHeadAttention._decode_step`)."""
+    caller-owned — `MultiHeadAttention._decode_step`). Broadcast covers
+    both layouts: per-block [S] leaves and the scanned cache's [L, S]
+    stacked leaf."""
     def f(path, leaf):
         if path and getattr(path[-1], "key", None) == "cursors":
-            return cursors
+            return jnp.broadcast_to(cursors, leaf.shape)
         return leaf
     return jax.tree_util.tree_map_with_path(f, cache)
 
@@ -115,19 +131,21 @@ def _prefill(model: TransformerLM, params: Any, prompt: jnp.ndarray,
     dec = decode_model(model, prompt_len)
     cache = init_cache(model, 1, prompt_len)
     params = dequantize_tree(params)     # no-op for full-precision trees
-    logits, mutated = dec.apply({"params": params, "cache": cache},
-                                prompt.astype(jnp.int32), mutable=["cache"])
+    logits, cache = decode_apply(dec, params, cache,
+                                 prompt.astype(jnp.int32))
     last = jax.lax.dynamic_index_in_dim(logits[0], true_len - 1, axis=0,
                                         keepdims=False)     # [vocab]
-    return mutated["cache"], last
+    return cache, last
 
 
 def _set_scalar_cursor(cache: Any, value) -> Any:
     """Overwrite the scalar ``cursor`` leaves of a batch-1 decode cache
-    (the chunked-prefill twin of `_set_cursors`)."""
+    (the chunked-prefill twin of `_set_cursors`; broadcast covers the
+    scanned cache's [L] stacked cursor leaf)."""
     def f(path, leaf):
         if path and getattr(path[-1], "key", None) == "cursor":
-            return jnp.asarray(value, jnp.int32)
+            return jnp.broadcast_to(jnp.asarray(value, jnp.int32),
+                                    leaf.shape)
         return leaf
     return jax.tree_util.tree_map_with_path(f, cache)
 
@@ -165,37 +183,15 @@ def _prefill_suffix(model: TransformerLM, params: Any, prefix_cache: Any,
     cache = jax.tree_util.tree_map_with_path(put, cache)
     cache = _set_scalar_cursor(cache, prefix_len)
     params = dequantize_tree(params)
-    logits, mutated = dec.apply({"params": params, "cache": cache},
-                                suffix.astype(jnp.int32),
-                                mutable=["cache"])
+    logits, cache = decode_apply(dec, params, cache,
+                                 suffix.astype(jnp.int32))
     last = jax.lax.dynamic_index_in_dim(logits[0], true_len - 1, axis=0,
                                         keepdims=False)     # [vocab]
-    return mutated["cache"], last
+    return cache, last
 
 
-def _safe_log(probs: jnp.ndarray) -> jnp.ndarray:
-    """log with EXACT -inf outside the support — a filtered-out token
-    must have probability zero, not e^-69 (matches generate's -inf
-    nucleus masking)."""
-    return jnp.where(probs > 0.0, jnp.log(jnp.maximum(probs, 1e-38)),
-                     -jnp.inf)
-
-
-def _filter_on(top_p: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
-    """Per-row: does this row ask for any sampling filter at all?"""
-    return (top_p < 1.0) | (top_k > 0)
-
-
-def _row_sample_logits(scaled: jnp.ndarray, top_p: jnp.ndarray,
-                       top_k: jnp.ndarray) -> jnp.ndarray:
-    """Per-row sampling logits: top-k/nucleus-filtered for rows that ask
-    for a filter, plain log-softmax otherwise. The per-ROW select (not a
-    batch-level branch) keeps every row's formula a function of its own
-    request alone, so a journal replay without its former co-residents
-    redraws the SAME stream bit-for-bit."""
-    plain = jax.nn.log_softmax(scaled, axis=-1)
-    filtered = _safe_log(filtered_probs(scaled, top_p, top_k))
-    return jnp.where(_filter_on(top_p, top_k)[..., None], filtered, plain)
+# _safe_log/_filter_on/_row_sample_logits live in `ops.sampling` (shared
+# with the fused decode tail); imported above under their former names.
 
 
 def _next_token(logits: jnp.ndarray, temp: jnp.ndarray,
@@ -222,12 +218,16 @@ def _pick_first(logits: jnp.ndarray, temp: jnp.ndarray,
     return _next_token(logits, temp, sub, top_p, top_k), nxt_key
 
 
-def _splice_rows(cache: Any, row_cache: Any, slot: jnp.ndarray) -> Any:
+def _splice_rows(cache: Any, row_cache: Any, slot: jnp.ndarray,
+                 stacked: bool) -> Any:
     """Write a batch-1 prefill cache's K/V rows into row ``slot`` of a
     pool cache. The two trees' structures differ only at the cursor leaves
     (scalar "cursor" in the prefill cache vs caller-owned [S] "cursors"
     in the pool) — K/V (and, for int8 caches, their scale) leaves match
-    by path, everything else untouched."""
+    by path, everything else untouched. ``stacked`` (static — the layout
+    is not inferable from rank: a per-block cached_k and a stacked
+    k_scale are both 4-D) selects the scanned layout, where every leaf
+    carries a leading depth axis and the slot axis is SECOND."""
     src = {jax.tree_util.keystr(p): leaf for p, leaf
            in jax.tree_util.tree_flatten_with_path(row_cache)[0]}
 
@@ -235,7 +235,11 @@ def _splice_rows(cache: Any, row_cache: Any, slot: jnp.ndarray) -> Any:
         if getattr(path[-1], "key", None) not in (
                 "cached_k", "cached_v", "k_scale", "v_scale"):
             return dst
-        kv = src[jax.tree_util.keystr(path)]          # [1, P, h, d]
+        kv = src[jax.tree_util.keystr(path)]          # [(L,) 1, P, h, d]
+        if stacked:
+            dst_rows = jax.lax.dynamic_update_slice(
+                dst[:, slot], kv[:, 0], (0,) * (kv.ndim - 1))
+            return dst.at[:, slot].set(dst_rows)
         dst_row = jax.lax.dynamic_update_slice(
             dst[slot], kv[0], (0,) * kv[0].ndim)
         return dst.at[slot].set(dst_row)
@@ -243,11 +247,13 @@ def _splice_rows(cache: Any, row_cache: Any, slot: jnp.ndarray) -> Any:
     return jax.tree_util.tree_map_with_path(splice, cache)
 
 
-@partial(jax.jit, static_argnames=("prompt_len",), donate_argnums=(0, 1))
+@partial(jax.jit, static_argnames=("prompt_len", "stacked"),
+         donate_argnums=(0, 1))
 def _insert(tokens: jnp.ndarray, cache: Any, row_cache: Any,
             prompt: jnp.ndarray, first_tok: jnp.ndarray,
             true_len: jnp.ndarray, slot: jnp.ndarray,
-            prompt_len: int) -> tuple[jnp.ndarray, Any]:
+            prompt_len: int, stacked: bool = False
+            ) -> tuple[jnp.ndarray, Any]:
     """Splice a prefilled request into decode slot ``slot``: tokens[:P] =
     prompt, tokens[true_len] = first generated token, cache rows [:P] from
     the prefill. Cursors are NOT touched here — the server tracks them."""
@@ -256,14 +262,15 @@ def _insert(tokens: jnp.ndarray, cache: Any, row_cache: Any,
                                        (0,))
     row = row.at[true_len].set(first_tok)
     tokens = tokens.at[slot].set(row)
-    return tokens, _splice_rows(cache, row_cache, slot)
+    return tokens, _splice_rows(cache, row_cache, slot, stacked)
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def _insert_cache(cache: Any, row_cache: Any, slot: jnp.ndarray) -> Any:
+@partial(jax.jit, static_argnames=("stacked",), donate_argnums=(0,))
+def _insert_cache(cache: Any, row_cache: Any, slot: jnp.ndarray,
+                  stacked: bool = False) -> Any:
     """Cache-only splice (the draft model's prompt prefill — tokens were
     already written by the target's `_insert`)."""
-    return _splice_rows(cache, row_cache, slot)
+    return _splice_rows(cache, row_cache, slot, stacked)
 
 
 def _fill_cand(proposals: jnp.ndarray, bonus: jnp.ndarray,
@@ -490,6 +497,19 @@ class DecodeServer:
             raise ValueError(
                 "penalties are not supported on speculative pools "
                 "(count-dependent logits break the parallel verify)")
+        # scanned decode hot loop: every scan-compatible model (dense
+        # blocks — `models.transformer.scan_compatible`) is converted to
+        # the stacked layout here, INSIDE the server, so callers keep
+        # handing over canonical per-block params (checkpoints, the
+        # manager's rebuild-from-store path) while the compiled step runs
+        # the layer loop as one lax.scan. Quantization above ran first:
+        # stacking QTensors stacks q/scale independently and preserves
+        # the dequantized numerics. MoE pools keep the per-layer loop.
+        if scan_compatible(model) and not getattr(model, "scan_layers",
+                                                  False):
+            model = dataclasses.replace(model, scan_layers=True)
+            params = stack_block_params(params, model.depth)
+        self._scan = bool(getattr(model, "scan_layers", False))
         self.model = model
         self.params = params
         self.slots = slots
@@ -512,7 +532,12 @@ class DecodeServer:
         self.draft_len = draft_len
         self._draft_model = self._draft_params = None
         if draft is not None:
-            self._draft_model, self._draft_params = draft
+            dm, dp = draft
+            if scan_compatible(dm) and not getattr(dm, "scan_layers",
+                                                   False):
+                dm = dataclasses.replace(dm, scan_layers=True)
+                dp = stack_block_params(dp, dm.depth)
+            self._draft_model, self._draft_params = dm, dp
 
         # mesh sharding: the pool's slot dimension spreads over the mesh's
         # data axis (every per-row decode op is elementwise over slots, so
@@ -521,32 +546,43 @@ class DecodeServer:
         # its KV-cache HBM — across chips.
         self.mesh = mesh
         rows = None
+        stacked_rows = None
         if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
             from idunno_tpu.parallel.mesh import DATA_AXIS
             from idunno_tpu.parallel.sharding import (
-                batch_sharding, replicate)
+                batch_sharding, replicate, replicated_sharding)
             n_data = mesh.shape[DATA_AXIS]
             if slots % n_data:
                 raise ValueError(f"slots={slots} must divide over the "
                                  f"mesh data axis ({n_data})")
             rows = batch_sharding(mesh)
+            # scanned caches lead with DEPTH ([L, slots, ...]): the slot
+            # split moves one dim right, depth stays whole on every chip
+            stacked_rows = NamedSharding(mesh, PartitionSpec(None, DATA_AXIS))
             self.params = replicate(mesh, self.params)
 
-        def zeros(shape, dtype):
+        def zeros(shape, dtype, stacked=False):
             # allocate UNDER the sharding: materializing the full cache on
             # one device first would need the whole pool to fit one chip's
             # HBM, defeating the point of sharding the slot dimension
             if rows is None:
                 return jnp.zeros(shape, dtype)
+            if stacked:
+                sh = (stacked_rows if len(shape) >= 2
+                      else replicated_sharding(mesh))
+            else:
+                sh = rows
             return jax.jit(lambda: jnp.zeros(shape, dtype),
-                           out_shardings=rows)()
+                           out_shardings=sh)()
 
         # device state
         self._tokens = zeros((slots, max_len), jnp.int32)
         cache_shapes = jax.eval_shape(
             lambda: init_cache(self._dec_for_init(), slots, max_len))
-        self._cache = jax.tree.map(lambda s: zeros(s.shape, s.dtype),
-                                   cache_shapes)
+        self._cache = jax.tree.map(
+            lambda s: zeros(s.shape, s.dtype, stacked=self._scan),
+            cache_shapes)
         self._cursors = zeros((slots,), jnp.int32)
         self._remaining = zeros((slots,), jnp.int32)
         # host cache of (remaining, cursors), fetched as ONE stacked D2H
@@ -577,8 +613,11 @@ class DecodeServer:
             ddec = self._per_row_decode(self._draft_model)
             dshapes = jax.eval_shape(
                 lambda: init_cache(ddec, slots, max_len))
+            dstacked = bool(getattr(self._draft_model, "scan_layers",
+                                    False))
             self._draft_cache = jax.tree.map(
-                lambda s: zeros(s.shape, s.dtype), dshapes)
+                lambda s: zeros(s.shape, s.dtype, stacked=dstacked),
+                dshapes)
             if mesh is not None:
                 from idunno_tpu.parallel.sharding import replicate
                 self._draft_params = replicate(mesh, self._draft_params)
@@ -655,78 +694,22 @@ class DecodeServer:
             def body(_, carry):
                 (tokens, cache, cursors, remaining, keys, logprobs,
                  counts) = carry
-                active = remaining > 0
                 cache = _set_cursors(cache, cursors)
                 tok = jnp.take_along_axis(tokens, cursors[:, None], axis=1)
-                logits, mutated = dec.apply(
-                    {"params": params, "cache": cache}, tok,
-                    mutable=["cache"])
-                cache = mutated["cache"]
-                l = logits[:, 0]
-                if pen:   # counts cover this row's GENERATED tokens only
-                    l = (l - pres[:, None] * (counts > 0)
-                         - freq[:, None] * counts.astype(l.dtype))
-
-                # sampling machinery (per-row key split, temperature
-                # scale, log-softmax, gumbel draw) runs only when a LIVE
-                # row actually samples — an all-greedy pool (the common
-                # serving and bench case) skips the whole branch. Stream
-                # exactness: with any sampled live row the branch is the
-                # byte-identical math as always; without one, no row's
-                # output reads `drawn` (greedy picks argmax) and frozen
-                # keys are harmless (a retired sampled row never draws
-                # again; admission re-seeds the slot's key).
-                def draw_sampled():
-                    # per-row key advance + sampled pick (row streams stay
-                    # independent of co-resident rows and of admissions)
-                    split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
-                    scaled = l / jnp.maximum(temps, 1e-6)[:, None]
-                    # the full-vocab sort+cumsum only runs when some live
-                    # row actually asked for a filter; inside that branch
-                    # the PER-ROW select gives unfiltered rows the
-                    # identical plain log-softmax the other branch
-                    # computes, so no row's stream ever depends on its
-                    # co-residents (token-exact journal replay)
-                    sample_logits = jax.lax.cond(
-                        jnp.any((remaining > 0) & (temps > 0.0)
-                                & _filter_on(top_ps, top_ks)),
-                        lambda: _row_sample_logits(scaled, top_ps, top_ks),
-                        lambda: jax.nn.log_softmax(scaled, axis=-1))
-                    d = jax.vmap(jax.random.categorical)(
-                        split[:, 0], sample_logits).astype(jnp.int32)
-                    return d, split[:, 1]
-
-                drawn, keys = jax.lax.cond(
-                    jnp.any((remaining > 0) & (temps > 0.0)),
-                    draw_sampled,
-                    lambda: (jnp.zeros(tokens.shape[0], jnp.int32), keys))
-                nxt = jnp.where(temps > 0.0, drawn,
-                                jnp.argmax(l, axis=-1).astype(jnp.int32))
-                wpos = jnp.clip(cursors + 1, 0, self.max_len - 1)
-                old = jnp.take_along_axis(tokens, wpos[:, None], axis=1)[:, 0]
-                rows = jnp.arange(tokens.shape[0])
-                tokens = tokens.at[rows, wpos].set(
-                    jnp.where(active, nxt, old))
-                if track:
-                    # logprobs report the RAW model distribution even on
-                    # penalized rows (sampler-independent semantics)
-                    lp_all = jax.nn.log_softmax(
-                        logits[:, 0].astype(jnp.float32), axis=-1)
-                    lp = jnp.take_along_axis(
-                        lp_all, nxt[:, None], axis=1)[:, 0]
-                    lp_old = jnp.take_along_axis(
-                        logprobs, wpos[:, None], axis=1)[:, 0]
-                    logprobs = logprobs.at[rows, wpos].set(
-                        jnp.where(active, lp, lp_old))
-                cursors = jnp.where(active, cursors + 1, cursors)
-                new_remaining = remaining - 1
-                if self.eos_id is not None:        # static: traced once
-                    new_remaining = jnp.where(nxt == self.eos_id, 0,
-                                              new_remaining)
-                remaining = jnp.where(active, new_remaining, remaining)
-                if pen:
-                    counts = counts.at[rows, nxt].add(
-                        jnp.where(active, 1, 0))
+                # decode_apply: the scanned step (one lax.scan over the
+                # stacked layers) on scan-compatible pools, the flax
+                # per-layer loop otherwise
+                logits, cache = decode_apply(dec, params, cache, tok)
+                # the whole post-model tail — penalties, sampling pick,
+                # token/logprob scatter, cursor/remaining/EOS/count
+                # bookkeeping — is ONE fused helper (`ops.sampling.
+                # fused_decode_tail`), traced into this same jitted body
+                (tokens, cursors, remaining, keys, logprobs,
+                 counts) = fused_decode_tail(
+                    logits[:, 0], tokens, cursors, remaining, temps,
+                    top_ps, top_ks, keys, logprobs, pres, freq, counts,
+                    max_len=self.max_len, eos_id=self.eos_id,
+                    track=track, pen=pen)
                 return (tokens, cache, cursors, remaining, keys, logprobs,
                         counts)
 
@@ -816,10 +799,9 @@ class DecodeServer:
                     plumbing — only the sampling machinery around it is
                     branch-local."""
                     dcache = _set_cursors(dcache, dcur)
-                    logits, mutated = ddec.apply(
-                        {"params": dparams, "cache": dcache},
-                        tok[:, None], mutable=["cache"])
-                    return mutated["cache"], logits[:, 0].astype(
+                    logits, dcache = decode_apply(ddec, dparams, dcache,
+                                                  tok[:, None])
+                    return dcache, logits[:, 0].astype(
                         jnp.float32)                         # [S, V]
 
                 # -- 1. draft: gamma proposals (+ full distributions and
@@ -899,9 +881,7 @@ class DecodeServer:
                 # -- 2. target: verify the whole chunk in one apply ----------
                 cache = _set_cursors(cache, cursors)
                 tin = jnp.concatenate([prev[:, None], proposals], axis=1)
-                logits, mutated = dec.apply(
-                    {"params": params, "cache": cache}, tin, mutable=["cache"])
-                cache = mutated["cache"]
+                logits, cache = decode_apply(dec, params, cache, tin)
                 logits = logits.astype(jnp.float32)
                 tpred = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S,γ+1]
 
@@ -1132,6 +1112,7 @@ class DecodeServer:
             "kv_block_size": self.kv_block_size,
             "kv_cache_blocks": (self._block_pool.num_blocks
                                 if self._block_pool is not None else 0),
+            "scan_layers": self._scan,
         }
         out = dict(self._stats, live=len(self._live),
                    queued=len(self._queue), slots=self.slots,
@@ -1248,7 +1229,10 @@ class DecodeServer:
             if hit:
                 gathered = self._block_pool.gather(
                     [nd.block for nd in hit_chain])
-                pre = (concat_kv_prefix(self._prefix_cache, gathered)
+                # stacked caches carry the token axis at 2 (depth, batch,
+                # token, ...) instead of the per-block layout's 1
+                pre = (concat_kv_prefix(self._prefix_cache, gathered,
+                                        token_axis=2 if self._scan else 1)
                        if self.prefix else gathered)
                 row_cache, last_logits = _prefill_suffix(
                     self._prefill_model, self.params, pre,
@@ -1293,7 +1277,8 @@ class DecodeServer:
                                      jax.random.PRNGKey(seed), topp, topk)
             self._tokens, self._cache = _insert(
                 self._tokens, self._cache, row_cache, jnp.asarray(prompt),
-                first, jnp.int32(true_len), jnp.int32(slot), bucket)
+                first, jnp.int32(true_len), jnp.int32(slot), bucket,
+                stacked=self._scan)
             if self._draft_model is not None:
                 # the draft needs the FULL request prompt through ITS
                 # OWN weights (a radix hit only covers the target's
@@ -1314,8 +1299,10 @@ class DecodeServer:
                         self._draft_model, self._draft_params,
                         jnp.asarray(dsuffix), jnp.int32(suffix_true),
                         dbucket)
-                self._draft_cache = _insert_cache(self._draft_cache, drow,
-                                                  jnp.int32(slot))
+                self._draft_cache = _insert_cache(
+                    self._draft_cache, drow, jnp.int32(slot),
+                    stacked=bool(getattr(self._draft_model, "scan_layers",
+                                         False)))
             self._cursors = self._cursors.at[slot].set(true_len)
             self._temps = self._temps.at[slot].set(temp)
             self._top_ps = self._top_ps.at[slot].set(topp)
@@ -1433,3 +1420,35 @@ class DecodeServer:
             raise RuntimeError(f"not drained after {max_steps} steps")
         self._retire_finished()
         return self.poll()
+
+    def warmup(self) -> float:
+        """Pay the pool's one-time compiles (prefill at the smallest
+        bucket, insert, the decode dispatch) on a throwaway request BEFORE
+        serving traffic; returns the wall seconds spent. Afterwards the
+        host-visible accounting is reset so the warm-up is invisible:
+        request ids restart at 0 (seed streams default to the id — a
+        warmed pool draws the same streams as a cold one), stats and
+        prefix-cache counters re-zero. The first REAL request's
+        `Completion.service_s` then measures steady-state work, which is
+        what the fair-share scheduler's service signal needs (a one-time
+        compile is capacity planning, not per-request cost). Call only on
+        an idle pool (no queued or live requests). On radix pools the
+        warm chain stays cached unpinned — token-exact if ever hit, LRU-
+        evicted otherwise."""
+        if self._queue or self._live:
+            raise RuntimeError("warmup() needs an idle pool")
+        toks = [t % self.model.vocab for t in (1, 2, 3)][:self.prompt_len]
+        headroom = (self.draft_len + 1 if self._draft_model is not None
+                    else 0)
+        pl = len(self.prefix) if self.prefix else 0
+        max_new = max(1, min(self.decode_steps + 1,
+                             self.max_len - pl - len(toks) - headroom))
+        t0 = time.perf_counter()
+        self.submit(toks, max_new=max_new)
+        self.run_until_drained()
+        warm_s = time.perf_counter() - t0
+        self._next_id = 0
+        for k in self._stats:
+            self._stats[k] = 0
+        self._pc_lookups = self._pc_hits = self._pc_tokens_saved = 0
+        return warm_s

@@ -401,11 +401,22 @@ class TransformerLM(nn.Module):
     # "int8": quantized KV cache in decode mode (see MultiHeadAttention)
     kv_cache_dtype: str = "native"
     remat: bool = False
+    # scan_layers=True marks the SCANNED decode twin: params/cache leaves
+    # carry a leading depth axis and the layer loop is one `lax.scan`
+    # (`scanned_apply`). The flax module itself must never run in this
+    # mode — `decode_apply` is the only entry point; the unscanned module
+    # stays the canonical layout for init/checkpointing/training.
+    scan_layers: bool = False
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, tokens):
+        if self.scan_layers:
+            raise ValueError(
+                "scan_layers=True models hold depth-stacked params/cache "
+                "and cannot run through the flax per-layer loop; call "
+                "decode_apply (models.transformer) instead of .apply")
         if self.ffn_every < 1:
             raise ValueError(f"ffn_every={self.ffn_every}: must be >= 1")
         # remat: recompute each block's activations in the backward pass
@@ -433,3 +444,89 @@ class TransformerLM(nn.Module):
         logits = nn.Dense(self.vocab, dtype=self.dtype,
                           param_dtype=self.param_dtype, name="head")(x)
         return logits.astype(jnp.float32)
+
+
+# -- scanned decode: the layer loop as ONE lax.scan -------------------------
+#
+# The per-layer Python loop above emits `depth` separate fusion groups per
+# decode step; at serving dims each group is a handful of small ops, so the
+# step time is dominated by dispatch overhead rather than the HBM-bound
+# weight stream (TRACE_LM_DECODE.json: 1.98 ms measured vs ~1.03 ms bound).
+# Stacking every block's params/cache on a leading depth axis and scanning
+# `Block.apply` collapses the loop to one fused scan body. The scan body IS
+# `Block.apply` on one layer's slice — same module, same math, same order —
+# but XLA's scan-body fusion may move float rounding by ~1 ULP vs the
+# unrolled loop, so exactness is enforced STRUCTURALLY instead: serving and
+# `engine.generate` run the IDENTICAL scanned step, and every oracle test
+# (tests/test_serve_lm.py) pins the streams against each other.
+
+
+def scan_compatible(model: TransformerLM) -> bool:
+    """Whether a model's blocks are homogeneous enough to scan: every
+    block must run the same program on its own param/cache slice, which a
+    per-block ``ffn_factory`` (MoE interleaving) breaks — those models
+    keep the per-layer loop."""
+    return model.ffn_factory is None
+
+
+def stack_block_params(params, depth: int):
+    """Per-block params → the scanned layout: ``block0..block{L-1}``
+    subtrees are stacked leaf-wise onto a leading depth axis under
+    ``"blocks"``; embed/ln_f/head pass through. Works on quantized trees
+    too (QTensor is a pytree — q and scale stack independently, and
+    `ops.quantize.dequantize_tree`'s per-leaf broadcast is rank-agnostic,
+    so quantize-then-stack preserves the dequantized numerics)."""
+    blocks = [params[f"block{i}"] for i in range(depth)]
+    return {
+        "embed": params["embed"],
+        "blocks": jax.tree.map(lambda *xs: jnp.stack(xs), *blocks),
+        "ln_f": params["ln_f"],
+        "head": params["head"],
+    }
+
+
+def scanned_apply(model: TransformerLM, params, cache, tokens):
+    """One decode/prefill step of a ``scan_layers=True`` model: embed →
+    `lax.scan` of `Block.apply` over the depth-stacked (params, cache) →
+    final norm → logits. Returns ``(float32 logits, new cache)`` — the
+    same contract as ``model.apply(..., mutable=["cache"])`` unpacked,
+    with the cache's leading axis the layer index."""
+    blk = Block(model.dim, model.num_heads,
+                num_kv_heads=model.num_kv_heads,
+                causal=model.causal,
+                attn_fn=model.attn_fn,
+                ffn_factory=None,
+                decode=model.decode,
+                max_decode_len=model.max_decode_len,
+                decode_per_row=model.decode_per_row,
+                kv_cache_dtype=model.kv_cache_dtype,
+                dtype=model.dtype,
+                param_dtype=model.param_dtype)
+    x = nn.Embed(model.vocab, model.dim, dtype=model.dtype,
+                 param_dtype=model.param_dtype).apply(
+        {"params": params["embed"]}, tokens)
+
+    def body(h, layer):
+        p_l, c_l = layer
+        h, mut = blk.apply({"params": p_l, "cache": c_l}, h,
+                           mutable=["cache"])
+        return h, mut["cache"]
+
+    x, new_cache = jax.lax.scan(body, x, (params["blocks"], cache))
+    x = nn.LayerNorm(dtype=model.dtype, param_dtype=model.param_dtype
+                     ).apply({"params": params["ln_f"]}, x)
+    logits = nn.Dense(model.vocab, dtype=model.dtype,
+                      param_dtype=model.param_dtype).apply(
+        {"params": params["head"]}, x)
+    return logits.astype(jnp.float32), new_cache
+
+
+def decode_apply(model: TransformerLM, params, cache, tokens):
+    """THE decode-step entry point: dispatches on ``model.scan_layers``
+    so callers (`engine.serve_lm`, `engine.generate`) are layout-blind.
+    Returns ``(float32 logits, new cache)``."""
+    if getattr(model, "scan_layers", False):
+        return scanned_apply(model, params, cache, tokens)
+    logits, mut = model.apply({"params": params, "cache": cache}, tokens,
+                              mutable=["cache"])
+    return logits, mut["cache"]

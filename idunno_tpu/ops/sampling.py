@@ -77,3 +77,105 @@ def filtered_probs(scaled_logits: jnp.ndarray, top_p: jnp.ndarray,
     keep = keep_k & ((probs >= cutoff) | (top_p[..., None] >= 1.0))
     filt = jnp.where(keep, probs, 0.0)
     return filt / filt.sum(axis=-1, keepdims=True)
+
+
+def safe_log(probs: jnp.ndarray) -> jnp.ndarray:
+    """log with EXACT -inf outside the support — a filtered-out token
+    must have probability zero, not e^-69 (matches generate's -inf
+    nucleus masking)."""
+    return jnp.where(probs > 0.0, jnp.log(jnp.maximum(probs, 1e-38)),
+                     -jnp.inf)
+
+
+def filter_on(top_p: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
+    """Per-row: does this row ask for any sampling filter at all?"""
+    return (top_p < 1.0) | (top_k > 0)
+
+
+def row_sample_logits(scaled: jnp.ndarray, top_p: jnp.ndarray,
+                      top_k: jnp.ndarray) -> jnp.ndarray:
+    """Per-row sampling logits: top-k/nucleus-filtered for rows that ask
+    for a filter, plain log-softmax otherwise. The per-ROW select (not a
+    batch-level branch) keeps every row's formula a function of its own
+    request alone, so a journal replay without its former co-residents
+    redraws the SAME stream bit-for-bit."""
+    plain = jax.nn.log_softmax(scaled, axis=-1)
+    filtered = safe_log(filtered_probs(scaled, top_p, top_k))
+    return jnp.where(filter_on(top_p, top_k)[..., None], filtered, plain)
+
+
+def fused_decode_tail(l_raw: jnp.ndarray, tokens: jnp.ndarray,
+                      cursors: jnp.ndarray, remaining: jnp.ndarray,
+                      temps: jnp.ndarray, top_ps: jnp.ndarray,
+                      top_ks: jnp.ndarray, keys: jnp.ndarray,
+                      logprobs: jnp.ndarray, pres: jnp.ndarray,
+                      freq: jnp.ndarray, counts: jnp.ndarray, *,
+                      max_len: int, eos_id: int | None, track: bool,
+                      pen: bool) -> tuple:
+    """The post-model tail of one continuous-batching decode step, fused
+    into whatever jitted program calls it (`engine.serve_lm._build_decode`):
+    penalties → temperature/top-k/top-p pick → token/logprob scatter →
+    cursor/remaining/EOS bookkeeping → count update. ``l_raw`` is the raw
+    [S, vocab] model logits for the step; returns ``(tokens, cursors,
+    remaining, keys, logprobs, counts)``.
+
+    The sampling machinery (per-row key split, temperature scale,
+    log-softmax, gumbel draw) runs only when a LIVE row actually samples —
+    an all-greedy pool (the common serving and bench case) skips the whole
+    branch. Stream exactness: with any sampled live row the branch is the
+    byte-identical math as always; without one, no row's output reads
+    ``drawn`` (greedy picks argmax) and frozen keys are harmless (a
+    retired sampled row never draws again; admission re-seeds the slot's
+    key). ``track``/``pen``/``eos_id`` are compile-time flags — off means
+    zero traced ops for that feature."""
+    active = remaining > 0
+    l = l_raw
+    if pen:   # counts cover this row's GENERATED tokens only
+        l = (l - pres[:, None] * (counts > 0)
+             - freq[:, None] * counts.astype(l.dtype))
+
+    def draw_sampled():
+        # per-row key advance + sampled pick (row streams stay
+        # independent of co-resident rows and of admissions)
+        split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+        scaled = l / jnp.maximum(temps, 1e-6)[:, None]
+        # the full-vocab sort+cumsum only runs when some live row
+        # actually asked for a filter; inside that branch the PER-ROW
+        # select gives unfiltered rows the identical plain log-softmax
+        # the other branch computes, so no row's stream ever depends on
+        # its co-residents (token-exact journal replay)
+        sample_logits = jax.lax.cond(
+            jnp.any((remaining > 0) & (temps > 0.0)
+                    & filter_on(top_ps, top_ks)),
+            lambda: row_sample_logits(scaled, top_ps, top_ks),
+            lambda: jax.nn.log_softmax(scaled, axis=-1))
+        d = jax.vmap(jax.random.categorical)(
+            split[:, 0], sample_logits).astype(jnp.int32)
+        return d, split[:, 1]
+
+    drawn, keys = jax.lax.cond(
+        jnp.any((remaining > 0) & (temps > 0.0)),
+        draw_sampled,
+        lambda: (jnp.zeros(tokens.shape[0], jnp.int32), keys))
+    nxt = jnp.where(temps > 0.0, drawn,
+                    jnp.argmax(l, axis=-1).astype(jnp.int32))
+    wpos = jnp.clip(cursors + 1, 0, max_len - 1)
+    old = jnp.take_along_axis(tokens, wpos[:, None], axis=1)[:, 0]
+    rows = jnp.arange(tokens.shape[0])
+    tokens = tokens.at[rows, wpos].set(jnp.where(active, nxt, old))
+    if track:
+        # logprobs report the RAW model distribution even on penalized
+        # rows (sampler-independent semantics)
+        lp_all = jax.nn.log_softmax(l_raw.astype(jnp.float32), axis=-1)
+        lp = jnp.take_along_axis(lp_all, nxt[:, None], axis=1)[:, 0]
+        lp_old = jnp.take_along_axis(logprobs, wpos[:, None], axis=1)[:, 0]
+        logprobs = logprobs.at[rows, wpos].set(
+            jnp.where(active, lp, lp_old))
+    cursors = jnp.where(active, cursors + 1, cursors)
+    new_remaining = remaining - 1
+    if eos_id is not None:
+        new_remaining = jnp.where(nxt == eos_id, 0, new_remaining)
+    remaining = jnp.where(active, new_remaining, remaining)
+    if pen:
+        counts = counts.at[rows, nxt].add(jnp.where(active, 1, 0))
+    return tokens, cursors, remaining, keys, logprobs, counts

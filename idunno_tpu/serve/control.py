@@ -259,9 +259,10 @@ class ControlService:
                     # speculative decoding: the draft is another
                     # store-persisted LM (typically a much smaller one)
                     draft = load_lm(node.store, p["draft"])
+                from idunno_tpu.engine.serve_lm import DEFAULT_SLOTS
                 server = DecodeServer(
                     model, params,
-                    slots=int(p.get("slots", 4)),
+                    slots=int(p.get("slots", DEFAULT_SLOTS)),
                     prompt_len=int(p["prompt_len"]),
                     max_len=int(p["max_len"]),
                     decode_steps=int(p.get("decode_steps", 1)),
@@ -283,6 +284,13 @@ class ControlService:
                     # EMPTY tree — cold misses, never stale KV
                     kv_block_size=int(p.get("kv_block_size", 0)),
                     kv_cache_blocks=int(p.get("kv_cache_blocks", 0)))
+                if p.get("warmup"):
+                    # pay the pool's one-time compiles BEFORE the loop
+                    # accepts traffic and reset its accounting, so the
+                    # first real request's service_s (the fair-share
+                    # scheduler's signal, serve/metrics.py) measures
+                    # steady-state work, not a compile
+                    server.warmup()
                 loop = LMServingLoop(server, name=f"{node.host}-{name}")
             except BaseException:
                 with self._reg_lock:

@@ -48,6 +48,14 @@ from idunno_tpu.config import ClusterConfig
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.utils.types import MemberStatus, MessageType
 
+
+def _default_slots() -> int:
+    """The measured serving default (engine/serve_lm.DEFAULT_SLOTS),
+    imported lazily — the manager must stay importable without paying the
+    engine's jax import on nodes that never serve."""
+    from idunno_tpu.engine.serve_lm import DEFAULT_SLOTS
+    return DEFAULT_SLOTS
+
 CONTROL = "control"
 
 # request lifecycle: pending (not yet on any node) -> inflight (forwarded,
@@ -191,8 +199,8 @@ class LMPoolManager:
                      # heterogeneous fair share: (seconds from
                      # submit to completion, new tokens)
                      "svc_samples": [],
-                     "slots_now": int(spec.get("slots", 4)),
-                     "slots_cap": int(spec.get("slots", 4)),
+                     "slots_now": int(spec.get("slots", _default_slots())),
+                     "slots_cap": int(spec.get("slots", _default_slots())),
                      "slots_target_prev": None,
                      "t_last_resize": 0.0}
             self._pools[name] = entry
@@ -1085,10 +1093,12 @@ class LMPoolManager:
                     "node_errors": [],
                     "svc_samples": [tuple(s) for s
                                     in p.get("svc_samples", ())],
-                    "slots_now": int(p.get("slots_now",
-                                           p["spec"].get("slots", 4))),
-                    "slots_cap": int(p.get("slots_cap",
-                                           p["spec"].get("slots", 4))),
+                    "slots_now": int(p.get(
+                        "slots_now",
+                        p["spec"].get("slots", _default_slots()))),
+                    "slots_cap": int(p.get(
+                        "slots_cap",
+                        p["spec"].get("slots", _default_slots()))),
                     "slots_target_prev": None,
                     "t_last_resize": 0.0,
                     # defaults first: a snapshot from an older master may

@@ -130,6 +130,21 @@ class MetricsTracker:
             stddev=statistics.pstdev(vals) if len(vals) > 1 else 0.0,
             n=len(vals))
 
+    def reset_processing(self, model: str | None = None) -> None:
+        """Drop the windowed timing series for one model (or all): the
+        fair scheduler's `avg_query_time` signal must not carry one-time
+        compile cost, so a warm-up pass resets here and the first REAL
+        query starts the steady-state signal (the reference's 7/3 worked
+        example is a steady-state split). Finished-counters and LM gauges
+        survive — they are totals, not service-time signal."""
+        with self._lock:
+            if model is None:
+                self._proc.clear()
+                self._images.clear()
+            else:
+                self._proc.pop(model, None)
+                self._images.pop(model, None)
+
     def lm_gauges(self, pool: str) -> dict | None:
         with self._lock:
             g = self._lm_gauges.get(pool)

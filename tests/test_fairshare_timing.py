@@ -92,9 +92,13 @@ def test_second_job_start_latency(tmp_path):
             "reference_source": "mp4_report_group1.pdf p.2 (Fig 3), "
                                 "BASELINE.md rows 2-3",
         }
-        with open(os.path.join(REPO, "FAIRSHARE.json"), "w") as f:
-            json.dump(artifact, f, indent=2)
-            f.write("\n")
+        # every slow run re-times the same code path with scheduler/OS
+        # jitter, so an unconditional write churns the committed artifact
+        # without information: refresh only on explicit request
+        if os.environ.get("IDUNNO_WRITE_TIMING_ARTIFACTS"):
+            with open(os.path.join(REPO, "FAIRSHARE.json"), "w") as f:
+                json.dump(artifact, f, indent=2)
+                f.write("\n")
     finally:
         for n in nodes.values():
             n.stop()

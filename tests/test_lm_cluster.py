@@ -163,9 +163,12 @@ def test_pool_survives_node_kill_mid_stream(tmp_path):
                        "metadata_interval_s": cfg.metadata_interval_s},
             "token_exact": True,
         }
-        with open(os.path.join(REPO, "LM_RECOVERY.json"), "w") as f:
-            json.dump(artifact, f, indent=2)
-            f.write("\n")
+        # jittered wall-clock numbers: refresh the committed artifact only
+        # on explicit request (same gate as FAIRSHARE.json)
+        if os.environ.get("IDUNNO_WRITE_TIMING_ARTIFACTS"):
+            with open(os.path.join(REPO, "LM_RECOVERY.json"), "w") as f:
+                json.dump(artifact, f, indent=2)
+                f.write("\n")
     finally:
         for n in nodes.values():
             n.stop()

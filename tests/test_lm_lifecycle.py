@@ -86,20 +86,25 @@ def test_lm_served_through_cluster_control(stores, tmp_path):
 
     # penalized one-shot generation over RPC (ADVICE r4 low: the verb
     # used to silently drop the penalty fields): greedy + penalties is
-    # deterministic, so it must match the library call exactly
+    # deterministic, so it must match the library call exactly. max_new
+    # is 10 here, not 5: the penalty only bites once the greedy stream
+    # repeats a generated token, and this tiny model's first repeat
+    # lands past position 5 — 10 keeps the inequality check below real
     want_pen = generate(model, state.params, prompt, prompt_len=4,
-                        max_new=5, presence_penalty=1.5,
+                        max_new=10, presence_penalty=1.5,
                         frequency_penalty=0.5)
+    want_plain = generate(model, state.params, prompt, prompt_len=4,
+                          max_new=10)
     out_pen = ctl._handle("control", Message(
         MessageType.INFERENCE, "client",
         {"verb": "generate", "name": "tiny",
          "prompt": [[int(t) for t in row] for row in prompt],
-         "max_new": 5, "presence_penalty": 1.5,
+         "max_new": 10, "presence_penalty": 1.5,
          "frequency_penalty": 0.5}))
     assert out_pen.type is MessageType.ACK, out_pen.payload
     np.testing.assert_array_equal(np.asarray(out_pen.payload["tokens"]),
                                   np.asarray(want_pen))
-    assert not np.array_equal(np.asarray(want_pen), np.asarray(want))
+    assert not np.array_equal(np.asarray(want_pen), np.asarray(want_plain))
 
     # beam search over the same verb: matches the library call, scores
     # included; samplers are rejected (beam is a search, not a sampler)

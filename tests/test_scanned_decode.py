@@ -1,0 +1,134 @@
+"""Scanned fused decode (`models.transformer.scanned_apply` and friends).
+
+Token-exactness of the scanned serving pool is pinned against
+`engine.generate` in tests/test_serve_lm.py; this file holds the CPU-side
+structural proxies for the perf claim the real chip has to confirm:
+
+  - the jaxpr of one scanned decode step has a DEPTH-INVARIANT top-level
+    equation count (the layer loop collapsed into one `lax.scan` body),
+    strictly below the unscanned twin's, which grows linearly with depth
+    — the op-count analog of "one fusion group instead of `depth`";
+  - the stacked param layout round-trips quantized trees exactly;
+  - the slot-curve blessing rule (`utils/lm_bench.bless_slots`) picks the
+    knee, not the max.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idunno_tpu.engine.generate import decode_model, init_cache
+from idunno_tpu.models.transformer import (TransformerLM, decode_apply,
+                                           scan_compatible,
+                                           stack_block_params)
+from idunno_tpu.ops.quantize import dequantize_tree, quantize_tree
+
+VOCAB = 61
+
+
+def _twins(depth: int, max_len: int = 16):
+    """(unscanned decode twin, scanned decode twin, flat params)."""
+    model = TransformerLM(vocab=VOCAB, dim=32, depth=depth, num_heads=4)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    dec = decode_model(model, max_len)
+    dec_s = dataclasses.replace(dec, scan_layers=True)
+    return dec, dec_s, params
+
+
+def _step_jaxpr(m, params, batch: int = 2, max_len: int = 16):
+    cache = init_cache(m, batch, max_len)
+    tok = jnp.ones((batch, 1), jnp.int32)
+    return jax.make_jaxpr(
+        lambda p, c, t: decode_apply(m, p, c, t))(params, cache, tok)
+
+
+def _eqn_count(jaxpr) -> int:
+    return len(jaxpr.jaxpr.eqns)
+
+
+def test_scanned_step_op_count_depth_invariant_and_lower():
+    counts = {}
+    for depth in (2, 4):
+        dec, dec_s, params = _twins(depth)
+        stacked = stack_block_params(params, depth)
+        counts[depth] = {
+            "unscanned": _eqn_count(_step_jaxpr(dec, params)),
+            "scanned": _eqn_count(_step_jaxpr(dec_s, stacked)),
+        }
+    # the layer loop is gone: adding layers adds ROWS to the stacked
+    # operands, not equations to the program
+    assert counts[2]["scanned"] == counts[4]["scanned"]
+    assert counts[4]["unscanned"] > counts[2]["unscanned"]
+    assert counts[4]["scanned"] < counts[4]["unscanned"]
+
+
+def test_scanned_step_is_one_scan():
+    dec, dec_s, params = _twins(4)
+    jx = _step_jaxpr(dec_s, stack_block_params(params, 4))
+    prims = [e.primitive.name for e in jx.jaxpr.eqns]
+    assert prims.count("scan") == 1
+    # the unscanned twin's per-layer loop unrolls at trace time: no scan
+    jx_flat = _step_jaxpr(dec, params)
+    assert all(e.primitive.name != "scan" for e in jx_flat.jaxpr.eqns)
+
+
+def test_scanned_step_logits_close_to_unscanned():
+    """Same math, same order — only XLA's scan-body fusion may move
+    float rounding, so the two layouts agree to ~1 ULP, and every
+    behavioral surface (the token streams) is pinned EXACT against
+    `generate` in test_serve_lm.py."""
+    dec, dec_s, params = _twins(3)
+    cache_f = init_cache(dec, 2, 16)
+    cache_s = init_cache(dec_s, 2, 16)
+    tok = jnp.asarray([[5], [11]], jnp.int32)
+    lf, _ = decode_apply(dec, params, cache_f, tok)
+    ls, _ = decode_apply(dec_s, stack_block_params(params, 3), cache_s, tok)
+    np.testing.assert_allclose(np.asarray(lf), np.asarray(ls),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_scan_compatible_gates_moe():
+    from idunno_tpu.models.moe import MoETransformerLM
+    assert scan_compatible(TransformerLM(vocab=VOCAB, dim=32, depth=2,
+                                         num_heads=4))
+    assert not scan_compatible(MoETransformerLM(vocab=VOCAB, dim=32,
+                                                depth=2, num_heads=4,
+                                                n_experts=2))
+
+
+def test_scan_layers_model_rejects_flax_apply():
+    _, dec_s, params = _twins(2)
+    with pytest.raises(ValueError, match="decode_apply"):
+        dec_s.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+
+
+def test_stack_block_params_quantized_roundtrip():
+    """QTensor is a pytree: q and scale stack independently, and the
+    dequantized slice of the stacked tree must equal the dequantized
+    original block — quantize-then-stack loses nothing."""
+    depth = 3
+    model = TransformerLM(vocab=VOCAB, dim=32, depth=depth, num_heads=4)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    qp = quantize_tree(params)
+    dq_stack = dequantize_tree(stack_block_params(qp, depth)["blocks"])
+    for i in range(depth):
+        ref = dequantize_tree(qp[f"block{i}"])
+        got = jax.tree.map(lambda leaf: leaf[i], dq_stack)
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), ref, got)
+
+
+def test_bless_slots_picks_knee_not_max():
+    from idunno_tpu.utils.lm_bench import bless_slots
+    curve = [{"slots": 2, "tokens_per_s": 100.0},
+             {"slots": 4, "tokens_per_s": 150.0},
+             {"slots": 8, "tokens_per_s": 160.0}]
+    b = bless_slots(curve)
+    assert b["slots"] == 2                      # 100 >= 0.5 * 160
+    assert b["frac_of_max"] == pytest.approx(100 / 160, abs=1e-3)
+    assert bless_slots(curve, frac=0.9)["slots"] == 4   # 150 >= 144
+    assert bless_slots(curve, frac=0.99)["slots"] == 8  # only the max

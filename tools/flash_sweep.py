@@ -28,7 +28,15 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-BLOCKS = [(128, 128), (256, 256), (512, 512), (128, 512), (256, 1024)]
+# neighbors of the 2026-08-01 winner (256x1024) ride at the end so the
+# budget clamp cuts them before the established grid: the default must
+# sit in a measured local optimum, not at an unexplored grid edge
+BLOCKS = [(128, 128), (256, 256), (512, 512), (128, 512), (256, 1024),
+          (256, 512), (512, 1024), (512, 256)]
+# second sequence length (VERDICT next-7: a default resting on one shape
+# is a coincidence, not a tuning): the winner + its big-block neighbor +
+# the XLA baseline again at 4x4096
+LONGSEQ_BLOCKS = [(256, 1024), (512, 1024)]
 
 
 def main() -> int:
@@ -104,22 +112,25 @@ def main() -> int:
             except OSError:
                 pass
 
-    def record(label, attn_kw):
+    def record(label, attn_kw, toks_arr=None, dest=None):
+        toks_arr = toks if toks_arr is None else toks_arr
+        dest = out["variants"] if dest is None else dest
+        tl, bb, tt = toks_arr.shape
         try:
             attn = make_attn_fn(**attn_kw)
             m = TransformerLM(**base, attn_fn=attn)
-            sec, c_s = timed_prefill_dispatch(m, params, toks)
+            sec, c_s = timed_prefill_dispatch(m, params, toks_arr)
             row = {"variant": label,
-                   "tokens_per_s": round(tile * b * t / sec, 1),
+                   "tokens_per_s": round(tl * bb * tt / sec, 1),
                    "median_s": round(sec, 4), "compile_s": round(c_s, 2)}
             if peak:
                 flops_tok = prefill_flops_per_token(
-                    n_params, t, cfg["dim"], cfg["depth"])
+                    n_params, tt, cfg["dim"], cfg["depth"])
                 row["mfu"] = round(
-                    (tile * b * t / sec) * flops_tok / peak, 4)
+                    (tl * bb * tt / sec) * flops_tok / peak, 4)
         except Exception as e:  # noqa: BLE001
             row = {"variant": label, "error": f"{type(e).__name__}: {e}"}
-        out["variants"].append(row)
+        dest.append(row)
         flush()
         print(json.dumps(row), flush=True)
 
@@ -151,6 +162,44 @@ def main() -> int:
         if (ebq, ebk) != (bq, bk):
             label += f"_effective_{ebq}x{ebk}"
         record(label, kw)
+
+    # -- second sequence length: 4x4096 (the default must hold on more
+    # than the suite's native shape — long prompts are where flash's
+    # O(seq) memory actually bites). Rides AFTER the main grid so a
+    # short window still produces the decision-grade sweep above; the
+    # xla baseline is re-measured at this shape so the comparison stays
+    # per-shape honest.
+    b_long = 4
+    t_long = 4096 if platform == "tpu" else 2 * t
+    toks_long = jnp.asarray(np.random.default_rng(1).integers(
+        1, cfg["vocab"], size=(1, b_long, t_long)), jnp.int32)
+    ls: list = []
+    out["long_seq"] = {"batch": b_long, "seq": t_long, "scan_tile": 1,
+                       "variants": ls}
+    geom_long: set = set()
+    for label, bq, bk in [("xla_full", None, None)] + [
+            (f"flash_{bq}x{bk}", bq, bk) for bq, bk in LONGSEQ_BLOCKS]:
+        if time.perf_counter() - t_start > args.budget_s:
+            ls.append({"variant": label, "skipped": "time budget"})
+            flush()
+            continue
+        if bq is None:
+            record(label, {"kind": "full"}, toks_long, ls)
+            continue
+        ebq, ebk, _ = resolve_blocks(t_long, bq, bk)
+        if (ebq, ebk) in geom_long:
+            ls.append({"variant": label,
+                       "skipped": f"duplicate effective geometry "
+                                  f"{ebq}x{ebk}"})
+            flush()
+            continue
+        geom_long.add((ebq, ebk))
+        kw = {"kind": "flash", "block_q": bq, "block_k": bk}
+        if args.cpu:
+            kw["interpret"] = True
+        if (ebq, ebk) != (bq, bk):
+            label += f"_effective_{ebq}x{ebk}"
+        record(label, kw, toks_long, ls)
 
     ok = [v for v in out["variants"] if "tokens_per_s" in v]
     flash_ok = [v for v in ok if v["variant"].startswith("flash_")]

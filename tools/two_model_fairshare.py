@@ -120,6 +120,15 @@ def main() -> int:
         run_query(LIGHT)
         out[f"warm_{LIGHT}_s"] = round(time.time() - t0, 2)
 
+        # the warm queries above paid each model's one-time compile; their
+        # inflated per-query times must NOT feed the fair-share signal
+        # (the reference's 7/3 worked example is a steady-state split, and
+        # a compile-polluted avg buries it). Reset every node's timing
+        # window so the arbitration view below sees only steady queries.
+        for n in nodes.values():
+            n.inference.metrics.reset_processing()
+            n.inference.scheduler.avg_query_time = {}
+
         # -- job 1 stream alone: measured rate -----------------------------
         t0 = time.time()
         for _ in range(2):
