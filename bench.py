@@ -36,13 +36,17 @@ REFERENCE_IMAGES_PER_S = 400 / 9.0   # ≈44.4, whole reference cluster
 # default run also embeds a compact LM sub-record on TPU), "lm" (the full
 # LM-tier suite — prefill/decode tokens/sec, speculative + int8 points;
 # round-3 VERDICT weak #3: the LM half of the codebase needs its own
-# hardware number), or "train" (LM + CNN train-step throughput/MFU —
-# training is a beyond-parity capability and carries its own surface,
+# hardware number), "lm_gateway" (goodput vs offered load through the QoS
+# admission gateway, open-loop Poisson overload — serve/gateway.py), or
+# "train" (LM + CNN train-step throughput/MFU — training is a
+# beyond-parity capability and carries its own surface,
 # utils/train_bench.py).
 BENCH_SUITE = os.environ.get("BENCH_SUITE", "cnn")
-if BENCH_SUITE not in ("cnn", "lm", "lm_prefix", "lm_slots", "train"):
+if BENCH_SUITE not in ("cnn", "lm", "lm_prefix", "lm_slots", "lm_gateway",
+                       "train"):
     raise SystemExit(
-        f"BENCH_SUITE={BENCH_SUITE!r}: want cnn|lm|lm_prefix|lm_slots|train")
+        f"BENCH_SUITE={BENCH_SUITE!r}: want "
+        "cnn|lm|lm_prefix|lm_slots|lm_gateway|train")
 # BENCH_MODEL selects the measured network: resnet18 (headline, matches the
 # reference's "resnet"), resnet50 (bottleneck — ~4x the FLOPs/image, the
 # MXU-utilisation probe), alexnet (the other half of the reference's
@@ -61,6 +65,7 @@ METRIC = {"cnn": f"{BENCH_MODEL}_imagenet_inference_throughput",
           "lm": "lm_decode_throughput",
           "lm_prefix": "lm_prefix_cache_throughput",
           "lm_slots": "lm_slot_scaling_throughput",
+          "lm_gateway": "lm_gateway_goodput",
           "train": "lm_train_throughput"}[BENCH_SUITE]
 
 # The TPU sits behind a tunnel that is intermittently down; a successful TPU
@@ -74,6 +79,7 @@ _LAST_GOOD = os.path.join(
      else "BENCH_LAST_GOOD_lm.json" if BENCH_SUITE == "lm"
      else "BENCH_LAST_GOOD_lm_prefix.json" if BENCH_SUITE == "lm_prefix"
      else "BENCH_LAST_GOOD_lm_slots.json" if BENCH_SUITE == "lm_slots"
+     else "BENCH_LAST_GOOD_lm_gateway.json" if BENCH_SUITE == "lm_gateway"
      else "BENCH_LAST_GOOD_train.json" if BENCH_SUITE == "train"
      else f"BENCH_LAST_GOOD_{BENCH_MODEL}.json"))
 # the compact LM sub-record captured during a default cnn run caches here
@@ -737,6 +743,17 @@ def run_lm_slots_suite(devices) -> None:
                       "lm slot-scaling measurement failed", compact=False)
 
 
+def run_lm_gateway_suite(devices) -> None:
+    """BENCH_SUITE=lm_gateway: goodput vs offered load through the QoS
+    admission gateway — open-loop Poisson arrivals at 2x the pool's
+    measured capacity (headline: goodput tokens/sec of admitted
+    completions), with shed rate per class and the 0.5x underload
+    control in details."""
+    from idunno_tpu.utils.lm_bench import run_lm_gateway_bench
+    _run_record_suite(devices, run_lm_gateway_bench, "overload",
+                      "lm gateway measurement failed", compact=False)
+
+
 def run_train_suite(devices) -> None:
     """BENCH_SUITE=train: LM + CNN train-step throughput (trained
     tokens/sec; accum/fsdp/cnn points in details)."""
@@ -789,6 +806,8 @@ def main() -> None:
             run_lm_prefix_suite(devices)
         elif BENCH_SUITE == "lm_slots":
             run_lm_slots_suite(devices)
+        elif BENCH_SUITE == "lm_gateway":
+            run_lm_gateway_suite(devices)
         elif BENCH_SUITE == "train":
             run_train_suite(devices)
         else:

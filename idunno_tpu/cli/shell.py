@@ -66,15 +66,21 @@ HELP = """\
         place=1 = cluster-managed: master-placed, requests journaled to
         the standby, pool+requests recovered if its node dies)
   lm-submit <name> <max_new> [temperature= top_p= top_k=
-       presence_penalty= frequency_penalty= stop=1,2;9 seed=]
+       presence_penalty= frequency_penalty= stop=1,2;9 seed=
+       tenant= priority=interactive|batch deadline_ms=]
        <tok> [tok ...]
        queue a prompt -> request id (temperature 0=greedy, >0 sampled;
        top_p<1 = nucleus, top_k>0 = k most probable first; penalties
-       need a penalties=1 pool; stop = token sequences, ';'-separated)
+       need a penalties=1 pool; stop = token sequences, ';'-separated;
+       tenant/priority/deadline_ms need a gateway=1 pool — a shed
+       request errors here with its reason)
   lm-poll <name> | lm-stats <name> | lm-stop <name>
        fetch completions / occupancy+token counters / stop
   lm-cancel <name> <id>   best-effort cancel (live rows return partials)
-  lm-tail <name>          stream view: live rows' tokens so far"""
+  lm-tail <name>          stream view: live rows' tokens so far
+       (+ recent gateway sheds with reasons on gateway pools)
+  lm-qos <name>           gateway QoS: per-class queue depth,
+       admit/shed/expire counters, p50/p99 queue wait, per-tenant rows"""
 
 
 class Shell:
@@ -110,6 +116,7 @@ class Shell:
             "lm-stop": self.cmd_lm_stop,
             "lm-cancel": self.cmd_lm_cancel,
             "lm-tail": self.cmd_lm_tail,
+            "lm-qos": self.cmd_lm_qos,
         }
 
     # -- driver -----------------------------------------------------------
@@ -403,12 +410,16 @@ class Shell:
                     "[slots= decode_steps= quantize=int8 "
                     "kv_cache_dtype=int8 eos_id=N logprobs=1 penalties=1 "
                     "prefix=7,2,19 kv_block_size=N kv_cache_blocks=N "
-                    "draft=<lm> draft_len=N place=1 reload=1]\n"
+                    "draft=<lm> draft_len=N place=1 reload=1 "
+                    "gateway=1 quota=tenant:rate:burst:weight[;...] "
+                    "gw_queue=N]\n"
                     "note: draft (speculative) pools serve greedy "
                     "requests token-exact and sampled requests "
                     "distribution-exact (speculative sampling); "
                     "kv_block_size>0 enables the paged cross-request "
-                    "prefix cache (token-exact, block-aligned hits)")
+                    "prefix cache (token-exact, block-aligned hits); "
+                    "gateway=1 puts the QoS admission gateway in front "
+                    "(quota rate '-' = unlimited)")
         kv = self._kv(args[3:])
         payload = {k: int(kv.pop(k))
                    for k in ("slots", "decode_steps", "eos_id",
@@ -436,6 +447,25 @@ class Shell:
                                  for t in kv.pop("prefix").split(",") if t]
         if "reload" in kv:
             payload["reload"] = kv.pop("reload") not in ("0", "false", "")
+        gw: dict | None = None
+        if "gateway" in kv and kv.pop("gateway") not in ("0", "false", ""):
+            gw = {}
+        if "quota" in kv:   # quota=t1:5:10:2;t2:-:4:1  (rate '-'=unlimited)
+            gw = gw if gw is not None else {}
+            tenants = {}
+            for part in kv.pop("quota").split(";"):
+                if not part:
+                    continue
+                t, rate, burst, weight = part.split(":")
+                tenants[t] = {"rate": None if rate == "-" else float(rate),
+                              "burst": float(burst),
+                              "weight": float(weight)}
+            gw["tenants"] = tenants
+        if "gw_queue" in kv:
+            gw = gw if gw is not None else {}
+            gw["max_queue"] = int(kv.pop("gw_queue"))
+        if gw is not None:
+            payload["gateway"] = gw
         if kv:
             return f"unknown lm-serve option(s): {sorted(kv)}"
         out = self._control("lm_serve", name=args[0],
@@ -468,6 +498,12 @@ class Shell:
                                for seq in kv.pop("stop").split(";") if seq]
         if "seed" in kv:
             payload["seed"] = int(kv.pop("seed"))
+        if "tenant" in kv:
+            payload["tenant"] = kv.pop("tenant")
+        if "priority" in kv:
+            payload["priority"] = kv.pop("priority")
+        if "deadline_ms" in kv:
+            payload["deadline_ms"] = float(kv.pop("deadline_ms"))
         if kv:
             return f"unknown lm-submit option(s): {sorted(kv)}"
         out = self._control("lm_submit", name=args[0],
@@ -480,10 +516,16 @@ class Shell:
         out = self._control("lm_poll", name=args[0])
         rows = [f"#{c['id']}: {' '.join(str(t) for t in c['tokens'])} "
                 f"(prompt_len={c['prompt_len']}"
-                + (", CANCELLED" if c.get("cancelled") else "") + ")"
+                + (", CANCELLED" if c.get("cancelled") else "")
+                + (f", {c['rejected'].upper()}" if c.get("rejected")
+                   else "") + ")"
                 for c in out["completions"]]
         rows.extend(f"#{rid}: CANCELLED"
                     for rid in out.get("cancelled", []))
+        rows.extend(f"#{s['id']}: SHED ({s['reason']})"
+                    for s in out.get("shed", []))
+        rows.extend(f"#{rid}: EXPIRED"
+                    for rid in out.get("expired", []))
         rows.extend(f"ERROR: {e}" for e in out.get("errors", []))
         return "\n".join(rows) or "(no completions yet)"
 
@@ -501,6 +543,9 @@ class Shell:
         rows = [f"#{r['id']}: {' '.join(str(t) for t in r['tokens'])} "
                 f"({len(r['tokens']) - r['prompt_len']} generated)"
                 for r in out["partial"]]
+        rows.extend(f"shed: tenant={s['tenant']} {s['priority']} "
+                    f"[{s['reason']}] {s['detail']}"
+                    for s in out.get("sheds", []))
         if out.get("error"):
             rows.append(f"ERROR: {out['error']}")
         return "\n".join(rows) or "(no live rows)"
@@ -533,27 +578,83 @@ class Shell:
                     f"{pc['kv_blocks_used'] + pc['kv_blocks_free']} "
                     f"evictions={pc['evictions']}")
 
+        def gateway_line(stats: dict) -> str:
+            gw = stats.get("gateway")
+            if not gw:
+                return ""
+            parts = []
+            for cname, c in sorted(gw["classes"].items()):
+                w = c["queue_wait_s"]
+                parts.append(
+                    f"{cname}: q={c['queued']} "
+                    f"shed={sum(c['shed'].values())} "
+                    f"expired={c['expired']} "
+                    f"reject_rate={c['reject_rate']:.2f} "
+                    f"wait_p99={w['p99'] * 1000:.0f}ms")
+            return "\n  gateway: " + " | ".join(parts)
+
         if "journal" in s:              # cluster-managed pool
             j = s["journal"]
             head = (f"{args[0]}: node={s['node']} "
                     f"pending={j['pending']} inflight={j['inflight']} "
                     f"done={j['done']} failed={j['failed']}"
                     + (f" cancelled={j['cancelled']}"
-                       if j.get("cancelled") else ""))
+                       if j.get("cancelled") else "")
+                    + (f" shed={j['shed']}" if j.get("shed") else "")
+                    + (f" expired={j['expired']}"
+                       if j.get("expired") else ""))
             p = s.get("pool")
             if not p:
                 return head + f" (pool: {s.get('pool_error', 'n/a')})"
             return (head + f" | live={p['live']}/{p['slots']} "
                     f"completed={p['completed']} "
                     f"tokens_generated={p['tokens_generated']}"
-                    + config_line(p) + prefix_line(p))
+                    + config_line(p) + prefix_line(p) + gateway_line(p))
         return (f"{args[0]}: live={s['live']}/{s['slots']} "
                 f"queued={s['queued']} inbox={s['inbox']} "
                 f"unpolled={s['unpolled']} admitted={s['admitted']} "
                 f"completed={s['completed']} "
                 f"tokens_generated={s['tokens_generated']} "
                 f"dispatches={s['dispatches']}" + config_line(s)
-                + prefix_line(s))
+                + prefix_line(s) + gateway_line(s))
+
+    def cmd_lm_qos(self, args: list[str]) -> str:
+        if len(args) != 1:
+            return "usage: lm-qos <name>"
+        out = self._control("lm_qos", name=args[0])
+        rows = []
+        if "journal" in out:            # cluster-managed pool
+            j = out["journal"]
+            rows.append(f"{args[0]}: node={out['node']} journal: "
+                        f"done={j['done']} shed={j['shed']} "
+                        f"expired={j['expired']} "
+                        f"cancelled={j['cancelled']}")
+            if out.get("qos_error"):
+                rows.append(f"  (gateway: {out['qos_error']})")
+        q = out.get("qos")
+        if q is None:
+            rows.append(f"  (no gateway on {args[0]})")
+            return "\n".join(rows)
+        rows.append(f"  queued={q['queued']}/{q['max_queue']}")
+        for cname, c in sorted(q["classes"].items()):
+            w = c["queue_wait_s"]
+            sheds = " ".join(f"{r}={n}" for r, n in sorted(c["shed"].items())
+                             if n)
+            rows.append(
+                f"  {cname}: queued={c['queued']} admitted={c['admitted']} "
+                f"dispatched={c['dispatched']} expired={c['expired']}"
+                + (f" shed[{sheds}]" if sheds else "")
+                + f" reject_rate={c['reject_rate']:.2f}"
+                  f" wait_p50={w['p50'] * 1000:.0f}ms"
+                  f" wait_p99={w['p99'] * 1000:.0f}ms (n={w['n']})")
+        for t, c in sorted(q["tenants"].items()):
+            rate = "-" if c["rate"] is None else f"{c['rate']:g}"
+            rows.append(
+                f"  tenant {t}: queued={c['queued']} "
+                f"admitted={c['admitted']} dispatched={c['dispatched']} "
+                f"shed={c['shed']} expired={c['expired']} "
+                f"rate={rate} burst={c['burst']:g} weight={c['weight']:g}")
+        return "\n".join(rows)
 
     def cmd_lm_stop(self, args: list[str]) -> str:
         if len(args) != 1:

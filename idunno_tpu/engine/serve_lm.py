@@ -106,6 +106,10 @@ class Completion:
     # (aligned with tokens[prompt_len:]); None unless the pool was built
     # with track_logprobs=True
     logprobs: list[float] | None = None
+    # gateway rejection that completed the request without decoding
+    # ("expired": its deadline_ms passed while queued — tokens hold the
+    # prompt only); None for every request that reached a slot
+    rejected: str | None = None
 
 
 def _set_cursors(cache: Any, cursors: jnp.ndarray) -> Any:

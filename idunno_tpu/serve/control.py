@@ -17,6 +17,9 @@ remote CLI can run the shell's commands against any node:
   lm_serve/lm_submit/lm_poll/lm_stop
                              — continuous-batching decode pool per LM
                                (engine/serve_lm.py via serve/lm_pool.py)
+  lm_qos                     — QoS gateway observability (queue depths,
+                               admit/shed counters, queue-wait
+                               percentiles; serve/gateway.py)
   train_start/train_status/train_stop
                              — background cluster training jobs
                                (engine/train_job.py; checkpoints + servable
@@ -237,6 +240,12 @@ class ControlService:
                 raise ValueError(
                     f"kv_cache_dtype {p['kv_cache_dtype']!r}: "
                     "want native|int8")
+            gw_spec = p.get("gateway")
+            if gw_spec:
+                # same validate-before-registry rule: a bad gateway spec
+                # on a reload must not stop the live loop
+                from idunno_tpu.serve.gateway import AdmissionGateway
+                gw_spec = AdmissionGateway.validate_spec(gw_spec)
             placeholder = _Starting()
             with self._reg_lock:
                 old = self._lm_loops.get(name)
@@ -291,7 +300,14 @@ class ControlService:
                     # scheduler's signal, serve/metrics.py) measures
                     # steady-state work, not a compile
                     server.warmup()
-                loop = LMServingLoop(server, name=f"{node.host}-{name}")
+                gateway = None
+                if gw_spec is not None:
+                    # QoS front door (serve/gateway.py): per-tenant
+                    # quotas + priority/deadline queueing + shedding
+                    from idunno_tpu.serve.gateway import AdmissionGateway
+                    gateway = AdmissionGateway(gw_spec)
+                loop = LMServingLoop(server, name=f"{node.host}-{name}",
+                                     gateway=gateway)
             except BaseException:
                 with self._reg_lock:
                     if self._lm_loops.get(name) is placeholder:
@@ -314,7 +330,14 @@ class ControlService:
                 stop=([[int(t) for t in q] for q in p["stop"]]
                       if p.get("stop") else None),
                 seed=(int(p["seed"]) if p.get("seed") is not None
-                      else None))
+                      else None),
+                # QoS surface (serve/gateway.py): no-ops on pools without
+                # a gateway beyond priority validation
+                tenant=str(p.get("tenant", "default")),
+                priority=str(p.get("priority", "interactive")),
+                deadline_ms=(float(p["deadline_ms"])
+                             if p.get("deadline_ms") is not None else None),
+                readmit=bool(p.get("readmit")))
             return {"id": rid}
         if verb == "lm_poll":
             loop = self._lm_loop(p["name"])
@@ -322,6 +345,8 @@ class ControlService:
                 {"id": c.id, "tokens": c.tokens, "prompt_len": c.prompt_len,
                  "service_s": round(c.service_s, 6),
                  "cancelled": c.cancelled,
+                 **({"rejected": c.rejected}
+                    if c.rejected is not None else {}),
                  **({"logprobs": c.logprobs}
                     if c.logprobs is not None else {})}
                 for c in loop.poll()]}
@@ -338,7 +363,18 @@ class ControlService:
         if verb == "lm_partial":
             # streaming surface: progress of every live row WITHOUT
             # draining completions (lm_poll keeps that role)
-            return {"partial": self._lm_loop(p["name"]).snapshot()}
+            loop = self._lm_loop(p["name"])
+            out = {"partial": loop.snapshot()}
+            if loop.gateway is not None:
+                # recent gateway rejections with reasons, for lm-tail
+                out["sheds"] = loop.gateway.recent_sheds()
+            return out
+        if verb == "lm_qos":
+            # QoS observability: gateway queue depths, admit/shed/expire
+            # counters and per-class queue-wait percentiles (None when
+            # the pool runs without a gateway)
+            gw = self._lm_loop(p["name"]).gateway
+            return {"qos": gw.stats() if gw is not None else None}
         if verb == "lm_stats":
             stats = self._lm_loop(p["name"]).stats()
             pc = stats.get("prefix_cache")
@@ -346,6 +382,17 @@ class ControlService:
                 # surface the prefix-cache gauges on the node's C8
                 # metrics tracker so the cluster metrics plane sees them
                 node.metrics.record_lm_gauges(p["name"], pc)
+            gw = stats.get("gateway")
+            if gw is not None:
+                node.metrics.record_gateway_gauges(p["name"], {
+                    "queued": gw["queued"],
+                    **{f"{c}_{k}": cls[k]
+                       for c, cls in gw["classes"].items()
+                       for k in ("queued", "admitted", "dispatched",
+                                 "expired", "reject_rate")},
+                    **{f"{c}_wait_{q}": cls["queue_wait_s"][q]
+                       for c, cls in gw["classes"].items()
+                       for q in ("p50", "p99")}})
             return {"stats": stats}
         if verb == "lm_stop":
             with self._reg_lock:
@@ -437,7 +484,8 @@ class ControlService:
                     else mgr.train(p))
         name = p.get("name")
         if verb in ("lm_submit", "lm_poll", "lm_stats", "lm_stop",
-                    "lm_cancel", "lm_partial") and mgr.has_pool(name):
+                    "lm_cancel", "lm_partial", "lm_qos") \
+                and mgr.has_pool(name):
             if verb == "lm_submit":
                 rid = mgr.submit(name, [int(t) for t in p["prompt"]],
                                  int(p["max_new"]),
@@ -454,7 +502,13 @@ class ControlService:
                                      p.get("temperature", 0.0)),
                                  seed=(int(p["seed"])
                                        if p.get("seed") is not None
-                                       else None))
+                                       else None),
+                                 tenant=str(p.get("tenant", "default")),
+                                 priority=str(p.get("priority",
+                                                    "interactive")),
+                                 deadline_ms=(float(p["deadline_ms"])
+                                              if p.get("deadline_ms")
+                                              is not None else None))
                 return {"id": rid}
             if verb == "lm_poll":
                 return mgr.poll(name)
@@ -464,6 +518,8 @@ class ControlService:
                 return mgr.cancel(name, int(p["id"]))
             if verb == "lm_partial":
                 return mgr.partial(name)
+            if verb == "lm_qos":
+                return mgr.qos(name)
             return mgr.stop(name)
         if verb in ("train_status", "train_stop") and mgr.has_job(name):
             return (mgr.train_status(name) if verb == "train_status"

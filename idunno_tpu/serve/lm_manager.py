@@ -46,6 +46,7 @@ from idunno_tpu.comm.message import Message
 from idunno_tpu.comm.transport import Transport, TransportError
 from idunno_tpu.config import ClusterConfig
 from idunno_tpu.membership.service import MembershipService
+from idunno_tpu.serve.admission import PRIORITIES, shed_reason
 from idunno_tpu.utils.types import MemberStatus, MessageType
 
 
@@ -61,10 +62,13 @@ CONTROL = "control"
 # request lifecycle: pending (not yet on any node) -> inflight (forwarded,
 # node id known) -> done (tokens journaled). Recovery moves inflight back
 # to pending; done, failed (node rejected the request — permanent, e.g.
-# a validation error) and cancelled (client lm_cancel) are terminal —
-# recovery/resubmission must never replay a cancelled request.
+# a validation error), cancelled (client lm_cancel), shed (the pool's QoS
+# gateway rejected admission — serve/gateway.py) and expired (deadline_ms
+# passed while queued) are terminal — recovery/resubmission must never
+# replay a request the client was already told is out.
 _PENDING, _INFLIGHT, _DONE, _FAILED = "pending", "inflight", "done", "failed"
 _CANCELLED = "cancelled"
+_SHED, _EXPIRED = "shed", "expired"
 
 
 class LMPoolManager:
@@ -194,6 +198,7 @@ class LMPoolManager:
                      "next_rid": 0, "requests": {},
                      "done_total": 0, "failed_total": 0,
                      "cancelled_total": 0,
+                     "shed_total": 0, "expired_total": 0,
                      "node_errors": [],
                      # measured service samples feeding the
                      # heterogeneous fair share: (seconds from
@@ -250,11 +255,22 @@ class LMPoolManager:
                top_k: int = 0, presence_penalty: float = 0.0,
                frequency_penalty: float = 0.0,
                stop: list[list[int]] | None = None,
-               seed: int | None = None) -> int:
+               seed: int | None = None,
+               tenant: str = "default", priority: str = "interactive",
+               deadline_ms: float | None = None) -> int:
         """Journal a request (seed pinned NOW — replay after any failure
         must be token-exact even for sampled requests), then forward it to
         the pool's node. Forward failures leave it pending; the pump
-        retries/relocates."""
+        retries/relocates.
+
+        QoS fields travel with the journal entry: the pool node's gateway
+        decides admission at forward time, and a gateway shed comes back
+        as a terminal journal state (never replayed). ``deadline_ms``
+        bounds node-side queue wait measured from gateway admission — a
+        replay after node death re-admits with a fresh deadline window."""
+        if priority not in PRIORITIES:
+            raise ValueError(f"priority must be one of {PRIORITIES}, "
+                             f"got {priority!r}")
         with self._lock:
             pool = self._pools.get(name)
             if pool is None:
@@ -272,6 +288,14 @@ class LMPoolManager:
                    "stop": ([[int(t) for t in q] for q in stop]
                             if stop else None),
                    "seed": int(seed) if seed is not None else rid,
+                   "tenant": str(tenant), "priority": str(priority),
+                   "deadline_ms": (float(deadline_ms)
+                                   if deadline_ms is not None else None),
+                   # flipped on the FIRST successful forward: a replay of
+                   # an admitted request bypasses gateway admission
+                   # (readmit) — the client was told it was in, recovery
+                   # must not shed it
+                   "admitted": False,
                    "status": _PENDING, "node_id": None,
                    "tokens": None, "prompt_len": None, "delivered": False,
                    "t_forwarded": None, "attempts": 0,
@@ -294,7 +318,11 @@ class LMPoolManager:
                 "presence_penalty": req.get("presence_penalty", 0.0),
                 "frequency_penalty": req.get("frequency_penalty", 0.0),
                 "stop": req.get("stop"),
-                "seed": req["seed"]})
+                "seed": req["seed"],
+                "tenant": req.get("tenant", "default"),
+                "priority": req.get("priority", "interactive"),
+                "deadline_ms": req.get("deadline_ms"),
+                "readmit": bool(req.get("admitted"))})
         except (TransportError, OSError):
             return                      # stays pending; pump will retry
         except ValueError as e:
@@ -316,12 +344,23 @@ class LMPoolManager:
                     # autoscaling into user-visible request failures
                     pass
                 elif req2 is not None and req2["status"] == _PENDING:
-                    # the node REJECTED the request (validation) —
-                    # permanent; retrying would loop forever. Surface via
-                    # poll().
-                    req2["status"] = _FAILED
-                    req2["error"] = str(e)
-                    pool["failed_total"] += 1
+                    reason = shed_reason(str(e))
+                    if reason is not None:
+                        # the pool's QoS gateway shed it (quota /
+                        # queue_full / backpressure) — journal-terminal,
+                        # exactly like a cancel: recovery must never
+                        # resubmit a request the client was told is out
+                        req2["status"] = _SHED
+                        req2["shed_reason"] = reason
+                        req2["error"] = str(e)
+                        pool["shed_total"] += 1
+                    else:
+                        # the node REJECTED the request (validation) —
+                        # permanent; retrying would loop forever. Surface
+                        # via poll().
+                        req2["status"] = _FAILED
+                        req2["error"] = str(e)
+                        pool["failed_total"] += 1
             return
         cancel_on_node = False
         with self._lock:
@@ -336,6 +375,7 @@ class LMPoolManager:
                     req2["node_id"] = int(out["id"])
                     req2["t_forwarded"] = time.time()
                     req2["attempts"] += 1
+                    req2["admitted"] = True
                 elif status == _CANCELLED:
                     # cancel() raced this forward: it saw a pending
                     # request with no node mapping, so no node-side
@@ -368,6 +408,7 @@ class LMPoolManager:
                         if q["delivered"]]:
                 del pool["requests"][rid]
             out, errors, cancelled = [], [], []
+            shed, expired = [], []
             for rid, req in sorted(pool["requests"].items()):
                 if req["status"] == _DONE:
                     req["delivered"] = True
@@ -386,11 +427,22 @@ class LMPoolManager:
                 elif req["status"] == _CANCELLED:
                     req["delivered"] = True
                     cancelled.append(rid)
+                elif req["status"] == _SHED:
+                    req["delivered"] = True
+                    shed.append({"id": rid,
+                                 "reason": req.get("shed_reason", "?")})
+                elif req["status"] == _EXPIRED:
+                    req["delivered"] = True
+                    expired.append(rid)
         reply: dict[str, Any] = {"completions": out}
         if errors:
             reply["errors"] = errors
         if cancelled:
             reply["cancelled"] = cancelled
+        if shed:
+            reply["shed"] = shed
+        if expired:
+            reply["expired"] = expired
         return reply
 
     def cancel(self, name: str, rid: int) -> dict[str, Any]:
@@ -442,9 +494,14 @@ class LMPoolManager:
                              timeout=10.0)
         except (TransportError, ValueError, OSError) as e:
             return {"partial": [], "error": str(e)}
-        return {"partial": [dict(row, id=id_map[int(row["id"])])
-                            for row in out.get("partial", ())
-                            if int(row["id"]) in id_map]}
+        reply = {"partial": [dict(row, id=id_map[int(row["id"])])
+                             for row in out.get("partial", ())
+                             if int(row["id"]) in id_map]}
+        if out.get("sheds"):
+            # recent gateway rejections with reasons (tenant-keyed, not
+            # rid-keyed — a shed request never got a node id)
+            reply["sheds"] = out["sheds"]
+        return reply
 
     def stats(self, name: str) -> dict[str, Any]:
         with self._lock:
@@ -461,6 +518,8 @@ class LMPoolManager:
             counts[_DONE] = pool["done_total"]
             counts[_FAILED] = pool["failed_total"]
             counts[_CANCELLED] = pool["cancelled_total"]
+            counts[_SHED] = pool["shed_total"]
+            counts[_EXPIRED] = pool["expired_total"]
             node_errors = list(pool["node_errors"][-5:])
         out = {"node": node, "journal": counts}
         if node_errors:
@@ -471,6 +530,30 @@ class LMPoolManager:
                     node, {"verb": "lm_stats", "name": name})["stats"]
             except (TransportError, ValueError, OSError) as e:
                 out["pool_error"] = str(e)
+        return out
+
+    def qos(self, name: str) -> dict[str, Any]:
+        """QoS observability for a managed pool: journal-side terminal
+        counters plus the node gateway's live stats (None when the pool
+        runs without a gateway or its node is unreachable)."""
+        with self._lock:
+            pool = self._pools.get(name)
+            if pool is None:
+                raise ValueError(f"no managed pool {name!r}")
+            node = pool["node"]
+            out: dict[str, Any] = {
+                "node": node,
+                "journal": {"shed": pool["shed_total"],
+                            "expired": pool["expired_total"],
+                            "cancelled": pool["cancelled_total"],
+                            "done": pool["done_total"]}}
+        if node is not None:
+            try:
+                out["qos"] = self._call(
+                    node, {"verb": "lm_qos", "name": name},
+                    timeout=10.0)["qos"]
+            except (TransportError, ValueError, OSError) as e:
+                out["qos_error"] = str(e)
         return out
 
     def stop(self, name: str) -> dict[str, Any]:
@@ -898,6 +981,14 @@ class LMPoolManager:
                         req["node_id"] = None
                         pool["cancelled_total"] += 1
                         continue
+                    if c.get("rejected") == "expired":
+                        # the deadline passed in the gateway queue —
+                        # journal-terminal (never replayed), no service
+                        # sample: the request never reached a slot
+                        req["status"] = _EXPIRED
+                        req["node_id"] = None
+                        pool["expired_total"] += 1
+                        continue
                     req["status"] = _DONE
                     req["tokens"] = [int(t) for t in c["tokens"]]
                     req["prompt_len"] = int(c["prompt_len"])
@@ -1067,6 +1158,8 @@ class LMPoolManager:
                               "done_total": p["done_total"],
                               "failed_total": p["failed_total"],
                               "cancelled_total": p["cancelled_total"],
+                              "shed_total": p["shed_total"],
+                              "expired_total": p["expired_total"],
                               "svc_samples": [list(s) for s
                                               in p["svc_samples"]],
                               "slots_now": p["slots_now"],
@@ -1090,6 +1183,8 @@ class LMPoolManager:
                     "done_total": int(p.get("done_total", 0)),
                     "failed_total": int(p.get("failed_total", 0)),
                     "cancelled_total": int(p.get("cancelled_total", 0)),
+                    "shed_total": int(p.get("shed_total", 0)),
+                    "expired_total": int(p.get("expired_total", 0)),
                     "node_errors": [],
                     "svc_samples": [tuple(s) for s
                                     in p.get("svc_samples", ())],
@@ -1106,7 +1201,11 @@ class LMPoolManager:
                     "requests": {int(rid): {"t_forwarded": None,
                                             "attempts": 0, "top_p": 1.0,
                                             "top_k": 0,
-                                            "t_submitted": 0.0, **dict(r)}
+                                            "t_submitted": 0.0,
+                                            "tenant": "default",
+                                            "priority": "interactive",
+                                            "deadline_ms": None,
+                                            "admitted": False, **dict(r)}
                                  for rid, r in p["requests"].items()}}
                 for n, p in snap.get("pools", {}).items()}
             self._jobs = {

@@ -10,6 +10,14 @@ drains, completions accumulate in a host-side outbox polls swap out.
 The loop sleeps on an event while idle (no busy-spin — the reference's
 `monitor_query_rate` burns a core, `mp4_machinelearning.py:1016-1036`) and
 wakes on submit or stop.
+
+With a `serve/gateway.py:AdmissionGateway` attached, submissions go
+through admission (quota/backpressure sheds raise on the caller's
+thread) into the gateway's priority queues instead of the FIFO inbox;
+the loop thread pulls from the gateway with a dispatch budget that keeps
+the server-side queue shallow (~2 batches deep), so EDF/fair-queueing
+decisions are made as late as possible, and completes expired entries
+as ``rejected="expired"`` without ever decoding them.
 """
 from __future__ import annotations
 
@@ -17,14 +25,18 @@ import threading
 from typing import Any
 
 from idunno_tpu.engine.serve_lm import Completion, DecodeServer
+from idunno_tpu.serve.admission import PRIORITIES
+from idunno_tpu.serve.gateway import AdmissionGateway
 
 
 class LMServingLoop:
     """One background thread driving one DecodeServer; all public methods
     are safe to call from any thread."""
 
-    def __init__(self, server: DecodeServer, name: str = "lm") -> None:
+    def __init__(self, server: DecodeServer, name: str = "lm",
+                 gateway: AdmissionGateway | None = None) -> None:
         self.server = server
+        self.gateway = gateway
         self._lock = threading.Lock()
         # (id, toks, max_new, temperature, top_p, top_k, pres, freq,
         #  stop, seed)
@@ -54,14 +66,26 @@ class LMServingLoop:
                top_k: int = 0, presence_penalty: float = 0.0,
                frequency_penalty: float = 0.0,
                stop: list[list[int]] | None = None,
-               seed: int | None = None) -> int:
+               seed: int | None = None,
+               tenant: str = "default", priority: str = "interactive",
+               deadline_ms: float | None = None,
+               readmit: bool = False) -> int:
         """Validate + queue a prompt; returns the public request id.
         Raises once the pool is stopped — a submit racing `stop()` must
-        error loudly, not return an id that never completes."""
+        error loudly, not return an id that never completes.
+
+        On a gateway pool, admission runs here on the caller's thread:
+        an `AdmissionShed` (quota / queue_full / backpressure) raises
+        before any id is queued. ``readmit=True`` is the manager's replay
+        path — an already-admitted request being re-forwarded after node
+        death bypasses admission checks (but still queues by class/ft)."""
         # validate eagerly on the caller's thread so the RPC gets the error
         # (the loop thread has nowhere to raise to)
         self.server.validate(tokens, max_new, temperature, top_p, top_k,
                              presence_penalty, frequency_penalty, stop)
+        if priority not in PRIORITIES:
+            raise ValueError(f"priority must be one of {PRIORITIES}, "
+                             f"got {priority!r}")
         with self._lock:
             # checked under the lock: stop() sets the flag BEFORE its own
             # locked inbox drain, so an append here either precedes the
@@ -70,12 +94,38 @@ class LMServingLoop:
                 raise ValueError("serving pool is stopped")
             rid = self._next_id
             self._next_id += 1
-            self._inbox.append((rid, list(tokens), max_new,
-                                temperature, top_p, top_k,
-                                presence_penalty, frequency_penalty,
-                                stop, seed))
+            entry = (rid, list(tokens), max_new, temperature, top_p, top_k,
+                     presence_penalty, frequency_penalty, stop, seed)
+            if self.gateway is None:
+                self._inbox.append(entry)
+        if self.gateway is not None:
+            # outside self._lock: the gateway has its own lock, and a shed
+            # must not leave loop state half-mutated (rid gaps are fine)
+            self.gateway.admit(rid, entry, tenant=tenant, priority=priority,
+                               deadline_ms=deadline_ms,
+                               pool_gauges=self._pool_gauges(),
+                               readmit=readmit)
+            # a stop() racing in between admit and here has already drained
+            # the gateway; pull our entry back out and error like any other
+            # post-stop submit (cancel() returning None = stop drained it,
+            # in which case it was errored there)
+            if self._stop.is_set() and self.gateway.cancel(rid) is not None:
+                raise ValueError("serving pool is stopped")
         self._wake.set()
         return rid
+
+    def _pool_gauges(self) -> dict:
+        """Live occupancy snapshot for backpressure. Reads of the server's
+        containers from RPC threads are GIL-atomic len()s; the gateway adds
+        its own queue depth to ``waiting`` under its lock."""
+        srv = self.server
+        g = {"waiting": len(self._inbox) + len(srv._queue),
+             "live": len(srv._live), "slots": srv.slots}
+        bp = srv._block_pool
+        if bp is not None:
+            g["kv_blocks_free"] = bp.num_free
+            g["kv_blocks_total"] = bp.num_blocks
+        return g
 
     def poll(self) -> list[Completion]:
         """Completions since the last poll (public ids)."""
@@ -90,6 +140,17 @@ class LMServingLoop:
         thread at its next iteration and completes with whatever tokens it
         had. Returns False when the id is unknown — already completed (its
         tokens are in the outbox or were polled) or never submitted."""
+        if self.gateway is not None:
+            e = self.gateway.cancel(rid)
+            if e is not None:
+                full = (self.server.prefix or []) + list(e.payload[1])
+                with self._lock:
+                    self._outbox.append(Completion(
+                        id=rid, tokens=full,
+                        prompt_len=len(full), cancelled=True,
+                        logprobs=([] if self.server.track_logprobs
+                                  else None)))
+                return True
         with self._lock:
             for i, entry in enumerate(self._inbox):
                 if entry[0] == rid:
@@ -131,6 +192,8 @@ class LMServingLoop:
         with self._lock:
             out["inbox"] = len(self._inbox)
             out["unpolled"] = len(self._outbox)
+        if self.gateway is not None:
+            out["gateway"] = self.gateway.stats()
         return out
 
     def errors(self) -> list[str]:
@@ -145,6 +208,8 @@ class LMServingLoop:
         self._thread.join(timeout=timeout)
         with self._lock:          # fail anything the loop never drained
             dropped, self._inbox = self._inbox, []
+            if self.gateway is not None:
+                dropped = dropped + [e.payload for e in self.gateway.drain()]
             for entry in dropped:
                 if len(self._errors) < 100:
                     self._errors.append(
@@ -163,6 +228,33 @@ class LMServingLoop:
                                      frequency_penalty=freq, stop=stop,
                                      seed=rid if seed is None else seed)
             # under the lock: cancel() iterates this map from RPC threads
+            with self._lock:
+                self._id_map[sid] = rid
+
+    def _drain_gateway(self) -> None:
+        """Pull admitted work from the gateway under a dispatch budget
+        that keeps the server queue ~2 batches deep (dispatching later
+        keeps EDF/expiry decisions informed by the freshest deadlines),
+        and retire expired entries as rejected completions."""
+        if self.gateway is None:
+            return
+        budget = max(0, 2 * self.server.slots - self.server.pending())
+        ready, expired = self.gateway.take(budget)
+        for e in expired:
+            full = (self.server.prefix or []) + list(e.payload[1])
+            with self._lock:
+                self._outbox.append(Completion(
+                    id=e.rid, tokens=full, prompt_len=len(full),
+                    rejected="expired",
+                    logprobs=([] if self.server.track_logprobs else None)))
+        for e in ready:
+            (rid, tokens, max_new, temperature, top_p, top_k, pres,
+             freq, stop, seed) = e.payload
+            sid = self.server.submit(tokens, max_new,
+                                     temperature=temperature, top_p=top_p,
+                                     top_k=top_k, presence_penalty=pres,
+                                     frequency_penalty=freq, stop=stop,
+                                     seed=rid if seed is None else seed)
             with self._lock:
                 self._id_map[sid] = rid
 
@@ -193,6 +285,7 @@ class LMServingLoop:
             try:
                 self._drain_cancels()
                 self._drain_inbox()
+                self._drain_gateway()
                 live = self.server.step()
                 done = self.server.poll()
             except Exception as e:  # noqa: BLE001 - loop must stay alive

@@ -60,6 +60,10 @@ class MetricsTracker:
         # cached_tokens_saved, kv_blocks_free/used — serve/prefix_cache.py);
         # point-in-time values, not windowed series
         self._lm_gauges: dict[str, dict] = {}
+        # last-seen QoS gateway gauges per pool (per-class queue depth,
+        # reject rate, queue-wait p50/p99 — serve/gateway.py); the gateway
+        # keeps its own windows, these are the flattened readback
+        self._gw_gauges: dict[str, dict] = {}
 
     # -- recording --------------------------------------------------------
 
@@ -84,6 +88,12 @@ class MetricsTracker:
         `lm_gauges`)."""
         with self._lock:
             self._lm_gauges[pool] = dict(gauges)
+
+    def record_gateway_gauges(self, pool: str, gauges: dict) -> None:
+        """Latest QoS gateway gauges for ``pool`` (same overwrite-per-read
+        contract as `record_lm_gauges`; read back via `gateway_gauges`)."""
+        with self._lock:
+            self._gw_gauges[pool] = dict(gauges)
 
     # -- reading ----------------------------------------------------------
 
@@ -150,6 +160,11 @@ class MetricsTracker:
             g = self._lm_gauges.get(pool)
             return dict(g) if g is not None else None
 
+    def gateway_gauges(self, pool: str) -> dict | None:
+        with self._lock:
+            g = self._gw_gauges.get(pool)
+            return dict(g) if g is not None else None
+
     def avg_query_time(self, model: str) -> float:
         """Feed for the fair scheduler (`model_average_inference_time`,
         `:504-506`). 0.0 = no history yet."""
@@ -167,7 +182,9 @@ class MetricsTracker:
                     "images": {m: [list(x) for x in v]
                                for m, v in self._images.items()},
                     "lm_gauges": {m: dict(g) for m, g
-                                  in self._lm_gauges.items()}}
+                                  in self._lm_gauges.items()},
+                    "gw_gauges": {m: dict(g) for m, g
+                                  in self._gw_gauges.items()}}
 
     def load_wire(self, d: dict) -> None:
         with self._lock:
@@ -181,3 +198,5 @@ class MetricsTracker:
                             for m, v in d.get("images", {}).items()}
             self._lm_gauges = {m: dict(g) for m, g
                                in d.get("lm_gauges", {}).items()}
+            self._gw_gauges = {m: dict(g) for m, g
+                               in d.get("gw_gauges", {}).items()}

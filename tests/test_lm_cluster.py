@@ -270,3 +270,122 @@ def test_train_job_auto_resumes_on_node_death(tmp_path):
     finally:
         for n in nodes.values():
             n.stop()
+
+
+def test_gateway_pool_churn_replays_only_admitted(tmp_path):
+    """ISSUE 4: a gateway-fronted managed pool loses its node mid-load.
+    The journal holds admitted work plus three terminal rejections — a
+    deterministic quota shed (tenant rate=0, burst=2: exactly the first
+    two capped submits are in), an in-queue expiry, and a client cancel.
+    After kill -9, recovery must resubmit ONLY the admitted, non-shed,
+    non-expired, non-cancelled requests (token-exact), and the terminal
+    trio must never reach the replacement node."""
+    net = InProcNetwork()
+    cfg, nodes = _cluster(tmp_path, net)
+    try:
+        model, params = _tiny_lm(nodes["n0"].store)
+        master = nodes["n0"]
+
+        out = _call(master, {"verb": "lm_serve", "placement": "auto",
+                             "name": "klm", "slots": 2, "prompt_len": 4,
+                             "max_len": 16,
+                             "gateway": {
+                                 # backpressure must not fire in this
+                                 # test — only the quota shed is scripted
+                                 "interactive_wait_slack": 50.0,
+                                 "batch_wait_slack": 50.0,
+                                 "tenants": {"capped": {"rate": 0,
+                                                        "burst": 2}}}})
+        victim = out["node"]
+        assert victim == "n2", out
+
+        rng = np.random.default_rng(4)
+        want = {}
+
+        def submit(tenant="free", deadline_ms=None):
+            prompt = [int(t) for t in rng.integers(0, 32, size=4)]
+            p = {"verb": "lm_submit", "name": "klm", "prompt": prompt,
+                 "max_new": 6, "tenant": tenant}
+            if deadline_ms is not None:
+                p["deadline_ms"] = deadline_ms
+            rid = _call(master, p)["id"]
+            ref = generate(model, params, jnp.asarray([prompt], jnp.int32),
+                           prompt_len=4, max_new=6)
+            want[rid] = [int(t) for t in np.asarray(ref[0])]
+            return rid
+
+        for _ in range(4):
+            submit()                      # wave 1: admitted, unlimited
+        capped = [submit(tenant="capped") for _ in range(3)]
+        shed_rid = capped[2]              # burst=2: third is shed[quota]
+        want.pop(shed_rid)
+
+        # >= 4 admitted requests un-retired keeps the loop's dispatch
+        # budget (2*slots) at zero, so a 1 ms deadline expires in-queue
+        expired_rid = submit(deadline_ms=1.0)
+        want.pop(expired_rid)
+
+        cancel_rid = submit()
+        want.pop(cancel_rid)
+        out = _call(master, {"verb": "lm_cancel", "name": "klm",
+                             "id": cancel_rid})
+        assert out["cancelled"] is True
+
+        done, shed, expired, cancelled = {}, {}, set(), set()
+
+        def drain(node):
+            out = _call(node, {"verb": "lm_poll", "name": "klm"})
+            for c in out["completions"]:
+                done[c["id"]] = c["tokens"]
+            for s in out.get("shed", ()):
+                shed[s["id"]] = s["reason"]
+            expired.update(out.get("expired", ()))
+            cancelled.update(out.get("cancelled", ()))
+
+        # the terminal trio must be journaled (and delivered) BEFORE the
+        # kill: an expiry still riding the node's outbox at kill time
+        # would leave the request inflight and make recovery ambiguous
+        deadline = time.time() + 90.0
+        while time.time() < deadline and not (
+                shed_rid in shed and expired_rid in expired
+                and cancel_rid in cancelled):
+            drain(master)
+            time.sleep(0.05)
+        assert shed == {shed_rid: "quota"}, shed
+        assert expired == {expired_rid} and cancelled == {cancel_rid}
+
+        # wave 2 + immediate kill: these straddle the node death
+        for _ in range(2):
+            submit()
+        net.kill(victim)
+
+        deadline = time.time() + 120.0
+        while time.time() < deadline and len(done) < len(want):
+            drain(master)
+            time.sleep(0.05)
+        assert sorted(done) == sorted(want), \
+            f"done {sorted(done)} != admitted {sorted(want)}"
+        for rid, toks in want.items():
+            assert done[rid] == toks, f"request {rid} not exact"
+
+        st = _call(master, {"verb": "lm_stats", "name": "klm"})["stats"]
+        assert st["node"] in ("n0", "n1"), st
+        assert st["journal"]["done"] == len(want), st
+        assert st["journal"]["shed"] == 1, st
+        assert st["journal"]["expired"] == 1, st
+        assert st["journal"]["cancelled"] == 1, st
+
+        qos = _call(master, {"verb": "lm_qos", "name": "klm"})
+        assert qos["journal"] == {"shed": 1, "expired": 1,
+                                  "cancelled": 1, "done": len(want)}
+        gw = qos["qos"]
+        assert gw is not None, "replacement pool lost its gateway"
+        # the replacement node's gateway saw only replays (readmit) and
+        # post-kill forwards — never a shed or expiry
+        assert all(n == 0 for cls in gw["classes"].values()
+                   for n in cls["shed"].values()), gw
+        assert all(cls["expired"] == 0
+                   for cls in gw["classes"].values()), gw
+    finally:
+        for n in nodes.values():
+            n.stop()

@@ -69,6 +69,13 @@ STEPS = [
      {"BENCH_SUITE": "lm_prefix", "BENCH_TIME_BUDGET_S": "600"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_lm_prefix.json"),
+    # QoS admission gateway: open-loop Poisson overload at 2x measured
+    # capacity (serve/gateway.py) — goodput tokens/sec + shed rate per
+    # class on chip; 0.5x underload control rides in details
+    ("gateway_suite",
+     {"BENCH_SUITE": "lm_gateway", "BENCH_TIME_BUDGET_S": "600"},
+     [sys.executable, "bench.py"],
+     "BENCH_LAST_GOOD_lm_gateway.json"),
     ("headline_resnet18",
      {"BENCH_TIME_BUDGET_S": "600"},
      [sys.executable, "bench.py"],
@@ -167,7 +174,11 @@ FORCE_RECAPTURE = {"lm_suite", "lm_suite_refresh", "lm_slots",
                    "prefix_suite", "spec_trace", "two_model_fairshare",
                    # flash_sweep: the committed artifact predates the
                    # 256x512/512x1024/512x256 neighbors + 4x4096 long-seq
-                   "flash_sweep"}
+                   "flash_sweep",
+                   # train_suite: BENCH_LAST_GOOD_train.json provenance is
+                   # two rounds stale (round-5 VERDICT) — the committed
+                   # record predates the scanned-decode rework's tree
+                   "train_suite"}
 
 
 def log(msg: str) -> None:
