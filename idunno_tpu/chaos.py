@@ -1,0 +1,628 @@
+"""Seeded chaos harness: deterministic fault schedules over a full
+in-process cluster, with global safety invariants checked continuously.
+
+FoundationDB-style simulation testing (Zhou et al., SIGMOD 2021): one
+``random.Random(seed)`` drives BOTH the fault schedule (partitions,
+one-way cuts, probabilistic drop/dup/delay, kills) and the workload (CNN
+queries, managed LM submits, SDFS puts), so any failing schedule replays
+exactly from its seed. The reference has nothing like this — its failover
+was only ever exercised by hand-killing VMs (SURVEY.md §4), and its
+fencing-free design (`mp4_machinelearning.py:956-963`) cannot pass these
+invariants at all.
+
+Invariants (``ChaosCluster.check_invariants`` after ``converge``):
+- at most one acting master per epoch, ever (fence owners are recorded at
+  every step; two owners for one epoch number = split brain);
+- zero stale-epoch messages ACCEPTED anywhere (a transport-level probe
+  snapshots each receiver's fence before the handler runs: a stamped
+  payload below that high-water mark must produce an ERROR, never an ACK);
+- every CNN query acked by the surviving master lineage completes exactly
+  once — result set exact, no duplicate records;
+- every LM request admitted into the surviving journal reaches exactly one
+  terminal state, and no completion is delivered twice;
+- every SDFS put acked by the surviving lineage reads back exactly;
+- membership views converge after heal.
+
+The LM node tier is a deterministic stand-in (`ChaosControl`): tokens are
+a pure function of (prompt, seed), so replay token-exactness is checkable
+without a model — the real tier's epoch fencing and lm_submit idempotency
+semantics are mirrored verb-for-verb from `serve/control.py`.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from types import SimpleNamespace
+
+from idunno_tpu.comm.inproc import InProcNetwork
+from idunno_tpu.comm.message import Message
+from idunno_tpu.comm.retry import call_with_retry
+from idunno_tpu.comm.transport import TransportError
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.epoch import check_payload
+from idunno_tpu.membership.service import MembershipService
+from idunno_tpu.scheduler.fair import FairScheduler
+from idunno_tpu.serve.failover import FailoverManager
+from idunno_tpu.serve.inference_service import (InferenceService,
+                                                InferenceServiceError)
+from idunno_tpu.serve.lm_manager import LMPoolManager
+from idunno_tpu.serve.metrics import MetricsTracker
+from idunno_tpu.store.sdfs import FileStoreService, StoreError
+from idunno_tpu.utils.types import MessageType
+
+# services whose handlers are epoch-fenced; the membership service is
+# deliberately NOT probed — its gossip must accept any epoch stamp (that
+# is how a deposed coordinator learns it was deposed)
+PROBED_SERVICES = ("inference", "control", "store", "metadata")
+
+
+class ChaosClock:
+    """Fake wall clock shared by every node (tests/test_membership.py
+    idiom) so suspicion timeouts are schedule-driven, not real-time."""
+
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class ChaosEngine:
+    """Deterministic CNN engine stand-in: 10 ms/image, name-derived
+    records (same contract as the serving tests' FakeEngine)."""
+
+    def __init__(self, host: str, clock: ChaosClock) -> None:
+        self.host = host
+        self.clock = clock
+
+    def infer(self, name, start, end, dataset_root=None):
+        n = end - start + 1
+        self.clock.advance(0.01 * n)
+        return SimpleNamespace(
+            records=[(f"test_{i}.JPEG", f"class_{(i * 7) % 1000}", 0.9)
+                     for i in range(start, end + 1)],
+            elapsed_s=0.01 * n,
+            weights="pretrained")
+
+
+def lm_tokens(prompt: list[int], seed: int, max_new: int) -> list[int]:
+    """The fake decode function: pure in (prompt, seed), so a journaled
+    replay with the pinned seed is token-exact by construction."""
+    base = sum(prompt) % 50257
+    return list(prompt) + [(seed * 1000003 + i * 7919 + base) % 50257
+                           for i in range(max_new)]
+
+
+class ChaosControl:
+    """Per-host control-verb handler: cluster routing + a fake node-local
+    LM tier, mirroring `serve/control.py` (epoch fence at the top,
+    deposed-master refusal on managed verbs, per-name lm_submit
+    idempotency purged on rebuild/stop)."""
+
+    def __init__(self, host: str, membership: MembershipService,
+                 lm_manager: LMPoolManager) -> None:
+        self.host = host
+        self.membership = membership
+        self.mgr = lm_manager
+        self._loops: dict = {}     # name -> {"next", "done"}
+        self._lm_idem: dict = {}   # (name, key) -> node-local row id
+
+    def handle(self, service: str, msg: Message) -> Message:
+        stale = check_payload(self.membership.epoch, msg.payload, self.host)
+        if stale is not None:
+            return stale
+        try:
+            out = self._dispatch(msg.payload.get("verb", ""), msg.payload)
+            return Message(MessageType.ACK, self.host, out)
+        except Exception as e:  # noqa: BLE001 - RPC boundary
+            return Message(MessageType.ERROR, self.host,
+                           {"error": f"{type(e).__name__}: {e}"})
+
+    def _dispatch(self, verb: str, p: dict) -> dict:
+        mgr = self.mgr
+        if mgr is not None and not p.get("local"):
+            if p.get("placement") == "auto" and verb == "lm_serve":
+                if not self.membership.is_acting_master:
+                    raise ValueError("placement=auto must go to the "
+                                     "acting master")
+                return mgr.serve(p)
+            name = p.get("name")
+            if verb in ("lm_submit", "lm_poll", "lm_stats") \
+                    and mgr.has_pool(name):
+                if not self.membership.is_acting_master:
+                    raise ValueError(f"{self.host} is not the acting "
+                                     f"master; journal fenced")
+                if verb == "lm_submit":
+                    rid = mgr.submit(
+                        name, [int(t) for t in p["prompt"]],
+                        int(p["max_new"]),
+                        seed=(int(p["seed"])
+                              if p.get("seed") is not None else None),
+                        idem_key=p.get("idem"))
+                    return {"id": rid}
+                if verb == "lm_poll":
+                    return mgr.poll(name)
+                return {"stats": mgr.stats(name)}
+        # -- node-local fake LM tier --
+        if verb == "lm_serve":
+            name = p["name"]
+            if name in self._loops and not p.get("reload"):
+                return {"already": True}
+            self._loops[name] = {"next": 0, "done": []}
+            for k in [k for k in self._lm_idem if k[0] == name]:
+                del self._lm_idem[k]
+            return {"slots": int(p.get("slots", 4))}
+        if verb == "lm_submit":
+            name = p["name"]
+            if name not in self._loops:
+                raise ValueError(f"no lm_serve pool for {name!r}; "
+                                 "call lm_serve first")
+            key = p.get("idem")
+            if key is not None and (name, key) in self._lm_idem:
+                return {"id": self._lm_idem[(name, key)],
+                        "duplicate": True}
+            loop = self._loops[name]
+            rid = loop["next"]
+            loop["next"] += 1
+            prompt = [int(t) for t in p["prompt"]]
+            toks = lm_tokens(prompt, int(p.get("seed") or 0),
+                             int(p["max_new"]))
+            loop["done"].append({"id": rid, "tokens": toks,
+                                 "prompt_len": len(prompt),
+                                 "service_s": 0.001})
+            if key is not None:
+                self._lm_idem[(name, key)] = rid
+            return {"id": rid}
+        if verb == "lm_poll":
+            name = p["name"]
+            if name not in self._loops:
+                raise ValueError(f"no lm_serve pool for {name!r}; "
+                                 "call lm_serve first")
+            done, self._loops[name]["done"] = self._loops[name]["done"], []
+            return {"completions": done}
+        if verb == "lm_stop":
+            self._loops.pop(p["name"], None)
+            for k in [k for k in self._lm_idem if k[0] == p["name"]]:
+                del self._lm_idem[k]
+            return {"stopped": True}
+        raise ValueError(f"unknown control verb {verb!r}")
+
+
+class ChaosCluster:
+    """A 5-host in-process cluster (coordinator n0, standby n1) with every
+    control-plane layer wired the way `serve/node.py` wires it, a seeded
+    fault/workload schedule, and invariant recording."""
+
+    LM_POOL = "chaos-lm"
+
+    def __init__(self, seed: int, data_dir: str, n_hosts: int = 5) -> None:
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.cfg = ClusterConfig(
+            hosts=tuple(f"n{i}" for i in range(n_hosts)),
+            coordinator="n0", standby_coordinator="n1", introducer="n0",
+            query_batch_size=100, query_interval_s=0.0,
+            straggler_timeout_s=4.0, rpc_retry_deadline_s=0.5)
+        self.net = InProcNetwork(seed=seed)
+        self.clock = ChaosClock()
+        self.members: dict[str, MembershipService] = {}
+        self.services: dict[str, InferenceService] = {}
+        self.stores: dict[str, FileStoreService] = {}
+        self.failovers: dict[str, FailoverManager] = {}
+        self.managers: dict[str, LMPoolManager] = {}
+        self.controls: dict[str, ChaosControl] = {}
+        for h in self.cfg.hosts:
+            t = self.net.transport(h)
+            self.members[h] = MembershipService(h, self.cfg, t,
+                                                clock=self.clock)
+            self.services[h] = InferenceService(
+                h, self.cfg, t, self.members[h],
+                ChaosEngine(h, self.clock),
+                metrics=MetricsTracker(clock=self.clock),
+                scheduler=FairScheduler(self.cfg,
+                                        rng=random.Random(seed),
+                                        clock=self.clock),
+                clock=self.clock)
+            self.stores[h] = FileStoreService(
+                h, self.cfg, t, self.members[h],
+                os.path.join(data_dir, h))
+            mgr = LMPoolManager(h, self.cfg, t, self.members[h],
+                                inference_service=self.services[h])
+            # the fake tier completes instantly: shrink the watchdog so a
+            # poll reply lost to chaos re-forwards within the convergence
+            # loop instead of after the production 120 s allowance
+            mgr.request_timeout_s = 0.2
+            mgr.build_rpc_timeout_s = 0.5
+            self.managers[h] = mgr
+            self.failovers[h] = FailoverManager(
+                h, self.cfg, t, self.members[h], self.services[h],
+                lm_manager=mgr)
+            self.services[h].wal_hook = self.failovers[h].wal_append
+            self.controls[h] = ChaosControl(h, self.members[h], mgr)
+            t.serve("control", self.controls[h].handle)
+        # invariant recorders
+        self.violations: list[str] = []
+        self.epoch_owners: dict[int, set[str]] = {}
+        self.acting_by_epoch: dict[int, set[str]] = {}
+        self._wrap_probes()
+        # workload ledgers
+        self._serial = 0
+        self.cnn_acked: list[tuple[str, int, int, int]] = []  # model,q,lo,hi
+        self.lm_acked: list[dict] = []       # {serial, prompt, seed, max_new}
+        # every ATTEMPTED lm submit, acked or not: a submit whose ACK was
+        # lost may still have been journaled (the classic "maybe" outcome)
+        # and legitimately completes — but tokens from a request nobody
+        # ever attempted would mean cross-wired journals
+        self.lm_attempted: list[dict] = []
+        self.sdfs_acked: list[tuple[str, int, bytes]] = []
+        self.lm_delivered: dict[tuple, int] = {}   # token tuple -> count
+        for h in self.cfg.hosts:
+            self.members[h].join()
+            self.clock.advance(0.01)
+        self.pump_membership(waves=3)
+        # one managed decode pool up-front; its journal rides failover
+        out = self._client_control("n2", {
+            "verb": "lm_serve", "placement": "auto", "name": self.LM_POOL,
+            "prompt_len": 8, "max_len": 64, "slots": 4})
+        assert out.get("node") or out.get("already"), out
+
+    # -- probes -----------------------------------------------------------
+
+    def _wrap_probes(self) -> None:
+        for h in self.cfg.hosts:
+            t = self.net._nodes[h]
+            fence = self.members[h].epoch
+            for svc in PROBED_SERVICES:
+                handler = t._handlers.get(svc)
+                if handler is None:
+                    continue
+                t._handlers[svc] = self._probe(h, svc, fence, handler)
+
+    def _probe(self, host, svc, fence, handler):
+        def wrapped(service, msg):
+            pre = fence.current()     # BEFORE the handler can observe
+            out = handler(service, msg)
+            ep = (msg.payload or {}).get("epoch")
+            if (ep and int(ep[0]) < pre and out is not None
+                    and out.type is not MessageType.ERROR):
+                self.violations.append(
+                    f"{host}/{svc} ACKed stale epoch {ep[0]} < {pre}")
+            return out
+        return wrapped
+
+    def record_fences(self) -> None:
+        """Sample every node's fence view: two owners for one epoch — or
+        two nodes acting as master under one epoch — is split brain."""
+        for h in self.cfg.hosts:
+            e, owner = self.members[h].epoch.view()
+            if owner is not None:
+                self.epoch_owners.setdefault(e, set()).add(owner)
+            if self.members[h].is_acting_master:
+                self.acting_by_epoch.setdefault(
+                    self.members[h].epoch.current(), set()).add(h)
+
+    # -- client helpers (route like real clients: chain + retry) ----------
+
+    def _client_control(self, client: str, payload: dict,
+                        idem: str | None = None) -> dict:
+        if idem is not None:
+            payload = dict(payload, idem=idem)
+        t = self.net._nodes[client]
+        targets = [self.members[client].acting_master()]
+        for x in (self.cfg.coordinator, self.cfg.standby_coordinator):
+            if x not in targets:
+                targets.append(x)
+        last = None
+        for target in targets:
+            try:
+                out = call_with_retry(
+                    lambda target=target: t.call(
+                        target, "control",
+                        Message(MessageType.INFERENCE, client, payload)),
+                    attempts=2, base_s=0.0, cap_s=0.0, deadline_s=0.2,
+                    sleep=lambda s: None)
+            except TransportError as e:
+                last = e
+                continue
+            if out is None:
+                continue
+            err = out.payload.get("error", "")
+            if out.type is MessageType.ERROR:
+                if ("acting master" in err or "fenced" in err
+                        or out.payload.get("stale_epoch")):
+                    last = err
+                    continue
+                raise RuntimeError(err)
+            return out.payload
+        raise TransportError(f"no master reachable: {last}")
+
+    # -- workload ops -----------------------------------------------------
+
+    def op_cnn(self, client: str) -> None:
+        self._serial += 1
+        model = f"m{self._serial}"        # one model per logical query:
+        lo = self._serial * 100           # ack/result matching is exact
+        hi = lo + 19                      # even across deposed lineages
+        try:
+            q = self.services[client].submit_query(model, lo, hi)
+        except (InferenceServiceError, TransportError, StoreError):
+            return                        # no master reachable — lost, fine
+        self.cnn_acked.append((model, q, lo, hi))
+
+    def op_lm(self, client: str) -> None:
+        self._serial += 1
+        s = self._serial
+        prompt = [s % 251, (s * 7) % 251, (s * 13) % 251]
+        self.lm_attempted.append({"serial": s, "prompt": prompt,
+                                  "seed": s, "max_new": 4})
+        try:
+            out = self._client_control(
+                client, {"verb": "lm_submit", "name": self.LM_POOL,
+                         "prompt": prompt, "max_new": 4, "seed": s},
+                idem=f"{client}:{s}")
+        except (TransportError, RuntimeError):
+            return
+        self.lm_acked.append({"serial": s, "rid": int(out["id"]),
+                              "prompt": prompt, "seed": s, "max_new": 4})
+
+    def op_sdfs(self, client: str) -> None:
+        self._serial += 1
+        name = f"f{self._serial}"
+        blob = f"blob-{self.seed}-{self._serial}".encode()
+        try:
+            v = self.stores[client].put_bytes(name, blob)
+        except (StoreError, TransportError):
+            return
+        self.sdfs_acked.append((name, v, blob))
+
+    # -- fault ops --------------------------------------------------------
+
+    def op_partition(self) -> None:
+        a, b = self.rng.sample(self.cfg.hosts, 2)
+        self.net.partition(a, b)
+
+    def op_isolate(self, host: str | None = None) -> None:
+        h = host or self.rng.choice(self.cfg.hosts)
+        for x in self.cfg.hosts:
+            if x != h:
+                self.net.partition(h, x)
+
+    def op_oneway(self) -> None:
+        a, b = self.rng.sample(self.cfg.hosts, 2)
+        self.net.cut_oneway(a, b)
+
+    def op_heal(self) -> None:
+        self.net.heal_all()
+
+    # -- pumping ----------------------------------------------------------
+
+    def pump_membership(self, waves: int = 1, dt: float = 0.3) -> None:
+        for _ in range(waves):
+            for m in self.members.values():
+                m.ping_once()
+            self.clock.advance(dt)
+            for m in self.members.values():
+                m.monitor_once()
+
+    def pump_work(self) -> None:
+        for h in self.cfg.hosts:
+            self.services[h].process_jobs_once()
+        for h in self.cfg.hosts:
+            if self.members[h].is_acting_master:
+                self.services[h].monitor_stragglers_once()
+                self.managers[h].pump_once()
+                self.failovers[h].replicate_once()
+
+    def step(self) -> None:
+        """One seeded schedule step: a workload or fault op, then a pump
+        wave, then fence sampling."""
+        r = self.rng.random()
+        client = self.rng.choice(self.cfg.hosts)
+        if r < 0.22:
+            self.op_cnn(client)
+        elif r < 0.44:
+            self.op_lm(client)
+        elif r < 0.58:
+            self.op_sdfs(client)
+        elif r < 0.68:
+            self.op_partition()
+        elif r < 0.74:
+            self.op_oneway()
+        elif r < 0.80:
+            self.op_isolate()
+        elif r < 0.90:
+            self.op_heal()
+        # else: pure pump step
+        self.pump_membership(waves=1)
+        self.pump_work()
+        self.record_fences()
+
+    def run_schedule(self, steps: int = 40,
+                     chaos: dict | None = None) -> None:
+        if chaos:
+            self.net.set_chaos(**chaos)
+        for _ in range(steps):
+            self.step()
+
+    # -- convergence ------------------------------------------------------
+
+    def final_master(self) -> str:
+        acting = [h for h in self.cfg.hosts
+                  if self.members[h].is_acting_master]
+        assert len(acting) == 1, f"no unique acting master: {acting}"
+        return acting[0]
+
+    def converge(self, deadline_s: float = 20.0) -> float:
+        """Heal everything and pump until all surviving work is terminal.
+        Returns wall-clock seconds spent converging."""
+        t0 = time.monotonic()
+        self.net.heal_all()
+        self.net.clear_chaos()
+        self.net.flush_held()
+        for h in self.cfg.hosts:
+            self.net.revive(h)
+        while True:
+            self.pump_membership(waves=2)
+            self.pump_work()
+            for h in self.cfg.hosts:
+                self.services[h].join_reassign_dispatch(timeout=1.0)
+                self.stores[h].join_repair(timeout=1.0)
+            self.record_fences()
+            if self._settled():
+                return time.monotonic() - t0
+            if time.monotonic() - t0 > deadline_s:
+                raise AssertionError(
+                    f"seed {self.seed}: no convergence in {deadline_s}s: "
+                    f"{self._unsettled()}")
+            time.sleep(0.02)    # real time for the lm watchdog / threads
+
+    def _surviving_cnn(self):
+        """Acked queries present in the final master's journal lineage
+        (a doomed minority-master ack books a model name the surviving
+        journal never saw — a lost ack, the shape client idempotent
+        retries exist for). Keyed on the BOOKING: results alone can leak
+        into the survivor from workers finishing a deposed master's
+        dispatches (`_handle_result` observes, never rejects), and such a
+        query has no tasks to ever flip query_done."""
+        m = self.services[self.final_master()]
+        return [(model, q, lo, hi) for model, q, lo, hi in self.cnn_acked
+                if m.scheduler.book.tasks_for_query(model, q)]
+
+    def _surviving_lm(self):
+        mgr = self.managers[self.final_master()]
+        with mgr._lock:
+            pool = mgr._pools.get(self.LM_POOL)
+            rids = set(pool["requests"]) if pool else set()
+            done = pool["done_total"] if pool else 0
+        return rids, done
+
+    def _unsettled(self) -> list[str]:
+        out = []
+        m = self.services[self.final_master()]
+        for model, q, lo, hi in self._surviving_cnn():
+            if not (m.query_done(model, q) or m.query_failed(model, q)):
+                out.append(f"cnn {model} q{q}")
+        mgr = self.managers[self.final_master()]
+        with mgr._lock:
+            pool = mgr._pools.get(self.LM_POOL)
+            if pool is not None:
+                if pool["node"] is None:
+                    out.append("lm pool unplaced")
+                for rid, r in pool["requests"].items():
+                    if r["status"] in ("pending", "inflight"):
+                        out.append(f"lm rid {rid} {r['status']}")
+        return out
+
+    def _settled(self) -> bool:
+        acting = [h for h in self.cfg.hosts
+                  if self.members[h].is_acting_master]
+        if len(acting) != 1:
+            return False
+        # membership must re-converge too: every host sees every host
+        # alive again (false LEAVEs refuted post-heal) — settling on work
+        # completion alone would snapshot views mid-refutation
+        full = set(self.cfg.hosts)
+        for h in self.cfg.hosts:
+            if set(self.members[h].members.alive_hosts()) != full:
+                return False
+        return not self._unsettled()
+
+    # -- invariants -------------------------------------------------------
+
+    def drain_lm(self) -> list[dict]:
+        """Poll the surviving journal through the client path, recording
+        per-completion delivery counts (token tuple = logical request
+        identity, since every prompt is serial-unique)."""
+        got = []
+        for _ in range(3):
+            try:
+                out = self._client_control("n3", {"verb": "lm_poll",
+                                                  "name": self.LM_POOL})
+            except RuntimeError as e:
+                # the pool died with a doomed lineage (created but never
+                # replicated before the master was deposed): nothing to
+                # drain — its acks were lost, never wrong
+                if "pool" in str(e):
+                    return got
+                raise
+            for c in out.get("completions", ()):
+                key = tuple(c["tokens"])
+                self.lm_delivered[key] = self.lm_delivered.get(key, 0) + 1
+                got.append(c)
+            self.pump_work()
+        return got
+
+    def check_invariants(self) -> dict:
+        """Assert every global invariant; returns a summary dict."""
+        assert not self.violations, self.violations
+        for e, owners in self.epoch_owners.items():
+            assert len(owners) <= 1, \
+                f"epoch {e} owned by {sorted(owners)} (split brain)"
+        for e, hosts in self.acting_by_epoch.items():
+            assert len(hosts) <= 1, \
+                f"epoch {e} acted by {sorted(hosts)} (split brain)"
+        # membership converged: every alive host agrees on the alive set
+        views = {h: tuple(self.members[h].members.alive_hosts())
+                 for h in self.cfg.hosts}
+        assert len(set(views.values())) == 1, views
+        # CNN: exact result sets, no duplicate records
+        m = self.services[self.final_master()]
+        survived = self._surviving_cnn()
+        for model, q, lo, hi in survived:
+            if m.query_failed(model, q):
+                continue        # terminal (move-cap) — still exactly-once
+            recs = m.results(model, q)
+            names = [r[0] for r in recs]
+            assert len(names) == len(set(names)) == hi - lo + 1, \
+                f"{model} q{q}: {len(names)} records for {hi - lo + 1}"
+            assert set(names) == {f"test_{i}.JPEG"
+                                  for i in range(lo, hi + 1)}
+        # LM: exactly one terminal state per surviving admitted request,
+        # token-exact completions, at-most-once delivery
+        self.drain_lm()
+        rids, done_total = self._surviving_lm()
+        by_tokens = {tuple(lm_tokens(a["prompt"], a["seed"],
+                                     a["max_new"])): a
+                     for a in self.lm_attempted}
+        for key, n in self.lm_delivered.items():
+            assert n == 1, f"completion delivered {n}x: {key}"
+            assert key in by_tokens, f"tokens never submitted: {key}"
+        # SDFS: surviving puts read back exactly
+        store = self.stores[self.final_master()]
+        sdfs_survived = 0
+        for name, version, blob in self.sdfs_acked:
+            try:
+                got, v = store.get_bytes(name, version=version)
+            except StoreError:
+                continue        # doomed-lineage ack (lost, never wrong)
+            assert got == blob, f"{name} v{version} corrupt"
+            sdfs_survived += 1
+        return {"cnn_acked": len(self.cnn_acked),
+                "cnn_survived": len(survived),
+                "lm_acked": len(self.lm_acked),
+                "lm_delivered": len(self.lm_delivered),
+                "sdfs_acked": len(self.sdfs_acked),
+                "sdfs_survived": sdfs_survived,
+                "epochs": max(self.epoch_owners, default=0),
+                "final_master": self.final_master()}
+
+
+def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
+                        chaos: dict | None = None) -> dict:
+    """One full seeded chaos run: schedule -> converge -> invariants.
+    Returns the invariant summary plus convergence time."""
+    c = ChaosCluster(seed, data_dir)
+    c.run_schedule(steps=steps,
+                   chaos=chaos if chaos is not None
+                   else {"drop": 0.05, "dup": 0.03, "delay": 0.10,
+                         "seed": seed})
+    convergence_s = c.converge()
+    out = c.check_invariants()
+    out["convergence_s"] = round(convergence_s, 3)
+    out["seed"] = seed
+    return out

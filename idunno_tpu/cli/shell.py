@@ -178,8 +178,11 @@ class Shell:
         return f"{self.node.host} left (voluntary)"
 
     def cmd_list_master(self, args: list[str]) -> str:
+        epoch, owner = self.node.membership.epoch.view()
         return (f"acting master: {self.node.membership.acting_master()}\n"
-                f"standby:       {self.node.config.standby_coordinator}")
+                f"standby:       {self.node.config.standby_coordinator}\n"
+                f"epoch:         {epoch}"
+                + (f" (owner {owner})" if owner else " (bootstrap)"))
 
     # -- grep -------------------------------------------------------------
 

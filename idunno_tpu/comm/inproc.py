@@ -6,9 +6,28 @@ controllable failures: ``kill(host)`` makes a node unreachable (process
 crash), ``partition(a, b)`` drops traffic between two hosts (network cut),
 both reversible. Delivery is synchronous on the caller's thread — tests stay
 deterministic; the node runtime supplies its own threads for periodic loops.
+
+Beyond binary kill/cut, the network injects *seeded* partial faults in the
+style of FoundationDB's deterministic simulation (Zhou et al., SIGMOD 2021):
+
+- ``cut_oneway(src, dst)`` — asymmetric loss: src→dst traffic is dropped
+  while dst→src still flows (requests lost one way; replies lost the
+  other — a reliable call whose *reply* direction is cut runs the handler
+  and then raises, the exact lost-ACK shape idempotency keys exist for).
+- ``set_chaos(drop=…, dup=…, delay=…, seed=…)`` — probabilistic drop,
+  duplication (handler runs twice per request), and bounded delay/reorder
+  of datagrams, drawn from one ``random.Random(seed)`` so a failing chaos
+  schedule replays exactly from its seed.
+- ``lose_next_reply(src, dst, n)`` — a targeted, deterministic lost ACK:
+  the next ``n`` reliable calls src→dst execute server-side but the caller
+  sees a timeout.
+
+Chaos is off by default (all probabilities 0, no cuts): existing fixtures
+burn no RNG draws and behave exactly as before.
 """
 from __future__ import annotations
 
+import random
 import threading
 
 from idunno_tpu.comm.message import Message
@@ -18,10 +37,22 @@ from idunno_tpu.comm.transport import Handler, Transport, TransportError
 class InProcNetwork:
     """Shared registry of node transports + fault state."""
 
-    def __init__(self) -> None:
+    def __init__(self, seed: int | None = None) -> None:
         self._nodes: dict[str, "InProcTransport"] = {}
         self._dead: set[str] = set()
         self._cuts: set[frozenset[str]] = set()
+        self._oneway: set[tuple[str, str]] = set()
+        self._lose_reply: dict[tuple[str, str], int] = {}
+        self._rng = random.Random(seed)
+        self._drop_p = 0.0
+        self._dup_p = 0.0
+        self._delay_p = 0.0
+        self._delay_max = 4
+        self._chaos_links: set[tuple[str, str]] | None = None
+        # held datagrams: [deliveries_left_until_release, src, dst,
+        # service, msg] — releasing after N subsequent delivers gives
+        # bounded delay AND reordering without a clock dependency
+        self._held: list[list] = []
         self._lock = threading.RLock()
 
     def transport(self, host: str) -> "InProcTransport":
@@ -48,30 +79,167 @@ class InProcNetwork:
         with self._lock:
             self._cuts.discard(frozenset((a, b)))
 
+    def cut_oneway(self, src: str, dst: str) -> None:
+        """Drop src→dst traffic only (dst→src still flows)."""
+        with self._lock:
+            self._oneway.add((src, dst))
+
+    def heal_oneway(self, src: str, dst: str) -> None:
+        with self._lock:
+            self._oneway.discard((src, dst))
+
+    def lose_next_reply(self, src: str, dst: str, n: int = 1) -> None:
+        """The next ``n`` reliable calls src→dst run the handler but the
+        caller gets a timeout — a deterministic lost ACK."""
+        with self._lock:
+            self._lose_reply[(src, dst)] = (
+                self._lose_reply.get((src, dst), 0) + n)
+
+    def set_chaos(self, *, drop: float = 0.0, dup: float = 0.0,
+                  delay: float = 0.0, max_delay: int = 4,
+                  seed: int | None = None,
+                  links=None) -> None:
+        """Enable probabilistic faults on every delivery (or only on
+        ``links``, an iterable of (src, dst) pairs). ``drop``/``dup``/
+        ``delay`` are per-delivery probabilities; a dropped reliable call
+        splits 50/50 between lost-request and lost-reply. Reseeds the
+        schedule RNG when ``seed`` is given."""
+        with self._lock:
+            self._drop_p = float(drop)
+            self._dup_p = float(dup)
+            self._delay_p = float(delay)
+            self._delay_max = max(1, int(max_delay))
+            self._chaos_links = (None if links is None
+                                 else {tuple(l) for l in links})
+            if seed is not None:
+                self._rng = random.Random(seed)
+
+    def clear_chaos(self) -> None:
+        with self._lock:
+            self._drop_p = self._dup_p = self._delay_p = 0.0
+            self._chaos_links = None
+            self._lose_reply.clear()
+
+    def heal_all(self) -> None:
+        """Remove every cut (symmetric and one-way); chaos probabilities
+        and held datagrams are untouched (clear_chaos / flush_held)."""
+        with self._lock:
+            self._cuts.clear()
+            self._oneway.clear()
+
+    def flush_held(self) -> None:
+        """Deliver every delayed datagram now (still subject to the
+        *current* reachability — a heal then flush models late packets
+        crossing the healed link)."""
+        with self._lock:
+            due, self._held = self._held, []
+        for _, src, dst, service, msg in due:
+            self._release_one(src, dst, service, msg)
+
     # -- delivery ---------------------------------------------------------
 
     def _reachable(self, src: str, dst: str) -> bool:
         with self._lock:
             return (dst in self._nodes and dst not in self._dead
                     and src not in self._dead
-                    and frozenset((src, dst)) not in self._cuts)
+                    and frozenset((src, dst)) not in self._cuts
+                    and (src, dst) not in self._oneway)
 
-    def deliver(self, src: str, dst: str, service: str,
-                msg: Message, reliable: bool) -> Message | None:
-        if not self._reachable(src, dst):
-            if reliable:
-                raise TransportError(f"{dst} unreachable from {src}")
-            return None
+    def _chaos_roll(self, src: str, dst: str, reliable: bool) -> str:
         with self._lock:
-            node = self._nodes[dst]
-            handler = node._handlers.get(service)
+            total = self._drop_p + self._dup_p + self._delay_p
+            if total <= 0.0:
+                return "ok"
+            if (self._chaos_links is not None
+                    and (src, dst) not in self._chaos_links):
+                return "ok"
+            r = self._rng.random()
+            if r < self._drop_p:
+                if reliable and self._rng.random() < 0.5:
+                    return "drop_reply"
+                return "drop"
+            if r < self._drop_p + self._dup_p:
+                return "dup"
+            if r < total:
+                return "delay"
+            return "ok"
+
+    def _tick_held(self) -> None:
+        """Each delivery ages held datagrams by one; release the due ones
+        (re-checking reachability at release time, like real late
+        packets)."""
+        with self._lock:
+            if not self._held:
+                return
+            keep: list[list] = []
+            due: list[list] = []
+            for item in self._held:
+                item[0] -= 1
+                (due if item[0] <= 0 else keep).append(item)
+            self._held = keep
+        for _, src, dst, service, msg in due:
+            self._release_one(src, dst, service, msg)
+
+    def _release_one(self, src: str, dst: str, service: str,
+                     msg: Message) -> None:
+        try:
+            if self._reachable(src, dst):
+                self._deliver_raw(src, dst, service, msg, reliable=False)
+        except TransportError:
+            pass
+
+    def _deliver_raw(self, src: str, dst: str, service: str,
+                     msg: Message, reliable: bool) -> Message | None:
+        with self._lock:
+            node = self._nodes.get(dst)
+            handler = node._handlers.get(service) if node else None
         if handler is None:
             if reliable:
-                raise TransportError(f"{dst} has no service {service!r}")
+                raise TransportError(f"{dst} has no service {service!r}",
+                                     reason="closed")
             return None
         # round-trip through bytes so serialization bugs surface in tests
         wire = Message.from_bytes(msg.to_bytes())
         return handler(service, wire)
+
+    def deliver(self, src: str, dst: str, service: str,
+                msg: Message, reliable: bool) -> Message | None:
+        self._tick_held()
+        if not self._reachable(src, dst):
+            if reliable:
+                raise TransportError(f"{dst} unreachable from {src}")
+            return None
+        mode = self._chaos_roll(src, dst, reliable)
+        with self._lock:
+            rev_cut = (dst, src) in self._oneway
+            lose_reply = self._lose_reply.get((src, dst), 0) > 0
+            if reliable and lose_reply:
+                self._lose_reply[(src, dst)] -= 1
+        if reliable:
+            if mode == "drop":
+                raise TransportError(
+                    f"request {src}->{dst} dropped (chaos)",
+                    reason="timeout")
+            # delay is unobservable on a synchronous call — deliver
+            out = self._deliver_raw(src, dst, service, msg, reliable=True)
+            if mode == "dup":    # duplicated request frame: handler twice
+                self._deliver_raw(src, dst, service, msg, reliable=True)
+            if mode == "drop_reply" or rev_cut or lose_reply:
+                raise TransportError(
+                    f"reply {dst}->{src} lost from {src}'s view",
+                    reason="timeout")
+            return out
+        if mode == "drop":
+            return None
+        if mode == "delay":
+            with self._lock:
+                hold = 1 + self._rng.randrange(self._delay_max)
+                self._held.append([hold, src, dst, service, msg])
+            return None
+        out = self._deliver_raw(src, dst, service, msg, reliable=False)
+        if mode == "dup":
+            self._deliver_raw(src, dst, service, msg, reliable=False)
+        return out
 
 
 class InProcTransport(Transport):

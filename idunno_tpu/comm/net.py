@@ -59,15 +59,19 @@ def oneshot_call(ip: str, tcp_port: int, service: str, msg: Message,
                  timeout: float = 10.0) -> Message | None:
     """Pure-client RPC: one framed request/response on a fresh connection,
     no listener bound — how external tools (tests, ops scripts, the remote
-    CLI) talk to a node without becoming one."""
+    CLI) talk to a node without becoming one. A peer that closes without
+    sending a reply frame raises a typed ``closed`` TransportError (every
+    service in this codebase replies over TCP, so a bare close means the
+    handler died mid-request — retryable, not a silent None)."""
     with socket.create_connection((ip, tcp_port), timeout=timeout) as sock:
         _send_frame(sock, service, msg)
         sock.shutdown(socket.SHUT_WR)
         try:
             _, out = _recv_frame(sock)
             return out
-        except ConnectionError:
-            return None
+        except ConnectionError as e:
+            raise TransportError(f"{ip}:{tcp_port} closed before reply: {e}",
+                                 reason="closed") from e
 
 
 class NetTransport(Transport):
@@ -161,11 +165,25 @@ class NetTransport(Transport):
 
     def call(self, host: str, service: str, msg: Message,
              timeout: float | None = None) -> Message | None:
+        # typed failure reasons instead of one blanket bucket: the retry
+        # layer (comm/retry.py) backs off on timeout/refused/closed but a
+        # caller can still tell "peer busy" from "peer gone". Order
+        # matters: socket.timeout ⊂ OSError, ConnectionRefusedError ⊂
+        # ConnectionError ⊂ OSError.
         ip, tcp_port, _ = self._addr_of(host)
         try:
             return oneshot_call(ip, tcp_port, service, msg,
                                 timeout=timeout or 10.0)
-        except (OSError, socket.timeout) as e:
+        except socket.timeout as e:
+            raise TransportError(f"{host} timed out: {e}",
+                                 reason="timeout") from e
+        except ConnectionRefusedError as e:
+            raise TransportError(f"{host} refused: {e}",
+                                 reason="refused") from e
+        except ConnectionError as e:
+            raise TransportError(f"{host} closed connection: {e}",
+                                 reason="closed") from e
+        except OSError as e:
             raise TransportError(f"{host} unreachable: {e}") from e
 
     def datagram(self, host: str, service: str, msg: Message) -> None:

@@ -1,0 +1,56 @@
+"""Bounded exponential backoff with jitter for control-plane RPC.
+
+The reference retries nothing: a lost ACK surfaces as a client error and a
+re-submit double-books (`mp4_machinelearning.py:956-963` fails over
+primary→standby exactly once, then gives up). Here mutating verbs carry
+client-generated idempotency keys deduped server-side (submit / lm_submit /
+SDFS put), which makes retrying safe — so the transport layer can retry
+typed-retryable faults (timeout/refused/closed/unreachable) under a
+deadline without risking duplicate work. ``stale_epoch`` rejections are
+never retried: a fenced coordinator must step down, not hammer the new one
+(membership/epoch.py).
+
+Full jitter (delay × U[0.5, 1)) decorrelates the retry storms of many
+clients hitting one recovering coordinator. Defaults are small (3 attempts
+from 20 ms) because callers sit in front of their own failover loops —
+this layer only rides out blips, it does not replace them.
+"""
+from __future__ import annotations
+
+import random
+import time
+from collections.abc import Callable
+
+from idunno_tpu.comm.transport import TransportError
+
+
+def call_with_retry(fn: Callable[[], object], *, attempts: int = 3,
+                    base_s: float = 0.02, cap_s: float = 0.25,
+                    deadline_s: float = 2.0,
+                    rng: random.Random | None = None,
+                    sleep: Callable[[float], None] = time.sleep,
+                    clock: Callable[[], float] = time.monotonic):
+    """Run ``fn`` retrying retryable TransportErrors with exponential
+    backoff + jitter, bounded by both ``attempts`` and ``deadline_s``.
+    Non-retryable errors (e.g. StaleEpoch) and non-transport exceptions
+    propagate immediately."""
+    roll = (rng or random).random
+    t0 = clock()
+    delay = base_s
+    last: TransportError | None = None
+    for attempt in range(max(1, attempts)):
+        try:
+            return fn()
+        except TransportError as e:
+            if not getattr(e, "retryable", True):
+                raise
+            last = e
+        if attempt + 1 >= attempts:
+            break
+        pause = delay * (0.5 + 0.5 * roll())
+        if clock() - t0 + pause > deadline_s:
+            break
+        sleep(pause)
+        delay = min(delay * 2.0, cap_s)
+    assert last is not None
+    raise last

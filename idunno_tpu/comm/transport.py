@@ -26,7 +26,29 @@ Handler = Callable[[str, Message], Message | None]
 
 class TransportError(Exception):
     """Peer unreachable / connection failed — the caller decides whether to
-    fail over (the reference's primary→standby retry, `:956-963`)."""
+    fail over (the reference's primary→standby retry, `:956-963`).
+
+    ``reason`` types the failure so the retry layer (comm/retry.py) can
+    distinguish retryable transport faults from fatal protocol rejections:
+
+    - ``timeout``      — no answer in time (peer may have processed it)
+    - ``refused``      — connection refused (peer down / port closed)
+    - ``closed``       — peer closed mid-exchange
+    - ``unreachable``  — no route / address failure
+    - ``stale_epoch``  — fenced by a higher coordinator epoch (never
+      retryable; see membership/epoch.py)
+    """
+
+    RETRYABLE = frozenset({"timeout", "refused", "closed", "unreachable"})
+
+    def __init__(self, message: str = "",
+                 reason: str = "unreachable") -> None:
+        super().__init__(message)
+        self.reason = reason
+
+    @property
+    def retryable(self) -> bool:
+        return self.reason in self.RETRYABLE
 
 
 class Transport(abc.ABC):

@@ -78,6 +78,16 @@ class ClusterConfig:
     # Failover metadata replication period (reference: 1 Hz, `:971-987`).
     metadata_interval_s: float = 1.0
 
+    # Control-plane RPC retry: bounded exponential backoff + jitter under
+    # a deadline (comm/retry.py). Retries are exactly-once because the
+    # mutating verbs (submit / lm_submit / SDFS put) carry client
+    # idempotency keys deduped server-side. Small on purpose — this layer
+    # rides out blips; real failover is the caller's primary→standby loop.
+    rpc_retry_attempts: int = 3
+    rpc_retry_base_s: float = 0.02
+    rpc_retry_cap_s: float = 0.25
+    rpc_retry_deadline_s: float = 2.0
+
     def __post_init__(self) -> None:
         for name in ("coordinator", "standby_coordinator", "introducer"):
             host = getattr(self, name)

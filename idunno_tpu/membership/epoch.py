@@ -1,0 +1,132 @@
+"""Monotone epoch fence for coordinator actions (Raft-style terms).
+
+The reference's client failover (`mp4_machinelearning.py:956-963`) retries
+primary→standby with no fencing: after a partition isolates the primary,
+both coordinators keep dispatching and nothing deposes the stale one when
+the network heals (SURVEY.md §7 bug-not-to-replicate). Here every adoption
+mints a strictly increasing epoch (Ongaro & Ousterhout, "In Search of an
+Understandable Consensus Algorithm", 2014 — the term mechanism only; no
+log replication or quorum election, the standby chain is configured).
+Coordinator-originated verbs (dispatch, metadata replication, lm_* control
+RPCs, SDFS internal pushes) are stamped with the sender's fence view;
+every receiver tracks the highest epoch seen, rejects lower-epoch verbs
+with a typed ``StaleEpoch`` reply, and a deposed coordinator that observes
+a higher epoch steps down — split brain becomes impossible by
+construction, and heal-time convergence is automatic because the fence
+view also rides the membership ping/pong gossip.
+
+Epoch 0 with no owner is the bootstrap state: the configured coordinator
+acts without minting, so a cluster that never fails over never pays for
+fencing (and older snapshots without an ``epoch`` field load unchanged).
+"""
+from __future__ import annotations
+
+import threading
+
+from idunno_tpu.comm.message import Message
+from idunno_tpu.comm.transport import TransportError
+from idunno_tpu.utils.types import MessageType
+
+
+class StaleEpoch(TransportError):
+    """A peer rejected our verb because it has seen a higher epoch — we are
+    (or are acting for) a deposed coordinator. Never retryable: retrying a
+    fenced verb cannot succeed, the caller must step down instead."""
+
+    def __init__(self, message: str, epoch: int = 0,
+                 owner: str | None = None) -> None:
+        super().__init__(message, reason="stale_epoch")
+        self.epoch = epoch
+        self.owner = owner
+
+
+class EpochFence:
+    """Thread-safe (epoch, owner) high-water mark.
+
+    ``observe`` advances on gossip/stamps from peers; ``mint`` is called by
+    an adopting coordinator and returns a strictly higher epoch owned by
+    it. On equal epochs the first-seen owner is kept (two mints of the
+    same epoch cannot happen through ``adopt`` because the snapshot carries
+    the old epoch and ``mint`` goes strictly above the high-water)."""
+
+    def __init__(self) -> None:
+        self._epoch = 0
+        self._owner: str | None = None
+        self._lock = threading.Lock()
+
+    def current(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def owner(self) -> str | None:
+        with self._lock:
+            return self._owner
+
+    def view(self) -> tuple[int, str | None]:
+        with self._lock:
+            return self._epoch, self._owner
+
+    def observe(self, epoch: int, owner: str | None = None) -> bool:
+        """Advance the high-water mark; True if it moved."""
+        with self._lock:
+            if epoch > self._epoch:
+                self._epoch = int(epoch)
+                self._owner = owner
+                return True
+            return False
+
+    def mint(self, owner: str) -> int:
+        with self._lock:
+            self._epoch += 1
+            self._owner = owner
+            return self._epoch
+
+
+# -- wire helpers (shared by every stamped service) ------------------------
+
+def stamp(fence: EpochFence, payload: dict) -> dict:
+    """Stamp a coordinator-originated payload with the sender's fence view
+    (in place; returns the payload for chaining)."""
+    e, owner = fence.view()
+    payload["epoch"] = [e, owner]
+    return payload
+
+
+def observe_payload(fence: EpochFence, payload) -> None:
+    """Advance the local fence from a stamped payload without rejecting —
+    for peer-originated messages (worker results, gossip) whose work is
+    valid at any epoch."""
+    ep = payload.get("epoch") if isinstance(payload, dict) else None
+    if ep:
+        fence.observe(int(ep[0]), ep[1])
+
+
+def check_payload(fence: EpochFence, payload, host: str) -> Message | None:
+    """Receiver-side fence check for a coordinator-originated verb: returns
+    a typed stale-epoch ERROR reply if the stamp is below the local
+    high-water mark, else observes the stamp and returns None. Unstamped
+    payloads (client RPCs, pre-fence peers) always pass."""
+    ep = payload.get("epoch") if isinstance(payload, dict) else None
+    if not ep:
+        return None
+    e = int(ep[0])
+    cur, owner = fence.view()
+    if e < cur:
+        return Message(MessageType.ERROR, host,
+                       {"error": f"stale epoch {e} < {cur}"
+                                 f" (owner {owner})",
+                        "stale_epoch": True, "epoch": [cur, owner]})
+    fence.observe(e, ep[1])
+    return None
+
+
+def reply_is_stale(fence: EpochFence, reply: Message | None) -> bool:
+    """Sender-side: True if the reply is a stale-epoch rejection. Observes
+    the rejecting peer's (higher) fence view so the caller demotes."""
+    if reply is None or reply.type is not MessageType.ERROR:
+        return False
+    p = reply.payload if isinstance(reply.payload, dict) else {}
+    if not p.get("stale_epoch"):
+        return False
+    observe_payload(fence, p)
+    return True

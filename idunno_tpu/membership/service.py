@@ -28,6 +28,7 @@ from collections.abc import Callable
 from idunno_tpu.comm.message import Message
 from idunno_tpu.comm.transport import Transport, TransportError
 from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.epoch import EpochFence, observe_payload
 from idunno_tpu.membership.list import MembershipList
 from idunno_tpu.utils.types import MemberStatus, MessageType
 
@@ -45,14 +46,25 @@ class MembershipService:
         self.transport = transport
         self.clock = clock
         self.members = MembershipList()
+        # coordinator epoch fence, shared by every service on this node
+        # (stamped on coordinator verbs, advanced by gossip; epoch 0 /
+        # no owner = bootstrap, the configured chain acts unfenced)
+        self.epoch = EpochFence()
         self._callbacks: list[ChangeCallback] = []
         self._left = False           # voluntary leave: never auto-refute
         transport.serve(SERVICE, self._handle)
 
     # -- wiring -----------------------------------------------------------
 
-    def on_change(self, cb: ChangeCallback) -> None:
-        self._callbacks.append(cb)
+    def on_change(self, cb: ChangeCallback, front: bool = False) -> None:
+        """``front=True`` runs the callback before earlier registrations —
+        the failover manager uses it so an adoption (epoch mint) lands
+        before reassignment callbacks start dispatching under the old
+        epoch."""
+        if front:
+            self._callbacks.insert(0, cb)
+        else:
+            self._callbacks.append(cb)
 
     def _fire(self, changes) -> None:
         for host, old, new in changes:
@@ -62,9 +74,17 @@ class MembershipService:
     # -- mastership -------------------------------------------------------
 
     def acting_master(self) -> str:
-        """The configured coordinator while it is alive in the local view,
-        else the standby (the reference's primary→standby order,
-        `mp4_machinelearning.py:47-48, 956-963`)."""
+        """Where this node routes coordinator traffic: the current epoch
+        owner while it is alive in the local view, else the configured
+        coordinator→standby chain (the reference's primary→standby order,
+        `mp4_machinelearning.py:47-48, 956-963` — but fence-aware: once an
+        adoption minted an epoch, its owner stays master across heals
+        instead of flapping back to the configured coordinator)."""
+        _, owner = self.epoch.view()
+        if owner is not None:
+            o = self.members.get(owner)
+            if o is None or o.status.alive:
+                return owner
         c = self.config.coordinator
         if self.members.get(c) is None or self.members.is_alive(c):
             return c
@@ -72,7 +92,16 @@ class MembershipService:
 
     @property
     def is_acting_master(self) -> bool:
-        return self.acting_master() == self.host
+        """Acting-master DUTIES (dispatch, heartbeats, replication) require
+        owning the fence: once any epoch has been minted, a node acts only
+        if it is the owner — a node that merely *routes* to itself while a
+        higher-epoch owner exists (e.g. the configured coordinator inside a
+        partition that marked the owner LEAVE) stays fenced until it mints
+        a higher epoch through FailoverManager.adopt."""
+        if self.acting_master() != self.host:
+            return False
+        owner = self.epoch.owner()
+        return owner is None or owner == self.host
 
     # -- lifecycle --------------------------------------------------------
 
@@ -86,7 +115,8 @@ class MembershipService:
         if self.host == self.config.introducer:
             return
         msg = Message(MessageType.JOIN, self.host,
-                      {"members": self.members.to_wire()})
+                      {"members": self.members.to_wire(),
+                       "epoch": list(self.epoch.view())})
         for seed in (self.config.introducer, self.config.coordinator,
                      self.config.standby_coordinator):
             if seed == self.host:
@@ -96,6 +126,10 @@ class MembershipService:
             except TransportError:
                 continue
             if out is not None:
+                # the ACK carries the cluster's fence view: a rejoiner that
+                # lost its fence state re-learns the current epoch before
+                # it could ever act on a stale one
+                observe_payload(self.epoch, out.payload)
                 self._fire(self.members.merge(out.payload["members"]))
                 return
         # nobody reachable — we are first up; keep our solo list.
@@ -120,7 +154,8 @@ class MembershipService:
         if not self.is_acting_master:
             return
         msg = Message(MessageType.PING, self.host,
-                      {"members": self.members.to_wire()})
+                      {"members": self.members.to_wire(),
+                       "epoch": list(self.epoch.view())})
         for h in self.config.hosts:
             if h != self.host:
                 self.transport.datagram(h, SERVICE, msg)
@@ -129,8 +164,12 @@ class MembershipService:
         """Failure detection step.
 
         Acting master: mark alive members LEAVE after ``failure_timeout_s``
-        of silence. Standby (not acting master): watch only the coordinator's
-        ping stream — silence there promotes the standby on the next step.
+        of silence. Coordinator/standby when NOT acting master: watch only
+        the current acting master's ping stream — silence there promotes
+        the watcher on the next step (pre-fence this was standby-watches-
+        coordinator only; with epochs the deposed coordinator equally
+        watches the owner, so mastership can fail back under a NEW epoch
+        when the owner dies).
         """
         now = self.clock()
         timeout = self.config.failure_timeout_s
@@ -171,8 +210,12 @@ class MembershipService:
                     self.members.set(e.host, MemberStatus.LEAVE, now)
                     self._fire([(e.host, MemberStatus.RUNNING,
                                  MemberStatus.LEAVE)])
-        elif self.host == self.config.standby_coordinator:
-            c = self.members.get(self.config.coordinator)
+        elif self.host in (self.config.coordinator,
+                           self.config.standby_coordinator):
+            target = self.acting_master()
+            if target == self.host:
+                return
+            c = self.members.get(target)
             if (c is not None and c.status.alive and c.last_heard
                     and now - c.last_heard > timeout):
                 self.members.set(c.host, MemberStatus.LEAVE, now)
@@ -183,11 +226,16 @@ class MembershipService:
 
     def _handle(self, service: str, msg: Message) -> Message | None:
         now = self.clock()
+        # fence gossip: every membership message carries the sender's
+        # (epoch, owner) view; observing it here is what deposes a stale
+        # coordinator within one ping wave of a heal
+        observe_payload(self.epoch, msg.payload)
         if msg.type is MessageType.JOIN:
             self._fire(self.members.merge(msg.payload["members"]))
             self.members.touch(msg.sender, now)
             return Message(MessageType.ACK, self.host,
-                           {"members": self.members.to_wire()})
+                           {"members": self.members.to_wire(),
+                            "epoch": list(self.epoch.view())})
         if msg.type in (MessageType.PING, MessageType.PONG,
                         MessageType.LEAVE):
             self._fire(self.members.merge(msg.payload["members"]))
@@ -196,6 +244,7 @@ class MembershipService:
                 self.transport.datagram(
                     msg.sender, SERVICE,
                     Message(MessageType.PONG, self.host,
-                            {"members": self.members.to_wire()}))
+                            {"members": self.members.to_wire(),
+                             "epoch": list(self.epoch.view())}))
             return None
         return None

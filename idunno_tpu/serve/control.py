@@ -34,6 +34,7 @@ import os
 from typing import TYPE_CHECKING
 
 from idunno_tpu.comm.message import Message
+from idunno_tpu.membership.epoch import check_payload
 from idunno_tpu.utils.types import MessageType
 
 if TYPE_CHECKING:                                    # pragma: no cover
@@ -55,6 +56,13 @@ class ControlService:
         self._lms: dict = {}          # name -> (model, params), loaded once
         self._lm_loops: dict = {}     # name -> LMServingLoop (continuous)
         self._train_jobs: dict = {}   # name -> LMTrainJob
+        # (name, idem key) -> node-local row id: dedupes a manager's
+        # RE-forward of an lm_submit whose ACK was lost, so the retried
+        # request decodes exactly once on this node. Purged per name on
+        # lm_serve rebuild / lm_stop — after a rebuild the old row ids
+        # are dead, replaying them would map retries onto a new loop's
+        # unrelated rows
+        self._lm_idem: dict = {}
         # transports run one handler thread per connection: registry
         # check-then-act must be atomic or two concurrent lm_serve/
         # train_start calls each spawn a loop and one leaks unjoinable
@@ -74,6 +82,15 @@ class ControlService:
             job.stop()
 
     def _handle(self, service: str, msg: Message) -> Message:
+        # epoch fence (membership/epoch.py): control verbs stamped by a
+        # deposed coordinator are rejected with a typed stale-epoch ERROR
+        # before they can mutate anything; unstamped payloads (clients,
+        # pre-failover traffic) pass and current stamps advance the local
+        # high-water mark
+        stale = check_payload(self.node.membership.epoch, msg.payload,
+                              self.node.host)
+        if stale is not None:
+            return stale
         try:
             out = self._dispatch(msg.payload.get("verb", ""), msg.payload)
             return Message(MessageType.ACK, self.node.host, out)
@@ -91,6 +108,8 @@ class ControlService:
                        for e in node.membership.members.entries()}
             return {"host": node.host,
                     "acting_master": node.membership.acting_master(),
+                    "fence": list(node.membership.epoch.view()),
+                    "counters": node.metrics.counters(),
                     "members": members,
                     "models": node.engine.loaded_models()
                     if hasattr(node.engine, "loaded_models") else []}
@@ -253,6 +272,10 @@ class ControlService:
                                         or not p.get("reload")):
                     return {"already": True}
                 self._lm_loops[name] = placeholder
+                # new loop generation: the old generation's idempotency
+                # row ids are dead, drop them
+                for k in [k for k in self._lm_idem if k[0] == name]:
+                    del self._lm_idem[k]
             try:
                 if old is not None:
                     old.stop()
@@ -320,6 +343,12 @@ class ControlService:
             loop.stop()               # lm_stop won the race mid-build
             return {"stopped": True}
         if verb == "lm_submit":
+            key = p.get("idem")
+            if key is not None:
+                with self._reg_lock:
+                    prior = self._lm_idem.get((p["name"], key))
+                if prior is not None:
+                    return {"id": prior, "duplicate": True}
             rid = self._lm_loop(p["name"]).submit(
                 [int(t) for t in p["prompt"]], int(p["max_new"]),
                 temperature=float(p.get("temperature", 0.0)),
@@ -338,6 +367,12 @@ class ControlService:
                 deadline_ms=(float(p["deadline_ms"])
                              if p.get("deadline_ms") is not None else None),
                 readmit=bool(p.get("readmit")))
+            if key is not None:
+                with self._reg_lock:
+                    if len(self._lm_idem) >= 4096:     # bound the map
+                        for k in list(self._lm_idem)[:1024]:
+                            del self._lm_idem[k]
+                    self._lm_idem[(p["name"], key)] = rid
             return {"id": rid}
         if verb == "lm_poll":
             loop = self._lm_loop(p["name"])
@@ -397,6 +432,9 @@ class ControlService:
         if verb == "lm_stop":
             with self._reg_lock:
                 loop = self._lm_loops.pop(p["name"], None)
+                for k in [k for k in self._lm_idem
+                          if k[0] == p["name"]]:
+                    del self._lm_idem[k]
             if loop is not None and not isinstance(loop, _Starting):
                 loop.stop()
             # popping a _Starting reservation makes the builder's final
@@ -476,7 +514,8 @@ class ControlService:
                   and verb in ("lm_serve", "train_start"))
         if placed:
             master = self.node.membership.acting_master()
-            if master != self.node.host:
+            if master != self.node.host \
+                    or not self.node.membership.is_acting_master:
                 raise ValueError(
                     f"placement=auto must go to the acting master "
                     f"({master}), not {self.node.host}")
@@ -486,6 +525,15 @@ class ControlService:
         if verb in ("lm_submit", "lm_poll", "lm_stats", "lm_stop",
                     "lm_cancel", "lm_partial", "lm_qos") \
                 and mgr.has_pool(name):
+            if not self.node.membership.is_acting_master:
+                # a deposed coordinator still holds the managed journal it
+                # diverged from: serving it would ack submits that can
+                # never complete and re-deliver completions the CURRENT
+                # master also delivers (split-brain double delivery) —
+                # refuse, clients fail over to the epoch owner
+                raise ValueError(
+                    f"{self.node.host} is not the acting master; its "
+                    f"managed journal for {name!r} is fenced")
             if verb == "lm_submit":
                 rid = mgr.submit(name, [int(t) for t in p["prompt"]],
                                  int(p["max_new"]),
@@ -508,7 +556,8 @@ class ControlService:
                                                     "interactive")),
                                  deadline_ms=(float(p["deadline_ms"])
                                               if p.get("deadline_ms")
-                                              is not None else None))
+                                              is not None else None),
+                                 idem_key=p.get("idem"))
                 return {"id": rid}
             if verb == "lm_poll":
                 return mgr.poll(name)

@@ -18,17 +18,21 @@ the new master.
 """
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Any
 
 from idunno_tpu.comm.message import Message
 from idunno_tpu.comm.transport import Transport, TransportError
 from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.epoch import check_payload, reply_is_stale
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.serve.inference_service import InferenceService
 from idunno_tpu.utils.types import MemberStatus, MessageType
 
 SERVICE = "metadata"
+
+log = logging.getLogger("idunno.failover")
 
 
 class FailoverManager:
@@ -45,14 +49,20 @@ class FailoverManager:
         self._seq = 0
         self._received: dict[str, Any] | None = None
         self._received_seq = -1
-        self._adopted = False
+        # satellite observability: acked queries whose write-ahead was
+        # skipped because the standby was down (durability gap until the
+        # periodic snapshot catches up) — also a metrics counter
+        self.wal_skips = 0
         # standby-side per-query write-ahead deltas, (model, qnum) →
         # {"tasks": [...wire...], "dataset": ...}; applied on adopt for
         # queries the newest full snapshot predates, pruned as snapshots
         # catch up (wal_append / _handle / adopt)
         self._wal: dict[tuple[str, int], dict[str, Any]] = {}
         transport.serve(SERVICE, self._handle)
-        membership.on_change(self._on_member_change)
+        # front: the adoption (epoch mint) must land BEFORE reassignment
+        # callbacks start re-dispatching, so nothing dispatches under the
+        # dead owner's epoch during the promotion itself
+        membership.on_change(self._on_member_change, front=True)
 
     # -- master side: periodic replication --------------------------------
 
@@ -71,8 +81,10 @@ class FailoverManager:
             qnum = dict(svc._qnum)
         self._seq += 1
         snap = {"seq": self._seq,
+                "epoch": list(self.membership.epoch.view()),
                 "tasks": svc.scheduler.book.to_wire(),
                 "qnum": qnum,
+                "idem": svc.idem_to_wire(),
                 "metrics": svc.metrics.to_wire(),
                 "results": results}
         if self.lm_manager is not None:
@@ -91,12 +103,17 @@ class FailoverManager:
             return False
         msg = Message(MessageType.METADATA, self.host, self.snapshot())
         try:
-            return self.transport.call(standby, SERVICE, msg,
-                                       timeout=10.0) is not None
+            out = self.transport.call(standby, SERVICE, msg, timeout=10.0)
         except TransportError:
             return False
+        if reply_is_stale(self.membership.epoch, out):
+            # the standby has seen a higher epoch: we are deposed — the
+            # observe above demoted us, stop replicating stale state
+            return False
+        return out is not None
 
-    def wal_append(self, model: str, qnum: int, tasks, dataset) -> bool:
+    def wal_append(self, model: str, qnum: int, tasks, dataset,
+                   idem: str | None = None) -> bool:
         """Synchronous per-query write-ahead for the submit path: a query
         the master has ACKed must survive an immediate coordinator death,
         not just one that lands after the next periodic tick. Ships ONLY
@@ -106,26 +123,44 @@ class FailoverManager:
         alive-but-degraded standby bounds ack latency. Skips (False) when
         the standby is not currently ALIVE — a dead standby must not add
         its timeout to every ack; the periodic loop resumes replication
-        when it returns."""
+        when it returns — but the skip is *observable* (log + metrics
+        counter), not silent: each one is an acked query that would be
+        lost if this master died before the next snapshot."""
         standby = self.config.standby_coordinator
-        if (standby == self.host or not self.membership.is_acting_master
-                or standby not in self.membership.members.alive_hosts()):
+        if standby == self.host or not self.membership.is_acting_master:
+            return False
+        if standby not in self.membership.members.alive_hosts():
+            self.wal_skips += 1
+            self.service.metrics.record_counter("wal_skipped_standby_down")
+            log.warning("wal_append skipped for %s q%d: standby %s not "
+                        "alive (%d skips — acked queries unprotected until "
+                        "the next snapshot)", model, qnum, standby,
+                        self.wal_skips)
             return False
         msg = Message(MessageType.METADATA, self.host,
-                      {"wal": {"model": model, "qnum": int(qnum),
+                      {"epoch": list(self.membership.epoch.view()),
+                       "wal": {"model": model, "qnum": int(qnum),
                                "tasks": [t.to_wire() for t in tasks],
-                               "dataset": dataset}})
+                               "dataset": dataset, "idem": idem}})
         try:
-            return self.transport.call(standby, SERVICE, msg,
-                                       timeout=2.0) is not None
+            out = self.transport.call(standby, SERVICE, msg, timeout=2.0)
         except TransportError:
             return False
+        if reply_is_stale(self.membership.epoch, out):
+            return False
+        return out is not None
 
     # -- standby side ------------------------------------------------------
 
     def _handle(self, service: str, msg: Message) -> Message | None:
         if msg.type is not MessageType.METADATA:
             return None
+        # epoch fence: a deposed master's replication must not overwrite
+        # the adopted state it diverged from (its seq counter may be
+        # HIGHER than ours — seq orders snapshots within one epoch only)
+        stale = check_payload(self.membership.epoch, msg.payload, self.host)
+        if stale is not None:
+            return stale
         with self._lock:
             if "wal" in msg.payload:        # per-query write-ahead delta
                 d = msg.payload["wal"]
@@ -135,7 +170,6 @@ class FailoverManager:
             if seq > self._received_seq:
                 self._received = msg.payload
                 self._received_seq = seq
-                self._adopted = False
                 # deltas the snapshot has caught up with are durable in it
                 have = {(t["model"], int(t["qnum"]))
                         for t in msg.payload.get("tasks", [])}
@@ -145,22 +179,36 @@ class FailoverManager:
 
     def _on_member_change(self, host: str, old: MemberStatus | None,
                           new: MemberStatus) -> None:
-        if (new is MemberStatus.LEAVE
-                and host == self.config.coordinator
-                and self.membership.is_acting_master):
+        # adopt when the CURRENT master (fence owner once one exists, the
+        # configured coordinator before any mint) is marked dead and this
+        # node is next in the chain
+        if new is not MemberStatus.LEAVE:
+            return
+        owner = self.membership.epoch.owner() or self.config.coordinator
+        if host == owner and self.membership.acting_master() == self.host:
             self.adopt()
 
     def adopt(self) -> None:
-        """Become the coordinator: load the newest replicated snapshot,
-        apply any write-ahead deltas it predates, and resume every
-        unfinished range."""
+        """Become the coordinator: mint a strictly higher epoch (fencing
+        the deposed master everywhere its stamps are checked), load the
+        newest replicated snapshot, apply any write-ahead deltas it
+        predates, and resume every unfinished range."""
+        fence = self.membership.epoch
         with self._lock:
-            if self._adopted or (self._received is None
-                                 and not self._wal):
-                return
+            if fence.owner() == self.host:
+                return          # already own the current epoch
             snap = self._received
-            self._adopted = True
             wal = dict(self._wal)
+        # the snapshot carries the deposed master's epoch: fold it into
+        # the high-water mark FIRST so the mint lands strictly above
+        # everything that master ever stamped
+        ep = snap.get("epoch") if snap is not None else None
+        if ep:
+            fence.observe(int(ep[0]), ep[1])
+        epoch = fence.mint(self.host)
+        log.info("%s adopting mastership at epoch %d (snapshot seq %s, "
+                 "%d wal deltas)", self.host, epoch,
+                 snap.get("seq") if snap else None, len(wal))
         svc = self.service
         if snap is not None:
             svc.scheduler.book.load_wire(snap["tasks"])
@@ -168,6 +216,7 @@ class FailoverManager:
                 svc._qnum.update({m: max(int(q), svc._qnum.get(m, 0))
                                   for m, q in snap["qnum"].items()})
             svc.metrics.load_wire(snap["metrics"])
+            svc.idem_load_wire(snap.get("idem", {}))
             with svc._results_lock:
                 for key, recs in snap["results"].items():
                     m, q = key.split("\x00")
@@ -185,6 +234,10 @@ class FailoverManager:
                     [Task.from_wire(t) for t in d["tasks"]])
             with svc._results_lock:
                 svc._qnum[m] = max(svc._qnum.get(m, 0), int(q))
+            if d.get("idem"):
+                # a client retrying its acked submit against the NEW
+                # master must dedupe, not double-book
+                svc.record_idem(d["idem"], int(q))
         self.resume_in_flight()
         if self.lm_manager is not None and snap is not None \
                 and "lm" in snap:

@@ -23,13 +23,17 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 from collections.abc import Callable
 from dataclasses import dataclass, field
 from typing import Any, Protocol
 
 from idunno_tpu.comm.message import Message
+from idunno_tpu.comm.retry import call_with_retry
 from idunno_tpu.comm.transport import Transport, TransportError
 from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.epoch import (check_payload, observe_payload,
+                                         reply_is_stale)
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.scheduler.fair import FairScheduler
 from idunno_tpu.scheduler.tasks import Task, WORKING
@@ -81,7 +85,7 @@ class InferenceService:
         self.scheduler = scheduler or FairScheduler(config, clock=clock)
         self.dataset_root = dataset_root
         # synchronous standby write-ahead invoked at the end of every
-        # master-side submit as wal_hook(model, qnum, tasks, dataset)
+        # master-side submit as wal_hook(model, qnum, tasks, dataset, idem)
         # (serve/node.py wires it to FailoverManager.wal_append);
         # None = periodic-only replication
         self.wal_hook = None
@@ -97,6 +101,10 @@ class InferenceService:
         # failed) somewhere — it is not cold-compiling, so the straggler
         # monitor's first-compile grace must not shield it
         self._task_errors: dict[str, int] = {}
+        # client idempotency keys → booked qnum: a retry after a lost ACK
+        # returns the original booking instead of double-submitting
+        # (replicated in the failover snapshot + WAL deltas)
+        self._idem: dict[str, int] = {}
         self._results_lock = threading.RLock()
 
         # worker state
@@ -116,25 +124,43 @@ class InferenceService:
     # ------------------------------------------------------------------ #
 
     def _master_call(self, msg: Message) -> Message:
-        """Primary→standby failover (`send_inference_command`, `:956-963`)."""
+        """Primary→standby failover (`send_inference_command`, `:956-963`)
+        — plus bounded backoff retries per target (safe: the message
+        carries an idempotency key, so a retry after a lost ACK dedupes
+        server-side) and fence-aware rerouting: a "not acting master" /
+        stale-epoch rejection moves on to the next target instead of
+        failing the submit."""
         targets = [self.membership.acting_master()]
-        if self.config.standby_coordinator not in targets:
-            targets.append(self.config.standby_coordinator)
-        last: Exception | None = None
+        for t in (self.config.coordinator, self.config.standby_coordinator):
+            if t not in targets:
+                targets.append(t)
+        last: object = None
         for t in targets:
             if t == self.host:
                 out = self._handle_inference(SERVICE, msg)
             else:
                 try:
-                    out = self.transport.call(t, SERVICE, msg, timeout=30.0)
+                    out = call_with_retry(
+                        lambda t=t: self.transport.call(t, SERVICE, msg,
+                                                        timeout=30.0),
+                        attempts=self.config.rpc_retry_attempts,
+                        base_s=self.config.rpc_retry_base_s,
+                        cap_s=self.config.rpc_retry_cap_s,
+                        deadline_s=self.config.rpc_retry_deadline_s)
                 except TransportError as e:
                     last = e
                     continue
-            if out is not None:
-                if out.type is MessageType.ERROR:
-                    raise InferenceServiceError(
-                        out.payload.get("error", "inference error"))
-                return out
+            if out is None:
+                continue
+            observe_payload(self.membership.epoch, out.payload)
+            if out.type is MessageType.ERROR:
+                if out.payload.get("not_master") \
+                        or out.payload.get("stale_epoch"):
+                    last = out.payload.get("error")
+                    continue        # deposed/unfenced peer: try the next
+                raise InferenceServiceError(
+                    out.payload.get("error", "inference error"))
+            return out
         raise InferenceServiceError(f"no reachable coordinator: {last}")
 
     def submit_query(self, model: str, start: int, end: int,
@@ -143,10 +169,15 @@ class InferenceService:
         ``dataset`` overrides this node's default root for the query —
         e.g. ``store://<name>`` resolves against a dataset published into
         the replicated store on every worker (`engine.data_store`)."""
+        # one idempotency key per LOGICAL submit, constant across every
+        # retry/failover attempt inside _master_call: a lost ACK retried
+        # against the same (or the newly adopted) master returns the
+        # original qnum instead of booking twice
         out = self._master_call(Message(
             MessageType.INFERENCE, self.host,
             {"model": model, "start": start, "end": end,
-             "dataset": dataset or self.dataset_root}))
+             "dataset": dataset or self.dataset_root,
+             "idem": f"{self.host}:{uuid.uuid4().hex}"}))
         return int(out.payload["qnum"])
 
     def inference(self, model: str, start: int, end: int,
@@ -216,12 +247,20 @@ class InferenceService:
         if msg.type is MessageType.INFERENCE:      # client submission
             if not self.membership.is_acting_master:
                 return Message(MessageType.ERROR, self.host,
-                               {"error": f"{self.host} not acting master"})
+                               {"error": f"{self.host} not acting master",
+                                "not_master": True})
             p = msg.payload
             return self._master_submit(p["model"], int(p["start"]),
-                                       int(p["end"]), p.get("dataset"))
+                                       int(p["end"]), p.get("dataset"),
+                                       idem=p.get("idem"))
         if msg.type is MessageType.JOB:            # dispatched task
             p = msg.payload
+            # fence: a JOB stamped below our epoch high-water comes from a
+            # deposed coordinator — reject (typed), never enqueue; the
+            # reply deposes the sender
+            stale = check_payload(self.membership.epoch, p, self.host)
+            if stale is not None:
+                return stale
             with self._jobs_lock:
                 self._jobs.append(Job(model=p["model"], qnum=int(p["qnum"]),
                                       assigned=float(p.get("assigned", 0.0)),
@@ -234,17 +273,31 @@ class InferenceService:
                        {"error": f"bad inference verb {msg.type}"})
 
     def _master_submit(self, model: str, start: int, end: int,
-                       dataset: str | None) -> Message:
+                       dataset: str | None,
+                       idem: str | None = None) -> Message:
+        workers = self._eligible_workers()     # before reserving the idem
+        # key: a failed submit must stay retryable as a fresh booking
+        if not workers:
+            return Message(MessageType.ERROR, self.host,
+                           {"error": "no alive workers"})
         with self._results_lock:                 # _qnum guarded like results
+            # idempotency: check-and-reserve under the same lock as the
+            # qnum bump, so two concurrent retries of one logical submit
+            # can't both book (the first wins, the second reads its qnum)
+            if idem is not None and idem in self._idem:
+                return Message(MessageType.ACK, self.host,
+                               {"qnum": self._idem[idem],
+                                "duplicate": True})
             self.scheduler.avg_query_time = {
                 m: self.metrics.avg_query_time(m)
                 for m in set(self._qnum) | {model}}
             qnum = self._qnum.get(model, 0) + 1
             self._qnum[model] = qnum
-        workers = self._eligible_workers()
-        if not workers:
-            return Message(MessageType.ERROR, self.host,
-                           {"error": "no alive workers"})
+            if idem is not None:
+                self._idem[idem] = qnum
+                if len(self._idem) > 4096:     # bounded: oldest keys fall
+                    for k in list(self._idem)[:1024]:
+                        del self._idem[k]
         tasks = self.scheduler.assign(model, qnum, start, end, workers,
                                       dataset=dataset)
         for t in tasks:
@@ -256,8 +309,23 @@ class InferenceService:
         # full snapshot, so the ack path stays O(1); best-effort when the
         # standby is down, like the periodic loop; wired by serve/node.py)
         if self.wal_hook is not None:
-            self.wal_hook(model, qnum, tasks, dataset)
+            self.wal_hook(model, qnum, tasks, dataset, idem)
         return Message(MessageType.ACK, self.host, {"qnum": qnum})
+
+    # -- idempotency-map replication glue (FailoverManager) ---------------
+
+    def record_idem(self, idem: str, qnum: int) -> None:
+        with self._results_lock:
+            self._idem[idem] = int(qnum)
+
+    def idem_to_wire(self) -> dict[str, int]:
+        with self._results_lock:
+            return dict(self._idem)
+
+    def idem_load_wire(self, wire: dict[str, int]) -> None:
+        with self._results_lock:
+            for k, v in wire.items():
+                self._idem.setdefault(k, int(v))
 
     def _eligible_workers(self) -> list[str]:
         """All alive hosts serve as workers, the coordinator included
@@ -282,12 +350,21 @@ class InferenceService:
                           {"model": task.model, "qnum": task.qnum,
                            "start": task.start, "end": task.end,
                            "dataset": task.dataset,
-                           "assigned": stamp})
+                           "assigned": stamp,
+                           "epoch": list(self.membership.epoch.view())})
             if worker == self.host:
                 self._handle_inference(SERVICE, msg)
                 return
             try:
-                self.transport.call(worker, SERVICE, msg, timeout=30.0)
+                out = self.transport.call(worker, SERVICE, msg,
+                                          timeout=30.0)
+                if reply_is_stale(self.membership.epoch, out):
+                    # the worker has seen a higher epoch: we are deposed.
+                    # Step down — do NOT treat this as a dead worker and
+                    # re-dispatch (that is exactly the split-brain double
+                    # execution fencing exists to prevent); the real
+                    # master owns this task now.
+                    return
                 return
             except TransportError:
                 tried.add(worker)
@@ -310,13 +387,20 @@ class InferenceService:
         """Acting master accumulates results + metrics (`:623-704`);
         error reports from workers re-dispatch the task immediately."""
         p = msg.payload
+        # observe (never reject) the worker's fence view: the work itself
+        # is valid at any epoch (the book dedupes), but a result stamped
+        # ABOVE our view means we were deposed while partitioned — the
+        # observe demotes us and the is_acting_master checks below hand
+        # the result back to the worker for the real master
+        observe_payload(self.membership.epoch, p)
         model, qnum = p["model"], int(p["qnum"])
         start, end = int(p["start"]), int(p["end"])
         if p.get("error"):
             if not self.membership.is_acting_master:
                 # keep the report queued worker-side for the real master
                 return Message(MessageType.ERROR, self.host,
-                               {"error": f"{self.host} not acting master"})
+                               {"error": f"{self.host} not acting master",
+                                "not_master": True})
             assigned = float(p.get("assigned", 0.0))
             task = next(
                 (t for t in self.scheduler.book.in_flight(msg.sender)
@@ -352,7 +436,8 @@ class InferenceService:
             # adoption): refuse, so the worker keeps the result queued
             # instead of believing it was delivered.
             return Message(MessageType.ERROR, self.host,
-                           {"error": f"{self.host} has no record of task"})
+                           {"error": f"{self.host} has no record of task",
+                            "not_master": True})
         records = [tuple(r) for r in p["records"]]
         with self._results_lock:
             self._results.setdefault((model, qnum), []).extend(records)
@@ -567,6 +652,10 @@ class InferenceService:
         """Send a computed RESULT to the acting master (standby fallback);
         queue the *message* for retry on failure — the inference itself is
         never re-executed."""
+        # stamp OUR fence view per delivery attempt (it may have advanced
+        # since the job executed): a deposed master receiving it observes
+        # the higher epoch and steps down
+        msg.payload["epoch"] = list(self.membership.epoch.view())
         master = self.membership.acting_master()
         targets = [master]
         if self.config.standby_coordinator not in targets:

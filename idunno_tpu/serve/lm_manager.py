@@ -45,6 +45,7 @@ from typing import Any
 from idunno_tpu.comm.message import Message
 from idunno_tpu.comm.transport import Transport, TransportError
 from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.epoch import StaleEpoch, reply_is_stale
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.serve.admission import PRIORITIES, shed_reason
 from idunno_tpu.utils.types import MemberStatus, MessageType
@@ -162,13 +163,23 @@ class LMPoolManager:
     def _call(self, node: str, payload: dict[str, Any],
               timeout: float = 30.0) -> dict[str, Any]:
         """Control RPC to a node's LOCAL lm tier (``local``=True keeps the
-        receiving dispatcher from routing back into its own manager)."""
-        payload = dict(payload, local=True)
+        receiving dispatcher from routing back into its own manager).
+        Stamped with this manager's epoch view: a node that has seen a
+        higher epoch fences us with StaleEpoch (a TransportError subclass,
+        so every catch-site treats it as transient — requests stay
+        pending/journal-safe — while the observe demotes this node and the
+        pump stops on its next is_acting_master gate)."""
+        payload = dict(payload, local=True,
+                       epoch=list(self.membership.epoch.view()))
         reply = self.transport.call(
             node, CONTROL, Message(MessageType.INFERENCE, self.host,
                                    payload), timeout=timeout)
         if reply is None:
             raise TransportError(f"no reply from {node}")
+        if reply_is_stale(self.membership.epoch, reply):
+            e, owner = self.membership.epoch.view()
+            raise StaleEpoch(f"{node} fenced this manager: epoch {e} "
+                             f"owned by {owner}", e, owner)
         if reply.type is MessageType.ERROR:
             raise ValueError(f"{node}: {reply.payload.get('error')}")
         return reply.payload
@@ -196,6 +207,11 @@ class LMPoolManager:
             entry = {"spec": dict(spec), "node": None,
                      "_recovering": True,
                      "next_rid": 0, "requests": {},
+                     # client idempotency keys → rid: a client retrying a
+                     # submit whose ACK was lost gets its ORIGINAL rid
+                     # back instead of double-journaling (replicated with
+                     # the journal so the dedupe survives failover)
+                     "idem": {},
                      "done_total": 0, "failed_total": 0,
                      "cancelled_total": 0,
                      "shed_total": 0, "expired_total": 0,
@@ -257,7 +273,8 @@ class LMPoolManager:
                stop: list[list[int]] | None = None,
                seed: int | None = None,
                tenant: str = "default", priority: str = "interactive",
-               deadline_ms: float | None = None) -> int:
+               deadline_ms: float | None = None,
+               idem_key: str | None = None) -> int:
         """Journal a request (seed pinned NOW — replay after any failure
         must be token-exact even for sampled requests), then forward it to
         the pool's node. Forward failures leave it pending; the pump
@@ -276,6 +293,12 @@ class LMPoolManager:
             if pool is None:
                 raise ValueError(f"no managed pool {name!r}; "
                                  "lm_serve (placement=auto) first")
+            if idem_key is not None:
+                prior = pool.setdefault("idem", {}).get(idem_key)
+                if prior is not None:
+                    # client retry of an already-journaled submit (its ACK
+                    # was lost): same booking, exactly-once
+                    return int(prior)
             rid = pool["next_rid"]
             pool["next_rid"] += 1
             req = {"prompt": [int(t) for t in prompt],
@@ -301,6 +324,8 @@ class LMPoolManager:
                    "t_forwarded": None, "attempts": 0,
                    "t_submitted": time.time()}
             pool["requests"][rid] = req
+            if idem_key is not None:
+                pool["idem"][idem_key] = rid
             node = pool["node"]
         if node is not None:
             self._forward(name, node, rid, req)
@@ -322,7 +347,13 @@ class LMPoolManager:
                 "tenant": req.get("tenant", "default"),
                 "priority": req.get("priority", "interactive"),
                 "deadline_ms": req.get("deadline_ms"),
-                "readmit": bool(req.get("admitted"))})
+                "readmit": bool(req.get("admitted")),
+                # node-side dedupe for a LOST-REPLY retry: attempts counts
+                # prior successful forwards, so the pump's re-forward after
+                # a dropped ACK reuses the key (the node returns its
+                # existing row), while a watchdog requeue — attempts
+                # already bumped — gets a fresh key and books a fresh row
+                "idem": f"{name}:{rid}:{req['attempts']}"})
         except (TransportError, OSError):
             return                      # stays pending; pump will retry
         except ValueError as e:
@@ -404,9 +435,15 @@ class LMPoolManager:
             # prune what the PREVIOUS poll delivered: the journal (and
             # every standby snapshot) stays bounded by requests in flight
             # plus one delivered batch
+            pruned = set()
             for rid in [r for r, q in pool["requests"].items()
                         if q["delivered"]]:
                 del pool["requests"][rid]
+                pruned.add(rid)
+            if pruned and pool.get("idem"):
+                # idempotency keys age out with the requests they booked
+                pool["idem"] = {k: r for k, r in pool["idem"].items()
+                                if r not in pruned}
             out, errors, cancelled = [], [], []
             shed, expired = [], []
             for rid, req in sorted(pool["requests"].items()):
@@ -1164,6 +1201,7 @@ class LMPoolManager:
                                               in p["svc_samples"]],
                               "slots_now": p["slots_now"],
                               "slots_cap": p["slots_cap"],
+                              "idem": dict(p.get("idem", {})),
                               "requests": {str(rid): dict(r) for rid, r
                                            in p["requests"].items()}}
                           for n, p in self._pools.items()},
@@ -1196,6 +1234,8 @@ class LMPoolManager:
                         p["spec"].get("slots", _default_slots()))),
                     "slots_target_prev": None,
                     "t_last_resize": 0.0,
+                    "idem": {k: int(v) for k, v
+                             in p.get("idem", {}).items()},
                     # defaults first: a snapshot from an older master may
                     # predate the watchdog/measurement fields
                     "requests": {int(rid): {"t_forwarded": None,

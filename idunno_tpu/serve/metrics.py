@@ -64,8 +64,22 @@ class MetricsTracker:
         # reject rate, queue-wait p50/p99 — serve/gateway.py); the gateway
         # keeps its own windows, these are the flattened readback
         self._gw_gauges: dict[str, dict] = {}
+        # named event counters (wal_skipped_standby_down, stale-epoch
+        # rejections, …) — node-LOCAL observability, deliberately not
+        # replicated in to_wire/load_wire: a counter describes what THIS
+        # node saw, adopting another node's count would double-report
+        self._counters: dict[str, int] = {}
 
     # -- recording --------------------------------------------------------
+
+    def record_counter(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + int(n)
+            return self._counters[name]
+
+    def counters(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
 
     def record_task(self, model: str, n_items: int, elapsed_s: float,
                     batch_size: int) -> None:

@@ -29,11 +29,15 @@ import json
 import os
 import re
 import threading
+import uuid
 import zlib
 
 from idunno_tpu.comm.message import Message
+from idunno_tpu.comm.retry import call_with_retry
 from idunno_tpu.comm.transport import Transport, TransportError
 from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.epoch import (check_payload, observe_payload,
+                                         reply_is_stale)
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.utils.ring import ring_order
 from idunno_tpu.utils.types import MemberStatus, MessageType
@@ -151,6 +155,11 @@ class FileStoreService:
         self._meta_lock = threading.RLock()
         self._versions: dict[str, int] = {}
         self._locations: dict[str, set[str]] = {}
+        # client put idempotency keys → (version, holders): a retried put
+        # whose ACK was lost returns its ORIGINAL version instead of
+        # writing (and versioning) the blob twice. Recorded only on
+        # success — a failed put must stay retryable.
+        self._put_idem: dict[str, tuple[int, list[str]]] = {}
         # serializes death-event repairs (rebuild + re-replication) so two
         # quick successive deaths don't interleave their copy passes; the
         # repairs themselves run OFF the membership monitor loop
@@ -181,23 +190,41 @@ class FileStoreService:
 
     def _master_call(self, msg: Message) -> Message:
         """Primary→standby failover, like `send_inference_command`
-        (`:956-963`)."""
+        (`:956-963`) — but each hop retries transient TransportErrors with
+        bounded backoff (comm/retry.py; safe because the mutating verb,
+        put, carries a client idempotency key), and a target that answers
+        "not master" or "stale epoch" is skipped, not fatal: during a
+        failover window the route advances to whoever actually holds the
+        current epoch."""
+        cfg = self.config
         master = self.membership.acting_master()
         targets = [master]
-        if self.config.standby_coordinator not in targets:
-            targets.append(self.config.standby_coordinator)
-        last: Exception | None = None
+        for t in (cfg.coordinator, cfg.standby_coordinator):
+            if t not in targets:
+                targets.append(t)
+        last: Exception | str | None = None
         for t in targets:
             if t == self.host:
                 out = self._handle_as_master(msg)
             else:
                 try:
-                    out = self.transport.call(t, SERVICE, msg, timeout=30.0)
+                    out = call_with_retry(
+                        lambda t=t: self.transport.call(t, SERVICE, msg,
+                                                        timeout=30.0),
+                        attempts=cfg.rpc_retry_attempts,
+                        base_s=cfg.rpc_retry_base_s,
+                        cap_s=cfg.rpc_retry_cap_s,
+                        deadline_s=cfg.rpc_retry_deadline_s)
                 except TransportError as e:
                     last = e
                     continue
             if out is not None:
+                observe_payload(self.membership.epoch, out.payload)
                 if out.type is MessageType.ERROR:
+                    if out.payload.get("not_master") \
+                            or out.payload.get("stale_epoch"):
+                        last = out.payload.get("error", "not master")
+                        continue
                     raise StoreError(out.payload.get("error", "store error"))
                 return out
         raise StoreError(f"no reachable master: {last}")
@@ -209,8 +236,13 @@ class FileStoreService:
         return self.put_bytes(sdfs_name, blob)
 
     def put_bytes(self, sdfs_name: str, blob: bytes) -> int:
+        # one idempotency key for the whole attempt tree: every retry of
+        # this logical put (transport-level AND the failover hop to the
+        # standby) dedupes to one version bump server-side
+        idem = f"{self.host}:{uuid.uuid4().hex}"
         out = self._master_call(Message(MessageType.PUT, self.host,
-                                        {"name": sdfs_name}, blob=blob))
+                                        {"name": sdfs_name, "idem": idem},
+                                        blob=blob))
         return int(out.payload["version"])
 
     def get(self, sdfs_name: str, local_path: str) -> int:
@@ -274,6 +306,12 @@ class FileStoreService:
         return Message(MessageType.ERROR, self.host, {"error": text})
 
     def _handle_internal(self, msg: Message) -> Message | None:
+        # internal verbs are master-originated and epoch-stamped: a push
+        # or delete from a deposed master is rejected here, so a healed
+        # partition cannot overwrite replicas with the old master's writes
+        stale = check_payload(self.membership.epoch, msg.payload, self.host)
+        if stale is not None:
+            return stale
         if msg.type is MessageType.STORE:      # inventory query (rebuild)
             return Message(MessageType.ACK, self.host,
                            {"files": self.local.files(),
@@ -294,10 +332,13 @@ class FileStoreService:
 
     def _handle_as_master(self, msg: Message) -> Message:
         if not self.membership.is_acting_master:
-            return self._err(f"{self.host} is not the acting master")
+            out = self._err(f"{self.host} is not the acting master")
+            out.payload["not_master"] = True     # route on, don't fail
+            return out
         name = msg.payload.get("name", "")
         if msg.type is MessageType.PUT:
-            return self._master_put(name, msg.blob)
+            return self._master_put(name, msg.blob,
+                                    idem=msg.payload.get("idem"))
         if msg.type is MessageType.GET:
             want = msg.payload.get("version")
             return self._master_get(name,
@@ -321,15 +362,24 @@ class FileStoreService:
 
     # -- master verb implementations --------------------------------------
 
-    def _master_put(self, name: str, blob: bytes) -> Message:
+    def _master_put(self, name: str, blob: bytes,
+                    idem: str | None = None) -> Message:
         with self._meta_lock:
+            if idem is not None and idem in self._put_idem:
+                # client retry of an already-completed put (lost ACK):
+                # same version, no second replica push
+                version, hosts = self._put_idem[idem]
+                return Message(MessageType.ACK, self.host,
+                               {"version": version, "hosts": hosts,
+                                "duplicate": True})
             # monotone across delete/re-put so tombstones stay meaningful
             version = max(self._versions.get(name, 0),
                           self.local.tombstones().get(name, 0)) + 1
             self._versions[name] = version       # reserve
         replicas = self._replica_hosts(name)
         push = Message(MessageType.PUT, self.host,
-                       {"name": name, "version": version, "internal": True},
+                       {"name": name, "version": version, "internal": True,
+                        "epoch": list(self.membership.epoch.view())},
                        blob=blob)
         stored: set[str] = set()
         for h in replicas:                        # network I/O — no lock held
@@ -338,15 +388,24 @@ class FileStoreService:
                 stored.add(h)
                 continue
             try:
-                if self.transport.call(h, SERVICE, push,
-                                       timeout=30.0) is not None:
-                    stored.add(h)
+                out = self.transport.call(h, SERVICE, push, timeout=30.0)
             except TransportError:
                 continue
+            if reply_is_stale(self.membership.epoch, out):
+                # a replica fenced us mid-push: we are deposed — abort
+                # rather than keep spraying a dead epoch's write
+                return self._err("deposed mid-put (stale epoch)")
+            if out is not None:
+                stored.add(h)
         if not stored:
             return self._err("no replica stored")
         with self._meta_lock:
             self._locations.setdefault(name, set()).update(stored)
+            if idem is not None:
+                if len(self._put_idem) >= 4096:   # bound the dedupe map
+                    for k in list(self._put_idem)[:1024]:
+                        del self._put_idem[k]
+                self._put_idem[idem] = (version, sorted(stored))
         return Message(MessageType.ACK, self.host,
                        {"version": version, "hosts": sorted(stored)})
 
@@ -356,7 +415,8 @@ class FileStoreService:
         if blob is not None:
             return blob
         req = Message(MessageType.GET, self.host,
-                      {"name": name, "version": version, "internal": True})
+                      {"name": name, "version": version, "internal": True,
+                       "epoch": list(self.membership.epoch.view())})
         for h in sorted(holders):
             if h == self.host:
                 continue
@@ -412,7 +472,8 @@ class FileStoreService:
         # tombstone + remove on EVERY alive host (not just known holders) so
         # stale replicas can't resurrect the file at metadata rebuild.
         req = Message(MessageType.DELETE, self.host,
-                      {"name": name, "version": version, "internal": True})
+                      {"name": name, "version": version, "internal": True,
+                       "epoch": list(self.membership.epoch.view())})
         self.local.delete(name, version)
         for h in self.membership.members.alive_hosts():
             if h == self.host:
@@ -481,7 +542,9 @@ class FileStoreService:
         every alive host's inventory + tombstones (replaces the reference's
         lossy 1 Hz metadata broadcast for file state). A file is live iff
         some replica's max version exceeds the newest tombstone."""
-        req = Message(MessageType.STORE, self.host, {"internal": True})
+        req = Message(MessageType.STORE, self.host,
+                      {"internal": True,
+                       "epoch": list(self.membership.epoch.view())})
         inventories: dict[str, dict[str, list[int]]] = {
             self.host: self.local.files()}
         tombs: dict[str, int] = dict(self.local.tombstones())
@@ -547,7 +610,8 @@ class FileStoreService:
             if blob is None:
                 continue
             push = Message(MessageType.PUT, self.host,
-                           {"name": name, "version": v, "internal": True},
+                           {"name": name, "version": v, "internal": True,
+                            "epoch": list(self.membership.epoch.view())},
                            blob=blob)
             try:
                 if target == self.host:

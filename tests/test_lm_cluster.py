@@ -389,3 +389,116 @@ def test_gateway_pool_churn_replays_only_admitted(tmp_path):
     finally:
         for n in nodes.values():
             n.stop()
+
+
+def test_gateway_pool_survives_coordinator_partition(tmp_path):
+    """ISSUE 5: partition the COORDINATOR (not the pool's node) away from
+    a gateway-fronted managed pool. The standby must promote behind the
+    epoch fence, adopt the journal, and finish every admitted request
+    token-exact — replays carry readmit=True, so admitted-but-unfinished
+    work from a rate-capped tenant bypasses the drained token bucket (the
+    client was already told it was in). After the heal the deposed
+    coordinator is fenced: its managed verbs are refused, its stale-epoch
+    pump traffic is rejected, and it never acts as master again."""
+    net = InProcNetwork()
+    cfg, nodes = _cluster(tmp_path, net)
+    try:
+        model, params = _tiny_lm(nodes["n0"].store)
+        master = nodes["n0"]
+
+        out = _call(master, {"verb": "lm_serve", "placement": "auto",
+                             "name": "klm", "slots": 2, "prompt_len": 4,
+                             "max_len": 16,
+                             "gateway": {
+                                 "interactive_wait_slack": 50.0,
+                                 "batch_wait_slack": 50.0,
+                                 "tenants": {"capped": {"rate": 0,
+                                                        "burst": 2}}}})
+        assert out["node"] == "n2", out
+
+        rng = np.random.default_rng(5)
+        want = {}
+
+        def submit(node, tenant="free"):
+            prompt = [int(t) for t in rng.integers(0, 32, size=4)]
+            rid = _call(node, {"verb": "lm_submit", "name": "klm",
+                               "prompt": prompt, "max_new": 6,
+                               "tenant": tenant})["id"]
+            ref = generate(model, params, jnp.asarray([prompt], jnp.int32),
+                           prompt_len=4, max_new=6)
+            want[rid] = [int(t) for t in np.asarray(ref[0])]
+            return rid
+
+        for _ in range(3):
+            submit(master)
+        capped = [submit(master, tenant="capped") for _ in range(3)]
+        shed_rid = capped[2]              # burst=2: the third is shed
+        want.pop(shed_rid)
+
+        done, shed = {}, {}
+
+        def drain(node):
+            out = _call(node, {"verb": "lm_poll", "name": "klm"})
+            for c in out["completions"]:
+                done[c["id"]] = c["tokens"]
+            for s in out.get("shed", ()):
+                shed[s["id"]] = s["reason"]
+
+        deadline = time.time() + 90.0
+        while time.time() < deadline and shed_rid not in shed:
+            drain(master)
+            time.sleep(0.05)
+        assert shed == {shed_rid: "quota"}, shed
+        # let one replication period carry the journal (incl. the shed's
+        # terminal state and the capped admissions) to the standby
+        time.sleep(3 * cfg.metadata_interval_s)
+
+        # isolate the coordinator: the pool's node stays up on the
+        # majority side with the standby
+        net.partition("n0", "n1")
+        net.partition("n0", "n2")
+        deadline = time.time() + 30.0
+        while time.time() < deadline and \
+                not nodes["n1"].membership.is_acting_master:
+            time.sleep(0.05)
+        assert nodes["n1"].membership.is_acting_master
+        epoch, owner = nodes["n1"].membership.epoch.view()
+        assert epoch >= 1 and owner == "n1"
+
+        # the new master's journal accepts fresh work mid-partition
+        for _ in range(2):
+            submit(nodes["n1"])
+
+        deadline = time.time() + 120.0
+        while time.time() < deadline and len(done) < len(want):
+            drain(nodes["n1"])
+            time.sleep(0.05)
+        assert sorted(done) == sorted(want), \
+            f"done {sorted(done)} != admitted {sorted(want)}"
+        for rid, toks in want.items():
+            assert done[rid] == toks, f"request {rid} not exact"
+
+        st = _call(nodes["n1"], {"verb": "lm_stats", "name": "klm"})["stats"]
+        assert st["journal"]["shed"] == 1, st      # readmit: never re-shed
+
+        # heal: gossip must fence the deposed coordinator
+        net.heal("n0", "n1")
+        net.heal("n0", "n2")
+        deadline = time.time() + 30.0
+        while time.time() < deadline and (
+                nodes["n0"].membership.is_acting_master
+                or nodes["n0"].membership.epoch.view()[1] != "n1"):
+            time.sleep(0.05)
+        assert not nodes["n0"].membership.is_acting_master
+        assert nodes["n0"].membership.epoch.view() == (epoch, "n1")
+
+        # a managed verb on the deposed coordinator is refused outright —
+        # its divergent journal must never take bookings again
+        out = nodes["n0"].control._handle("control", Message(
+            MessageType.INFERENCE, "client",
+            {"verb": "lm_stats", "name": "klm"}))
+        assert out.type is MessageType.ERROR, out.payload
+        assert "acting master" in out.payload["error"], out.payload
+    finally:
+        for n in nodes.values():
+            n.stop()

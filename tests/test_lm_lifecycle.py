@@ -3,6 +3,8 @@ replicated store → restore on a DIFFERENT node → KV-cached generation —
 plus rollback to a historical version. Exercises engine/train_lm,
 engine/checkpoint, store/sdfs and engine/generate together, the workflow
 the reference could never do (no checkpointing, no sequence models)."""
+from types import SimpleNamespace
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -18,6 +20,7 @@ from idunno_tpu.engine.generate import generate
 from idunno_tpu.engine.train import flat_tx
 from idunno_tpu.engine.train_lm import (
     create_lm_train_state, make_lm_train_step)
+from idunno_tpu.membership.epoch import EpochFence
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.models.transformer import TransformerLM
 from idunno_tpu.store.sdfs import FileStoreService
@@ -71,6 +74,8 @@ def test_lm_served_through_cluster_control(stores, tmp_path):
 
     # serve over the control RPC from a node wired to n2's store
     node = type("NodeStub", (), {})()
+    # minimal fence surface for ControlService._handle's epoch check
+    node.membership = SimpleNamespace(epoch=EpochFence())
     node.host, node.store = "n2", stores["n2"]
     node.transport = stores["n2"].transport
     ctl = ControlService(node)
@@ -218,6 +223,8 @@ def test_continuous_batching_served_over_control_rpc(stores):
     save_lm(stores["n0"], "pool", model, params)
 
     node = type("NodeStub", (), {})()
+    # minimal fence surface for ControlService._handle's epoch check
+    node.membership = SimpleNamespace(epoch=EpochFence())
     node.host, node.store = "n2", stores["n2"]
     node.transport = stores["n2"].transport
     ctl = ControlService(node)
@@ -308,6 +315,8 @@ def test_speculative_pool_over_rpc(stores):
     save_lm(stores["n0"], "spec-draft", draft, dparams)
 
     node = type("NodeStub", (), {})()
+    # minimal fence surface for ControlService._handle's epoch check
+    node.membership = SimpleNamespace(epoch=EpochFence())
     node.host, node.store = "n1", stores["n1"]
     node.transport = stores["n1"].transport
     ctl = ControlService(node)
@@ -366,6 +375,8 @@ def test_train_job_over_rpc_then_serve(stores):
                 np.tile(pattern, 400).astype(np.int32))
 
     node = type("NodeStub", (), {})()
+    # minimal fence surface for ControlService._handle's epoch check
+    node.membership = SimpleNamespace(epoch=EpochFence())
     node.host, node.store = "n1", stores["n1"]
     node.transport = stores["n1"].transport
     ctl = ControlService(node)
@@ -619,6 +630,8 @@ def test_int8_kv_cache_pool_over_rpc(stores):
     save_lm(stores["n0"], "kv8", model, params)
 
     node = type("NodeStub", (), {})()
+    # minimal fence surface for ControlService._handle's epoch check
+    node.membership = SimpleNamespace(epoch=EpochFence())
     node.host, node.store = "n1", stores["n1"]
     node.transport = stores["n1"].transport
     ctl = ControlService(node)
@@ -671,6 +684,8 @@ def test_bad_kv_cache_dtype_does_not_kill_live_pool(stores):
     save_lm(stores["n0"], "kvbad", model, params)
 
     node = type("NodeStub", (), {})()
+    # minimal fence surface for ControlService._handle's epoch check
+    node.membership = SimpleNamespace(epoch=EpochFence())
     node.host, node.store = "n1", stores["n1"]
     node.transport = stores["n1"].transport
     ctl = ControlService(node)

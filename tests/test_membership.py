@@ -243,3 +243,35 @@ def test_isolated_coordinator_converges_after_heal(cluster):
     for h in cfg.hosts:
         assert sorted(services[h].members.alive_hosts()) == \
             sorted(cfg.hosts), f"{h} view did not converge"
+
+
+def test_delayed_pongs_false_leave_then_refute(cluster):
+    """Delay (not loss): every n3→n0 datagram is held, so the master sees
+    2+ s of silence and marks n3 LEAVE — a false positive the detector
+    cannot distinguish from death. When the late pongs finally land they
+    must NOT resurrect n3 (their timestamps lose the merge against the
+    newer verdict); only n3's own refutation — stamped above the verdict —
+    converges every view back to RUNNING."""
+    cfg, net, clock, services = cluster
+    pump(services, clock)
+    net.set_chaos(delay=1.0, max_delay=100_000, seed=42,
+                  links={("n3", "n0")})
+    # pings keep flowing n0→n3; the pongs pile up in the held queue
+    pump(services, clock, waves=8)           # 2.4 s of apparent silence
+    services["n0"].monitor_once()
+    assert not services["n0"].members.is_alive("n3")
+    pump(services, clock, waves=1)           # verdict gossips outward
+    assert not services["n2"].members.is_alive("n3")
+
+    net.clear_chaos()
+    net.flush_held()                         # the late pongs arrive NOW
+    # stale pongs alone must not clear the suspicion: n3's list in them
+    # predates the LEAVE verdict, and the merge keeps the newer stamp
+    assert not services["n0"].members.is_alive("n3")
+
+    pump(services, clock, waves=1)           # n3 hears the verdict...
+    services["n3"].monitor_once()            # ...and refutes it
+    assert services["n3"].members.is_alive("n3")
+    pump(services, clock, waves=2)
+    for h in cfg.hosts:
+        assert services[h].members.is_alive("n3"), h

@@ -59,9 +59,29 @@ def test_unreachable_raises(pair):
         ta.call("h7", "store", Message(MessageType.GET, "h0"), timeout=0.5)
 
 
-def test_call_without_handler_returns_none(pair):
+def test_call_without_handler_raises_closed(pair):
+    """No handler → server sends no reply frame → typed ``closed`` error
+    (matches InProcTransport, which raises for a missing service)."""
     ta, tb = pair
-    assert ta.call("h1", "nosuch", Message(MessageType.GET, "h0")) is None
+    with pytest.raises(TransportError) as ei:
+        ta.call("h1", "nosuch", Message(MessageType.GET, "h0"))
+    assert ei.value.reason == "closed" and ei.value.retryable
+
+
+def test_typed_reasons_refused_and_timeout(pair):
+    """The retry layer distinguishes retryable transport faults by reason:
+    nothing listening → refused; handler slower than the client deadline →
+    timeout (comm/retry.py backs off on both)."""
+    import time as _time
+    ta, tb = pair
+    with pytest.raises(TransportError) as ei:
+        ta.call("h9", "store", Message(MessageType.GET, "h0"), timeout=0.5)
+    assert ei.value.reason in ("refused", "unreachable")
+
+    tb.serve("slow", lambda svc, m: _time.sleep(2.0) or None)
+    with pytest.raises(TransportError) as ei:
+        ta.call("h1", "slow", Message(MessageType.GET, "h0"), timeout=0.3)
+    assert ei.value.reason == "timeout" and ei.value.retryable
 
 
 def test_concurrent_oneshot_calls(pair):
@@ -122,9 +142,10 @@ def test_malformed_frame_and_handler_bug_do_not_kill_listener(pair):
         s.shutdown(socket.SHUT_WR)
         assert s.recv(1) == b""          # server dropped the connection
 
-    # 2. handler raises → this client sees a close (call returns None)
-    assert ta.call("h1", "store",
-                   Message(MessageType.PUT, "h0", {"boom": True})) is None
+    # 2. handler raises → this client sees a typed ``closed`` error
+    with pytest.raises(TransportError) as ei:
+        ta.call("h1", "store", Message(MessageType.PUT, "h0", {"boom": True}))
+    assert ei.value.reason == "closed"
 
     # 3. the listener survived both: a good call still round-trips
     out = ta.call("h1", "store", Message(MessageType.PUT, "h0", {}))

@@ -1,0 +1,67 @@
+"""Chaos soak driver: N seeded fault schedules, ONE JSON line out.
+
+Same contract as bench.py: exactly one JSON object on stdout regardless of
+outcome, so a cron/CI wrapper can append it to a ledger. Each schedule is
+an independent `idunno_tpu.chaos.run_seeded_schedule` (full 5-host cluster,
+seeded drop/dup/delay + partitions/isolations, convergence + invariant
+check); a schedule that trips an invariant is recorded, not raised.
+
+    python tools/chaos_soak.py --schedules 25 --steps 40 --seed0 1
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+
+from idunno_tpu.chaos import run_seeded_schedule  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--schedules", type=int, default=10)
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--seed0", type=int, default=1)
+    ap.add_argument("--drop", type=float, default=0.05)
+    ap.add_argument("--dup", type=float, default=0.03)
+    ap.add_argument("--delay", type=float, default=0.10)
+    args = ap.parse_args()
+    logging.disable(logging.WARNING)   # wal-skip warnings are expected
+
+    passed, failures = 0, []
+    worst_convergence = 0.0
+    epochs_total = 0
+    work = {"cnn_acked": 0, "lm_acked": 0, "sdfs_acked": 0}
+    for i in range(args.schedules):
+        seed = args.seed0 + i
+        try:
+            with tempfile.TemporaryDirectory() as d:
+                out = run_seeded_schedule(
+                    seed, d, steps=args.steps,
+                    chaos={"drop": args.drop, "dup": args.dup,
+                           "delay": args.delay, "seed": seed})
+        except Exception as e:  # noqa: BLE001 - invariant trip is data
+            failures.append({"seed": seed, "error":
+                             f"{type(e).__name__}: {e}"[:300]})
+            continue
+        passed += 1
+        worst_convergence = max(worst_convergence, out["convergence_s"])
+        epochs_total += out["epochs"]
+        for k in work:
+            work[k] += out[k]
+    print(json.dumps({
+        "suite": "chaos_soak", "schedules": args.schedules,
+        "steps": args.steps, "passed": passed,
+        "violations": failures,
+        "epochs_minted_total": epochs_total,
+        "worst_convergence_s": round(worst_convergence, 3),
+        **work}))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
