@@ -50,6 +50,7 @@ from idunno_tpu.serve.inference_service import (InferenceService,
 from idunno_tpu.serve.lm_manager import LMPoolManager
 from idunno_tpu.serve.metrics import MetricsTracker
 from idunno_tpu.store.sdfs import FileStoreService, StoreError
+from idunno_tpu.utils.spans import SpanStore, trace_from_payload
 from idunno_tpu.utils.types import MessageType
 
 # services whose handlers are epoch-fenced; the membership service is
@@ -143,7 +144,8 @@ class ChaosControl:
                         int(p["max_new"]),
                         seed=(int(p["seed"])
                               if p.get("seed") is not None else None),
-                        idem_key=p.get("idem"))
+                        idem_key=p.get("idem"),
+                        trace=trace_from_payload(p))
                     return {"id": rid}
                 if verb == "lm_poll":
                     return mgr.poll(name)
@@ -216,8 +218,18 @@ class ChaosCluster:
         self.failovers: dict[str, FailoverManager] = {}
         self.managers: dict[str, LMPoolManager] = {}
         self.controls: dict[str, ChaosControl] = {}
+        # per-host span stores on the FAKE clock: span capture runs through
+        # every chaos schedule (the whole point — traces of the runs that
+        # trip invariants), and fake-clock timestamps make replays of one
+        # seed produce identical waterfalls
+        self.spans: dict[str, SpanStore] = {}
+        # populated by check_invariants on any invariant trip: the last
+        # window of every host's spans, so the failing request's trace is
+        # in hand without re-running the schedule
+        self.last_span_dump: dict[str, list[dict]] | None = None
         for h in self.cfg.hosts:
             t = self.net.transport(h)
+            self.spans[h] = SpanStore(h, clock=self.clock)
             self.members[h] = MembershipService(h, self.cfg, t,
                                                 clock=self.clock)
             self.services[h] = InferenceService(
@@ -228,11 +240,14 @@ class ChaosCluster:
                                         rng=random.Random(seed),
                                         clock=self.clock),
                 clock=self.clock)
+            self.services[h].spans = self.spans[h]
             self.stores[h] = FileStoreService(
                 h, self.cfg, t, self.members[h],
                 os.path.join(data_dir, h))
+            self.stores[h].spans = self.spans[h]
             mgr = LMPoolManager(h, self.cfg, t, self.members[h],
                                 inference_service=self.services[h])
+            mgr.spans = self.spans[h]
             # the fake tier completes instantly: shrink the watchdog so a
             # poll reply lost to chaos re-forwards within the convergence
             # loop instead of after the production 120 s allowance
@@ -557,8 +572,25 @@ class ChaosCluster:
             self.pump_work()
         return got
 
+    def span_dump(self) -> dict[str, list[dict]]:
+        """Every host's current span window (ISSUE 6: chaos-causal
+        dumps) — the raw material `tools/trace_export.py` turns into a
+        Perfetto timeline of the failing schedule."""
+        return {h: s.dump() for h, s in self.spans.items()}
+
     def check_invariants(self) -> dict:
-        """Assert every global invariant; returns a summary dict."""
+        """Assert every global invariant; returns a summary dict. On any
+        trip the full per-host span dump is snapshotted into
+        ``last_span_dump`` BEFORE the assertion propagates, so the failing
+        request's trace (the one named in the assertion message) can be
+        pulled out and exported without replaying the seed."""
+        try:
+            return self._check_invariants()
+        except AssertionError:
+            self.last_span_dump = self.span_dump()
+            raise
+
+    def _check_invariants(self) -> dict:
         assert not self.violations, self.violations
         for e, owners in self.epoch_owners.items():
             assert len(owners) <= 1, \
@@ -617,12 +649,23 @@ def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
     """One full seeded chaos run: schedule -> converge -> invariants.
     Returns the invariant summary plus convergence time."""
     c = ChaosCluster(seed, data_dir)
-    c.run_schedule(steps=steps,
-                   chaos=chaos if chaos is not None
-                   else {"drop": 0.05, "dup": 0.03, "delay": 0.10,
-                         "seed": seed})
-    convergence_s = c.converge()
-    out = c.check_invariants()
+    try:
+        c.run_schedule(steps=steps,
+                       chaos=chaos if chaos is not None
+                       else {"drop": 0.05, "dup": 0.03, "delay": 0.10,
+                             "seed": seed})
+        convergence_s = c.converge()
+        out = c.check_invariants()
+    except Exception as e:
+        # any failure — invariant trip or convergence timeout — carries
+        # the cluster's span windows out with it (chaos-causal dump: the
+        # failing request's trace is in here, replayable from the seed)
+        if c.last_span_dump is None:
+            c.last_span_dump = c.span_dump()
+        e.span_dump = c.last_span_dump
+        raise
     out["convergence_s"] = round(convergence_s, 3)
     out["seed"] = seed
+    out["spans_recorded"] = sum(s.recorded_total()
+                                for s in c.spans.values())
     return out

@@ -80,7 +80,12 @@ HELP = """\
   lm-tail <name>          stream view: live rows' tokens so far
        (+ recent gateway sheds with reasons on gateway pools)
   lm-qos <name>           gateway QoS: per-class queue depth,
-       admit/shed/expire counters, p50/p99 queue wait, per-tenant rows"""
+       admit/shed/expire counters, p50/p99 queue wait, per-tenant rows
+  trace <trace-id> | trace <pool> <req-id> | trace <model> <qnum>
+       cluster-wide span waterfall of one request (collected from every
+       alive node; one line per span: offset, duration, node, name, attrs)
+  metrics [host]          Prometheus text exposition of a node's counters,
+       rates, LM/gateway gauges and span-store depth"""
 
 
 class Shell:
@@ -117,6 +122,8 @@ class Shell:
             "lm-cancel": self.cmd_lm_cancel,
             "lm-tail": self.cmd_lm_tail,
             "lm-qos": self.cmd_lm_qos,
+            "trace": self.cmd_trace,
+            "metrics": self.cmd_metrics,
         }
 
     # -- driver -----------------------------------------------------------
@@ -545,6 +552,7 @@ class Shell:
         out = self._control("lm_partial", name=args[0])
         rows = [f"#{r['id']}: {' '.join(str(t) for t in r['tokens'])} "
                 f"({len(r['tokens']) - r['prompt_len']} generated)"
+                + (f" trace={r['trace']}" if r.get("trace") else "")
                 for r in out["partial"]]
         rows.extend(f"shed: tenant={s['tenant']} {s['priority']} "
                     f"[{s['reason']}] {s['detail']}"
@@ -665,3 +673,59 @@ class Shell:
         out = self._control("lm_stop", name=args[0])
         return (f"stopped {args[0]}" if out["stopped"]
                 else f"no serving pool {args[0]}")
+
+    # -- observability ----------------------------------------------------
+
+    def cmd_trace(self, args: list[str]) -> str:
+        if len(args) not in (1, 2):
+            return ("usage: trace <trace-id> | trace <pool> <req-id> | "
+                    "trace <model> <qnum>")
+        if len(args) == 1:
+            out = self._control("trace", trace_id=args[0])
+        else:
+            try:        # LM pool request first, CNN query as the fallback
+                out = self._control("trace", name=args[0], id=int(args[1]))
+            except Exception:
+                out = self._control("trace", model=args[0],
+                                    qnum=int(args[1]))
+        return format_waterfall(out["trace_id"], out["spans"])
+
+    def cmd_metrics(self, args: list[str]) -> str:
+        if len(args) > 1:
+            return "usage: metrics [host]"
+        out = self._control("metrics_export",
+                            **({"host": args[0]} if args else {}))
+        return out["text"].rstrip("\n")
+
+
+def format_waterfall(trace_id: str, spans: list[dict]) -> str:
+    """One line per span — offset from the trace start, duration, node,
+    depth-indented name, then the attrs. Shared by the shell `trace`
+    command and tools/trace_export.py."""
+    if not spans:
+        return f"(no spans recorded for {trace_id})"
+    base = min(s["t_start"] for s in spans)
+    by_id = {s["span_id"]: s for s in spans}
+
+    def depth(s: dict) -> int:
+        d, seen = 0, set()
+        while s.get("parent") in by_id and s["span_id"] not in seen:
+            seen.add(s["span_id"])
+            s = by_id[s["parent"]]
+            d += 1
+        return d
+
+    rows = [f"trace {trace_id} ({len(spans)} spans)"]
+    for s in spans:
+        t0 = s["t_start"] - base
+        dur = ((s["t_end"] - s["t_start"]) * 1000.0
+               if s.get("t_end") is not None else None)
+        attrs = " ".join(f"{k}={v}" for k, v in sorted(
+            (s.get("attrs") or {}).items()))
+        rows.append(f"{t0 * 1000.0:9.2f}ms "
+                    + (f"{dur:9.2f}ms " if dur is not None
+                       else f"{'open':>9s}   ")
+                    + f"{s['node']:<12s} "
+                    + "  " * depth(s) + s["name"]
+                    + (f"  [{attrs}]" if attrs else ""))
+    return "\n".join(rows)

@@ -18,10 +18,37 @@ this layer only rides out blips, it does not replace them.
 from __future__ import annotations
 
 import random
+import threading
 import time
 from collections.abc import Callable
 
 from idunno_tpu.comm.transport import TransportError
+
+# process-wide retry accounting (ISSUE 6 satellite): PR 5 logged retries
+# but never counted them. Module-level because this helper has no node
+# handle — `metrics_export` (serve/control.py) merges these into the
+# Prometheus exposition, and `counters()` consumers read them via
+# `retry_counters()`. Thread-safe; reset only in tests.
+_counters_lock = threading.Lock()
+_counters = {"retry_attempts": 0, "retry_exhausted": 0}
+
+
+def _count(name: str, n: int = 1) -> None:
+    with _counters_lock:
+        _counters[name] += n
+
+
+def retry_counters() -> dict[str, int]:
+    """Snapshot of the process-wide retry counters."""
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_retry_counters() -> None:
+    """Test hook: zero the process-wide counters."""
+    with _counters_lock:
+        for k in _counters:
+            _counters[k] = 0
 
 
 def call_with_retry(fn: Callable[[], object], *, attempts: int = 3,
@@ -50,7 +77,9 @@ def call_with_retry(fn: Callable[[], object], *, attempts: int = 3,
         pause = delay * (0.5 + 0.5 * roll())
         if clock() - t0 + pause > deadline_s:
             break
+        _count("retry_attempts")
         sleep(pause)
         delay = min(delay * 2.0, cap_s)
     assert last is not None
+    _count("retry_exhausted")
     raise last

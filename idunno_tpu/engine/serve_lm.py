@@ -85,6 +85,10 @@ class Request:
     stop: list[list[int]] | None = None
     seed: int | None = None
     t_admit: float = 0.0       # monotonic stamp set at slot admission
+    # (trace_id, parent_span_id) from the submitting hop (utils/spans.py);
+    # None = untraced. _admit re-points the parent at its prefill span so
+    # decode-step spans chain under the prefill in the waterfall.
+    trace: tuple | None = None
 
 
 @dataclass
@@ -437,6 +441,9 @@ class DecodeServer:
             raise ValueError("kv_cache_blocks needs kv_block_size > 0")
         self._block_pool = self._radix = None
         self._held: dict[int, list] = {}   # live request id → pinned chain
+        # optional per-node span recorder (utils/spans.py), set by the
+        # serving layer after construction; None = tracing off, zero cost
+        self.spans = None
         # cheap argument validation BEFORE any device allocation or
         # weight quantization: a bad prefix must fail in microseconds
         self.prefix = list(prefix) if prefix else None
@@ -1009,12 +1016,16 @@ class DecodeServer:
                top_k: int = 0, presence_penalty: float = 0.0,
                frequency_penalty: float = 0.0,
                stop: list[list[int]] | None = None,
-               seed: int | None = None) -> int:
+               seed: int | None = None,
+               trace: tuple | None = None) -> int:
         """Queue a prompt; returns the request id. ``temperature`` 0 =
         greedy; > 0 samples with a per-request stream seeded by ``seed``
         (default: the request id); ``top_p`` < 1 restricts sampling to
         the nucleus and ``top_k`` > 0 to the k most probable tokens
-        (k-filter first, then nucleus), exactly as in `engine.generate`."""
+        (k-filter first, then nucleus), exactly as in `engine.generate`.
+        ``trace`` is an optional (trace_id, parent_span_id) context —
+        prefill/decode spans are recorded under it when `self.spans` is
+        wired (utils/spans.py)."""
         self.validate(tokens, max_new, temperature, top_p, top_k,
                       presence_penalty, frequency_penalty, stop)
         rid = self._next_id
@@ -1027,7 +1038,8 @@ class DecodeServer:
                                    frequency_penalty=float(frequency_penalty),
                                    stop=([list(q) for q in stop]
                                          if stop else None),
-                                   seed=seed))
+                                   seed=seed,
+                                   trace=(tuple(trace) if trace else None)))
         return rid
 
     def poll(self) -> list[Completion]:
@@ -1191,6 +1203,10 @@ class DecodeServer:
             slot = free.pop(0)
             req = self._queue.popleft()
             req.t_admit = time.monotonic()
+            # prefill span opens here (store clock, not monotonic: fake-
+            # clock tests need assertable timelines); closed after insert
+            t_prefill0 = (self.spans.clock()
+                          if self.spans is not None and req.trace else None)
             per_req = list(req.tokens)      # pre-prefix request tokens
             suffix_true = len(per_req)
             pl = len(self.prefix) if self.prefix else 0
@@ -1330,6 +1346,15 @@ class DecodeServer:
                 rem = 0                   # the prompt's very next token
             self._remaining = self._remaining.at[slot].set(rem)
             self._rc_invalidate()
+            if t_prefill0 is not None:
+                sp = self.spans.record(
+                    "lm.prefill", trace=req.trace[0], parent=req.trace[1],
+                    t_start=t_prefill0,
+                    attrs={"id": req.id, "prompt_len": suffix_true,
+                           "prefix_hit": hit, "bucket": suffix_bucket})
+                # decode-step spans chain under the prefill
+                req = dataclasses.replace(
+                    req, trace=(req.trace[0], sp.span_id))
             self._live[slot] = req
             self._stats["admitted"] += 1
             # max_new == 1: the prefill's token was the only one; the next
@@ -1392,6 +1417,9 @@ class DecodeServer:
         self._admit()
         self._retire_finished()           # max_new == 1 admissions
         if self._live:
+            t_step0 = (self.spans.clock() if self.spans is not None
+                       and any(r.trace for r in self._live.values())
+                       else None)
             if self._draft_model is not None:
                 (self._tokens, self._cache, self._draft_cache,
                  self._cursors, self._remaining,
@@ -1409,6 +1437,14 @@ class DecodeServer:
                     self._top_ks, self._keys, self._logprobs,
                     self._pres, self._freq, self._counts)
             self._stats["dispatches"] += 1
+            if t_step0 is not None:
+                batch = len(self._live)
+                for req in self._live.values():
+                    if req.trace:
+                        self.spans.record(
+                            "lm.decode_step", trace=req.trace[0],
+                            parent=req.trace[1], t_start=t_step0,
+                            attrs={"id": req.id, "batch": batch})
             self._rc_invalidate()         # the dispatch advanced the rows
             self._apply_stops()
             self._retire_finished()

@@ -35,6 +35,7 @@ from typing import TYPE_CHECKING
 
 from idunno_tpu.comm.message import Message
 from idunno_tpu.membership.epoch import check_payload
+from idunno_tpu.utils.spans import trace_from_payload
 from idunno_tpu.utils.types import MessageType
 
 if TYPE_CHECKING:                                    # pragma: no cover
@@ -90,6 +91,8 @@ class ControlService:
         stale = check_payload(self.node.membership.epoch, msg.payload,
                               self.node.host)
         if stale is not None:
+            # ISSUE 6 satellite: PR 5 logged these, now they count
+            self.node.metrics.record_counter("stale_epoch_rejected")
             return stale
         try:
             out = self._dispatch(msg.payload.get("verb", ""), msg.payload)
@@ -330,7 +333,8 @@ class ControlService:
                     from idunno_tpu.serve.gateway import AdmissionGateway
                     gateway = AdmissionGateway(gw_spec)
                 loop = LMServingLoop(server, name=f"{node.host}-{name}",
-                                     gateway=gateway)
+                                     gateway=gateway,
+                                     spans=getattr(node, "spans", None))
             except BaseException:
                 with self._reg_lock:
                     if self._lm_loops.get(name) is placeholder:
@@ -343,30 +347,67 @@ class ControlService:
             loop.stop()               # lm_stop won the race mid-build
             return {"stopped": True}
         if verb == "lm_submit":
+            from idunno_tpu.serve.admission import AdmissionShed
+
+            # trace context (utils/spans.py): adopt the submitter's stamp
+            # (manager forward, traced client) or mint a root here, so
+            # every lm_submit is traceable end to end
+            spans = getattr(node, "spans", None)
+            tctx = trace_from_payload(p)
             key = p.get("idem")
             if key is not None:
                 with self._reg_lock:
                     prior = self._lm_idem.get((p["name"], key))
                 if prior is not None:
+                    if spans is not None and tctx is not None:
+                        # dedup made visible in the waterfall: the retried
+                        # hop records a span, the request decodes once
+                        spans.record("lm.submit", trace=tctx[0],
+                                     parent=tctx[1],
+                                     attrs={"pool": p["name"], "rid": prior,
+                                            "duplicate": True})
                     return {"id": prior, "duplicate": True}
-            rid = self._lm_loop(p["name"]).submit(
-                [int(t) for t in p["prompt"]], int(p["max_new"]),
-                temperature=float(p.get("temperature", 0.0)),
-                top_p=float(p.get("top_p", 1.0)),
-                top_k=int(p.get("top_k", 0)),
-                presence_penalty=float(p.get("presence_penalty", 0.0)),
-                frequency_penalty=float(p.get("frequency_penalty", 0.0)),
-                stop=([[int(t) for t in q] for q in p["stop"]]
-                      if p.get("stop") else None),
-                seed=(int(p["seed"]) if p.get("seed") is not None
-                      else None),
-                # QoS surface (serve/gateway.py): no-ops on pools without
-                # a gateway beyond priority validation
-                tenant=str(p.get("tenant", "default")),
-                priority=str(p.get("priority", "interactive")),
-                deadline_ms=(float(p["deadline_ms"])
-                             if p.get("deadline_ms") is not None else None),
-                readmit=bool(p.get("readmit")))
+            sp = None
+            if spans is not None:
+                sp = spans.start("lm.submit",
+                                 trace=tctx[0] if tctx else None,
+                                 parent=tctx[1] if tctx else None,
+                                 attrs={"pool": p["name"]})
+            try:
+                rid = self._lm_loop(p["name"]).submit(
+                    [int(t) for t in p["prompt"]], int(p["max_new"]),
+                    temperature=float(p.get("temperature", 0.0)),
+                    top_p=float(p.get("top_p", 1.0)),
+                    top_k=int(p.get("top_k", 0)),
+                    presence_penalty=float(p.get("presence_penalty", 0.0)),
+                    frequency_penalty=float(
+                        p.get("frequency_penalty", 0.0)),
+                    stop=([[int(t) for t in q] for q in p["stop"]]
+                          if p.get("stop") else None),
+                    seed=(int(p["seed"]) if p.get("seed") is not None
+                          else None),
+                    # QoS surface (serve/gateway.py): no-ops on pools
+                    # without a gateway beyond priority validation
+                    tenant=str(p.get("tenant", "default")),
+                    priority=str(p.get("priority", "interactive")),
+                    deadline_ms=(float(p["deadline_ms"])
+                                 if p.get("deadline_ms") is not None
+                                 else None),
+                    readmit=bool(p.get("readmit")),
+                    trace=sp.ctx if sp is not None else None)
+            except AdmissionShed as e:
+                # ISSUE 6 satellite: per-reason shed counters on the C8
+                # tracker (the gateway's own stats stay the pool view)
+                node.metrics.record_counter(f"gateway_shed_{e.reason}")
+                if sp is not None:
+                    spans.finish(sp, shed=e.reason)
+                raise
+            except Exception:
+                if sp is not None:
+                    spans.finish(sp, error=True)
+                raise
+            if sp is not None:
+                spans.finish(sp, rid=rid)
             if key is not None:
                 with self._reg_lock:
                     if len(self._lm_idem) >= 4096:     # bound the map
@@ -498,7 +539,92 @@ class ControlService:
             # lifecycle flags live under "status" (its 'stopped' field is
             # False when the job had already finished)
             return {"stopped": True, "status": job.status()}
+        if verb == "spans_dump":
+            # node-local span window (utils/spans.py); the cluster-wide
+            # view is the `trace` verb below
+            spans = getattr(node, "spans", None)
+            return {"node": node.host,
+                    "spans": ([] if spans is None else spans.dump(
+                        trace_id=p.get("trace_id"),
+                        limit=(int(p["limit"])
+                               if p.get("limit") else None)))}
+        if verb == "trace":
+            return self._collect_trace(p)
+        if verb == "metrics_export":
+            # Prometheus text exposition of everything observable on this
+            # node: C8 tracker counters/rates/percentiles/gauges plus the
+            # process-wide retry counters and span-buffer gauges
+            from idunno_tpu.comm.retry import retry_counters
+
+            target = p.get("host")
+            if target and target != node.host:
+                out = node.transport.call(
+                    target, SERVICE,
+                    Message(MessageType.INFERENCE, node.host,
+                            {"verb": "metrics_export"}), timeout=5.0)
+                if out is None or out.type is not MessageType.ACK:
+                    raise ValueError(f"metrics_export: {target} unreachable")
+                return {"text": out.payload["text"]}
+            spans = getattr(node, "spans", None)
+            extra_g = {}
+            if spans is not None:
+                extra_g["span_buffer_depth"] = spans.depth()
+                extra_g["spans_recorded_total"] = spans.recorded_total()
+            return {"text": node.metrics.prometheus_text(
+                node.host, extra_counters=retry_counters(),
+                extra_gauges=extra_g)}
         raise ValueError(f"unknown control verb {verb!r}")
+
+    def _collect_trace(self, p: dict) -> dict:
+        """Cluster-wide trace collection: resolve the trace id (given
+        directly, or looked up from an LM pool request id / CNN qnum),
+        then fan `spans_dump` out to every alive member and merge the
+        returned spans sorted by start time — the shell waterfall and
+        `tools/trace_export.py` both consume this."""
+        node = self.node
+        tid = p.get("trace_id")
+        if tid is None and p.get("name") is not None \
+                and p.get("id") is not None:
+            name, rid = p["name"], int(p["id"])
+            mgr = getattr(node, "lm_manager", None)
+            if mgr is not None and mgr.has_pool(name) \
+                    and not p.get("local"):
+                tid = mgr.trace_of(name, rid)
+            else:
+                loop = self._lm_loops.get(name)
+                if loop is not None and not isinstance(loop, _Starting):
+                    tid = loop.trace_of(rid)
+        if tid is None and p.get("model") is not None \
+                and p.get("qnum") is not None:
+            tid = node.inference.trace_of(p["model"], int(p["qnum"]))
+        if tid is None:
+            raise ValueError(
+                "trace: pass trace_id, or name+id for an LM request, or "
+                "model+qnum for a CNN query (unknown/untraced ids "
+                "resolve to nothing)")
+        merged: list[dict] = []
+        nodes: list[str] = []
+        ask = {"verb": "spans_dump", "trace_id": tid, "local": True}
+        for h in node.membership.members.alive_hosts():
+            if h == node.host:
+                spans = getattr(node, "spans", None)
+                got = [] if spans is None else spans.dump(trace_id=tid)
+            else:
+                try:
+                    out = node.transport.call(
+                        h, SERVICE, Message(MessageType.INFERENCE,
+                                            node.host, dict(ask)),
+                        timeout=5.0)
+                except Exception:  # noqa: BLE001 - best-effort collection
+                    continue
+                if out is None or out.type is not MessageType.ACK:
+                    continue
+                got = out.payload.get("spans", [])
+            if got:
+                nodes.append(h)
+                merged.extend(got)
+        merged.sort(key=lambda s: (s.get("t_start", 0.0), s["span_id"]))
+        return {"trace_id": tid, "spans": merged, "nodes": nodes}
 
     def _route_cluster(self, verb: str, p: dict) -> dict | None:
         """Cluster-managed LM tier (serve/lm_manager.py): placement verbs
@@ -557,7 +683,8 @@ class ControlService:
                                  deadline_ms=(float(p["deadline_ms"])
                                               if p.get("deadline_ms")
                                               is not None else None),
-                                 idem_key=p.get("idem"))
+                                 idem_key=p.get("idem"),
+                                 trace=trace_from_payload(p))
                 return {"id": rid}
             if verb == "lm_poll":
                 return mgr.poll(name)

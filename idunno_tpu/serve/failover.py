@@ -210,6 +210,16 @@ class FailoverManager:
                  "%d wal deltas)", self.host, epoch,
                  snap.get("seq") if snap else None, len(wal))
         svc = self.service
+        asp = None
+        if svc.spans is not None:
+            # the adoption is itself a span — in its OWN trace (the event
+            # is cluster-scoped, not owned by any one request), finished
+            # after resume_in_flight so its duration covers the promotion
+            asp = svc.spans.start(
+                "failover.adopt",
+                attrs={"epoch": epoch,
+                       "snapshot_seq": snap.get("seq") if snap else None,
+                       "wal_deltas": len(wal)})
         if snap is not None:
             svc.scheduler.book.load_wire(snap["tasks"])
             with svc._results_lock:
@@ -243,6 +253,9 @@ class FailoverManager:
                 and "lm" in snap:
             self.lm_manager.load_wire(snap["lm"])
             self.lm_manager.on_adopt()
+        if asp is not None:
+            svc.spans.finish(
+                asp, resumed=len(svc.scheduler.book.in_flight()))
 
     def resume_in_flight(self) -> None:
         """Reassign in-flight tasks stranded on dead hosts (including the

@@ -38,6 +38,7 @@ from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.scheduler.fair import FairScheduler
 from idunno_tpu.scheduler.tasks import Task, WORKING
 from idunno_tpu.serve.metrics import MetricsTracker
+from idunno_tpu.utils.spans import stamp_trace, trace_from_payload
 from idunno_tpu.utils.types import MemberStatus, MessageType
 
 SERVICE = "inference"
@@ -62,6 +63,9 @@ class Job:
     # dispatch stamp echoed in error reports so a stale report about an
     # OLD assignment can't be mistaken for the current one
     assigned: float = 0.0
+    # (trace_id, parent_span_id) riding the JOB payload — the worker span
+    # parents under the master's dispatch span
+    trace: tuple | None = None
 
 
 class InferenceServiceError(Exception):
@@ -105,6 +109,12 @@ class InferenceService:
         # returns the original booking instead of double-submitting
         # (replicated in the failover snapshot + WAL deltas)
         self._idem: dict[str, int] = {}
+        # SpanStore wired by serve/node.py; None = tracing off everywhere
+        self.spans = None
+        # (model, qnum) → (trace_id, schedule_span_id): dispatch /
+        # re-dispatch / collect spans of a query all hang off its schedule
+        # span. Master-local (like metrics counters) — bounded FIFO.
+        self._trace_ctx: dict[tuple[str, int], tuple] = {}
         self._results_lock = threading.RLock()
 
         # worker state
@@ -173,11 +183,24 @@ class InferenceService:
         # retry/failover attempt inside _master_call: a lost ACK retried
         # against the same (or the newly adopted) master returns the
         # original qnum instead of booking twice
-        out = self._master_call(Message(
-            MessageType.INFERENCE, self.host,
-            {"model": model, "start": start, "end": end,
-             "dataset": dataset or self.dataset_root,
-             "idem": f"{self.host}:{uuid.uuid4().hex}"}))
+        payload = {"model": model, "start": start, "end": end,
+                   "dataset": dataset or self.dataset_root,
+                   "idem": f"{self.host}:{uuid.uuid4().hex}"}
+        sp = None
+        if self.spans is not None:
+            sp = self.spans.start("cnn.submit",
+                                  attrs={"model": model, "start": start,
+                                         "end": end})
+            stamp_trace(payload, sp.ctx)
+        try:
+            out = self._master_call(Message(
+                MessageType.INFERENCE, self.host, payload))
+        except Exception:
+            if sp is not None:
+                self.spans.finish(sp, error=True)
+            raise
+        if sp is not None:
+            self.spans.finish(sp, qnum=int(out.payload["qnum"]))
         return int(out.payload["qnum"])
 
     def inference(self, model: str, start: int, end: int,
@@ -252,7 +275,8 @@ class InferenceService:
             p = msg.payload
             return self._master_submit(p["model"], int(p["start"]),
                                        int(p["end"]), p.get("dataset"),
-                                       idem=p.get("idem"))
+                                       idem=p.get("idem"),
+                                       trace=trace_from_payload(p))
         if msg.type is MessageType.JOB:            # dispatched task
             p = msg.payload
             # fence: a JOB stamped below our epoch high-water comes from a
@@ -266,7 +290,8 @@ class InferenceService:
                                       assigned=float(p.get("assigned", 0.0)),
                                       start=int(p["start"]),
                                       end=int(p["end"]),
-                                      dataset=p.get("dataset")))
+                                      dataset=p.get("dataset"),
+                                      trace=trace_from_payload(p)))
                 self._jobs_available.set()
             return Message(MessageType.ACK, self.host)
         return Message(MessageType.ERROR, self.host,
@@ -274,7 +299,8 @@ class InferenceService:
 
     def _master_submit(self, model: str, start: int, end: int,
                        dataset: str | None,
-                       idem: str | None = None) -> Message:
+                       idem: str | None = None,
+                       trace: tuple | None = None) -> Message:
         workers = self._eligible_workers()     # before reserving the idem
         # key: a failed submit must stay retryable as a fresh booking
         if not workers:
@@ -285,9 +311,17 @@ class InferenceService:
             # qnum bump, so two concurrent retries of one logical submit
             # can't both book (the first wins, the second reads its qnum)
             if idem is not None and idem in self._idem:
+                dup = self._idem[idem]
+                if self.spans is not None and trace is not None:
+                    # retry after a lost ACK: the dedup is a span too, so
+                    # the trace shows both attempts and ONE booking
+                    self.spans.record(
+                        "cnn.schedule", trace=trace[0], parent=trace[1],
+                        t_start=self.spans.clock(),
+                        attrs={"model": model, "qnum": dup,
+                               "duplicate": True})
                 return Message(MessageType.ACK, self.host,
-                               {"qnum": self._idem[idem],
-                                "duplicate": True})
+                               {"qnum": dup, "duplicate": True})
             self.scheduler.avg_query_time = {
                 m: self.metrics.avg_query_time(m)
                 for m in set(self._qnum) | {model}}
@@ -298,10 +332,27 @@ class InferenceService:
                 if len(self._idem) > 4096:     # bounded: oldest keys fall
                     for k in list(self._idem)[:1024]:
                         del self._idem[k]
+        ssp = None
+        if self.spans is not None:
+            # mints a fresh trace when the client didn't stamp one (e.g. a
+            # shell-local submit): every query is traceable either way
+            ssp = self.spans.start(
+                "cnn.schedule",
+                trace=trace[0] if trace else None,
+                parent=trace[1] if trace else None,
+                attrs={"model": model, "qnum": qnum,
+                       "start": start, "end": end})
+            with self._results_lock:
+                self._trace_ctx[(model, qnum)] = (ssp.trace_id, ssp.span_id)
+                if len(self._trace_ctx) > 4096:
+                    for k in list(self._trace_ctx)[:1024]:
+                        del self._trace_ctx[k]
         tasks = self.scheduler.assign(model, qnum, start, end, workers,
                                       dataset=dataset)
         for t in tasks:
             self._dispatch(t)
+        if ssp is not None:
+            self.spans.finish(ssp, tasks=len(tasks))
         # write-ahead to the standby BEFORE the client sees the ack: an
         # acked query must survive an immediate coordinator death, not
         # only one that lands after the next periodic replication tick
@@ -327,6 +378,13 @@ class InferenceService:
             for k, v in wire.items():
                 self._idem.setdefault(k, int(v))
 
+    def trace_of(self, model: str, qnum: int) -> str | None:
+        """Trace id of a scheduled query (the `trace` verb resolves
+        ``model qnum`` through this); None when untraced or evicted."""
+        with self._results_lock:
+            tr = self._trace_ctx.get((model, int(qnum)))
+            return tr[0] if tr else None
+
     def _eligible_workers(self) -> list[str]:
         """All alive hosts serve as workers, the coordinator included
         (`send_inference_work` local-execute branch, `:764-791`)."""
@@ -337,6 +395,10 @@ class InferenceService:
         # failure detector — with a cumulative exclusion set so several
         # simultaneously-dead workers can't ping-pong the dispatch forever.
         tried: set[str] = set()
+        tr = None
+        if self.spans is not None:
+            with self._results_lock:
+                tr = self._trace_ctx.get((task.model, task.qnum))
         while True:
             # snapshot the assignment this attempt is for (atomic — a torn
             # read could pair the new worker with the old stamp), and
@@ -352,12 +414,26 @@ class InferenceService:
                            "dataset": task.dataset,
                            "assigned": stamp,
                            "epoch": list(self.membership.epoch.view())})
+            dsp = None
+            if tr is not None:
+                # one span per ATTEMPT: re-dispatch after a dead worker
+                # shows up as a second span naming the new worker
+                dsp = self.spans.start(
+                    "cnn.dispatch", trace=tr[0], parent=tr[1],
+                    attrs={"model": task.model, "qnum": task.qnum,
+                           "start": task.start, "end": task.end,
+                           "worker": worker})
+                stamp_trace(msg.payload, (tr[0], dsp.span_id))
             if worker == self.host:
                 self._handle_inference(SERVICE, msg)
+                if dsp is not None:
+                    self.spans.finish(dsp, local=True)
                 return
             try:
                 out = self.transport.call(worker, SERVICE, msg,
                                           timeout=30.0)
+                if dsp is not None:
+                    self.spans.finish(dsp)
                 if reply_is_stale(self.membership.epoch, out):
                     # the worker has seen a higher epoch: we are deposed.
                     # Step down — do NOT treat this as a dead worker and
@@ -367,6 +443,8 @@ class InferenceService:
                     return
                 return
             except TransportError:
+                if dsp is not None:
+                    self.spans.finish(dsp, error="TransportError")
                 tried.add(worker)
                 alive = [h for h in self._eligible_workers()
                          if h not in tried]
@@ -446,8 +524,19 @@ class InferenceService:
         self.metrics.record_task(model, task.n_items,
                                  float(p["elapsed_s"]),
                                  self.config.query_batch_size)
-        if self.scheduler.book.query_done(model, qnum):
+        done = self.scheduler.book.query_done(model, qnum)
+        if done:
             self.metrics.record_query_done(model)
+        tctx = trace_from_payload(p)
+        if self.spans is not None and tctx is not None:
+            now = self.spans.clock()
+            self.spans.record("cnn.collect", trace=tctx[0], parent=tctx[1],
+                              t_start=now, t_end=now,
+                              attrs={"model": model, "qnum": qnum,
+                                     "start": start, "end": end,
+                                     "n": len(records),
+                                     "worker": msg.sender,
+                                     "query_done": done})
         return Message(MessageType.ACK, self.host)
 
     # -- failure / straggler handling (master) ----------------------------
@@ -613,6 +702,8 @@ class InferenceService:
 
     def _execute(self, job: Job) -> None:
         t0 = self.clock()
+        traced = self.spans is not None and job.trace is not None
+        ts0 = self.spans.clock() if traced else 0.0
         try:
             res = self.engine.infer(
                 job.model, job.start, job.end,
@@ -629,23 +720,42 @@ class InferenceService:
                 "job %s#%s [%s, %s] failed on %s (%s: %s); reporting to "
                 "master for re-dispatch", job.model, job.qnum, job.start,
                 job.end, self.host, type(e).__name__, e)
+            err_payload = {"model": job.model, "qnum": job.qnum,
+                           "start": job.start, "end": job.end,
+                           "assigned": job.assigned,
+                           "error": f"{type(e).__name__}: {e}"}
+            if traced:
+                wsp = self.spans.record(
+                    "cnn.worker", trace=job.trace[0], parent=job.trace[1],
+                    t_start=ts0,
+                    attrs={"model": job.model, "qnum": job.qnum,
+                           "start": job.start, "end": job.end,
+                           "error": f"{type(e).__name__}: {e}"[:120]})
+                stamp_trace(err_payload, (job.trace[0], wsp.span_id))
             self._deliver_result(Message(
-                MessageType.RESULT, self.host,
-                {"model": job.model, "qnum": job.qnum,
-                 "start": job.start, "end": job.end,
-                 "assigned": job.assigned,
-                 "error": f"{type(e).__name__}: {e}"}))
+                MessageType.RESULT, self.host, err_payload))
             return
         elapsed = getattr(res, "elapsed_s", None)
         if elapsed is None:
             elapsed = self.clock() - t0
         records = getattr(res, "records", res)
-        msg = Message(MessageType.RESULT, self.host,
-                      {"model": job.model, "qnum": job.qnum,
+        payload = {"model": job.model, "qnum": job.qnum,
+                   "start": job.start, "end": job.end,
+                   "elapsed_s": elapsed,
+                   "weights": getattr(res, "weights", "unknown"),
+                   "records": [list(r) for r in records]}
+        if traced:
+            wsp = self.spans.record(
+                "cnn.worker", trace=job.trace[0], parent=job.trace[1],
+                t_start=ts0,
+                attrs={"model": job.model, "qnum": job.qnum,
                        "start": job.start, "end": job.end,
-                       "elapsed_s": elapsed,
-                       "weights": getattr(res, "weights", "unknown"),
-                       "records": [list(r) for r in records]})
+                       "n": len(payload["records"]),
+                       "elapsed_s": round(float(elapsed), 6)})
+            # the RESULT carries the worker span as parent so the master's
+            # collect span closes the loop under it
+            stamp_trace(payload, (job.trace[0], wsp.span_id))
+        msg = Message(MessageType.RESULT, self.host, payload)
         self._deliver_result(msg)
 
     def _deliver_result(self, msg: Message) -> None:

@@ -48,6 +48,7 @@ from idunno_tpu.config import ClusterConfig
 from idunno_tpu.membership.epoch import StaleEpoch, reply_is_stale
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.serve.admission import PRIORITIES, shed_reason
+from idunno_tpu.utils.spans import stamp_trace
 from idunno_tpu.utils.types import MemberStatus, MessageType
 
 
@@ -107,6 +108,10 @@ class LMPoolManager:
         self.transport = transport
         self.membership = membership
         self.service = inference_service      # scheduler book = load signal
+        # per-node span recorder (utils/spans.py), wired by serve/node.py;
+        # None = tracing off. Journaled requests carry their trace ctx in
+        # to_wire, so a trace survives failover adoption
+        self.spans = None
         self._lock = threading.RLock()
         # name -> {"spec": dict, "node": str|None, "next_rid": int,
         #          "requests": {rid: descriptor}}
@@ -274,7 +279,8 @@ class LMPoolManager:
                seed: int | None = None,
                tenant: str = "default", priority: str = "interactive",
                deadline_ms: float | None = None,
-               idem_key: str | None = None) -> int:
+               idem_key: str | None = None,
+               trace: tuple | None = None) -> int:
         """Journal a request (seed pinned NOW — replay after any failure
         must be token-exact even for sampled requests), then forward it to
         the pool's node. Forward failures leave it pending; the pump
@@ -297,11 +303,31 @@ class LMPoolManager:
                 prior = pool.setdefault("idem", {}).get(idem_key)
                 if prior is not None:
                     # client retry of an already-journaled submit (its ACK
-                    # was lost): same booking, exactly-once
+                    # was lost): same booking, exactly-once — and the
+                    # retried hop leaves a duplicate-marked span so the
+                    # waterfall shows the dedupe
+                    if self.spans is not None and trace:
+                        self.spans.record(
+                            "lm.submit", trace=trace[0], parent=trace[1],
+                            attrs={"pool": name, "rid": int(prior),
+                                   "duplicate": True})
                     return int(prior)
             rid = pool["next_rid"]
             pool["next_rid"] += 1
-            req = {"prompt": [int(t) for t in prompt],
+            tr = None
+            if self.spans is not None:
+                # mint/extend the trace at the journal booking: the ctx
+                # rides the journal entry (and the standby snapshot), so
+                # forwards — including post-adoption replays — chain
+                # under this span
+                sp = self.spans.record(
+                    "lm.submit",
+                    trace=trace[0] if trace else None,
+                    parent=trace[1] if trace else None,
+                    attrs={"pool": name, "rid": rid, "managed": True})
+                tr = [sp.trace_id, sp.span_id]
+            req = {"trace": tr,
+                   "prompt": [int(t) for t in prompt],
                    "max_new": int(max_new),
                    "temperature": float(temperature),
                    "top_p": float(top_p),
@@ -333,30 +359,46 @@ class LMPoolManager:
 
     def _forward(self, name: str, node: str, rid: int,
                  req: dict[str, Any]) -> None:
+        payload = {
+            "verb": "lm_submit", "name": name,
+            "prompt": req["prompt"], "max_new": req["max_new"],
+            "temperature": req["temperature"],
+            "top_p": req.get("top_p", 1.0),
+            "top_k": req.get("top_k", 0),
+            "presence_penalty": req.get("presence_penalty", 0.0),
+            "frequency_penalty": req.get("frequency_penalty", 0.0),
+            "stop": req.get("stop"),
+            "seed": req["seed"],
+            "tenant": req.get("tenant", "default"),
+            "priority": req.get("priority", "interactive"),
+            "deadline_ms": req.get("deadline_ms"),
+            "readmit": bool(req.get("admitted")),
+            # node-side dedupe for a LOST-REPLY retry: attempts counts
+            # prior successful forwards, so the pump's re-forward after
+            # a dropped ACK reuses the key (the node returns its
+            # existing row), while a watchdog requeue — attempts
+            # already bumped — gets a fresh key and books a fresh row
+            "idem": f"{name}:{rid}:{req['attempts']}"}
+        fsp = None
+        tr = req.get("trace")
+        if self.spans is not None and tr:
+            # one span per forward ATTEMPT: a retried/re-placed request
+            # shows every hop (and which node finally took it); the
+            # stamped ctx makes the node's lm.submit span its child
+            fsp = self.spans.start(
+                "lm.forward", trace=tr[0], parent=tr[1],
+                attrs={"pool": name, "rid": rid, "node": node,
+                       "attempt": int(req.get("attempts", 0))})
+            stamp_trace(payload, fsp.ctx)
         try:
-            out = self._call(node, {
-                "verb": "lm_submit", "name": name,
-                "prompt": req["prompt"], "max_new": req["max_new"],
-                "temperature": req["temperature"],
-                "top_p": req.get("top_p", 1.0),
-                "top_k": req.get("top_k", 0),
-                "presence_penalty": req.get("presence_penalty", 0.0),
-                "frequency_penalty": req.get("frequency_penalty", 0.0),
-                "stop": req.get("stop"),
-                "seed": req["seed"],
-                "tenant": req.get("tenant", "default"),
-                "priority": req.get("priority", "interactive"),
-                "deadline_ms": req.get("deadline_ms"),
-                "readmit": bool(req.get("admitted")),
-                # node-side dedupe for a LOST-REPLY retry: attempts counts
-                # prior successful forwards, so the pump's re-forward after
-                # a dropped ACK reuses the key (the node returns its
-                # existing row), while a watchdog requeue — attempts
-                # already bumped — gets a fresh key and books a fresh row
-                "idem": f"{name}:{rid}:{req['attempts']}"})
-        except (TransportError, OSError):
+            out = self._call(node, payload)
+        except (TransportError, OSError) as e:
+            if fsp is not None:
+                self.spans.finish(fsp, error=type(e).__name__)
             return                      # stays pending; pump will retry
         except ValueError as e:
+            if fsp is not None:
+                self.spans.finish(fsp, error=str(e)[:120])
             with self._lock:
                 pool = self._pools.get(name)
                 req2 = pool["requests"].get(rid) if pool else None
@@ -393,6 +435,9 @@ class LMPoolManager:
                         req2["error"] = str(e)
                         pool["failed_total"] += 1
             return
+        if fsp is not None:
+            self.spans.finish(fsp, node_id=int(out["id"]),
+                              duplicate=bool(out.get("duplicate")))
         cancel_on_node = False
         with self._lock:
             # recovery may have requeued/re-placed while the RPC ran; only
@@ -524,6 +569,9 @@ class LMPoolManager:
                       for rid, r in pool["requests"].items()
                       if r["status"] == _INFLIGHT
                       and r["node_id"] is not None}
+            traces = {rid: r["trace"][0]
+                      for rid, r in pool["requests"].items()
+                      if r.get("trace")}
         if node is None:
             return {"partial": []}
         try:
@@ -531,9 +579,22 @@ class LMPoolManager:
                              timeout=10.0)
         except (TransportError, ValueError, OSError) as e:
             return {"partial": [], "error": str(e)}
-        reply = {"partial": [dict(row, id=id_map[int(row["id"])])
-                             for row in out.get("partial", ())
-                             if int(row["id"]) in id_map]}
+        rows = []
+        for row in out.get("partial", ()):
+            if int(row["id"]) not in id_map:
+                continue
+            rid = id_map[int(row["id"])]
+            # journal trace id wins (it is the root the `trace` verb
+            # resolves); the node row's own id is the fallback — and an
+            # untraced request gains no `trace` key at all
+            row = dict(row, id=rid)
+            tr = traces.get(rid) or row.get("trace")
+            if tr:
+                row["trace"] = tr
+            elif "trace" in row:
+                del row["trace"]
+            rows.append(row)
+        reply = {"partial": rows}
         if out.get("sheds"):
             # recent gateway rejections with reasons (tenant-keyed, not
             # rid-keyed — a shed request never got a node id)
@@ -612,6 +673,16 @@ class LMPoolManager:
     def has_pool(self, name: str) -> bool:
         with self._lock:
             return name in self._pools
+
+    def trace_of(self, name: str, rid: int) -> str | None:
+        """Trace id of a journaled request (None once pruned/untraced) —
+        the `trace` control verb's lookup for managed pools."""
+        with self._lock:
+            pool = self._pools.get(name)
+            if pool is None:
+                return None
+            tr = (pool["requests"].get(int(rid)) or {}).get("trace")
+            return tr[0] if tr else None
 
     # -- train jobs --------------------------------------------------------
 
@@ -1245,7 +1316,8 @@ class LMPoolManager:
                                             "tenant": "default",
                                             "priority": "interactive",
                                             "deadline_ms": None,
-                                            "admitted": False, **dict(r)}
+                                            "admitted": False,
+                                            "trace": None, **dict(r)}
                                  for rid, r in p["requests"].items()}}
                 for n, p in snap.get("pools", {}).items()}
             self._jobs = {

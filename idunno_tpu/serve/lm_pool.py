@@ -25,7 +25,7 @@ import threading
 from typing import Any
 
 from idunno_tpu.engine.serve_lm import Completion, DecodeServer
-from idunno_tpu.serve.admission import PRIORITIES
+from idunno_tpu.serve.admission import PRIORITIES, AdmissionShed
 from idunno_tpu.serve.gateway import AdmissionGateway
 
 
@@ -34,9 +34,20 @@ class LMServingLoop:
     are safe to call from any thread."""
 
     def __init__(self, server: DecodeServer, name: str = "lm",
-                 gateway: AdmissionGateway | None = None) -> None:
+                 gateway: AdmissionGateway | None = None,
+                 spans=None) -> None:
         self.server = server
         self.gateway = gateway
+        # per-node span recorder (utils/spans.SpanStore | None); wiring it
+        # here also hands it to the server for prefill/decode-step spans
+        self.spans = spans
+        if spans is not None:
+            server.spans = spans
+        # rid → (trace_id, admit_span_id, t_enq) while in flight;
+        # rid → trace_id survives completion so the `trace` verb can
+        # resolve a finished request's trace (bounded, insertion-ordered)
+        self._traces: dict[int, tuple] = {}
+        self._trace_ids: dict[int, str] = {}
         self._lock = threading.Lock()
         # (id, toks, max_new, temperature, top_p, top_k, pres, freq,
         #  stop, seed)
@@ -69,7 +80,8 @@ class LMServingLoop:
                seed: int | None = None,
                tenant: str = "default", priority: str = "interactive",
                deadline_ms: float | None = None,
-               readmit: bool = False) -> int:
+               readmit: bool = False,
+               trace: tuple | None = None) -> int:
         """Validate + queue a prompt; returns the public request id.
         Raises once the pool is stopped — a submit racing `stop()` must
         error loudly, not return an id that never completes.
@@ -86,6 +98,7 @@ class LMServingLoop:
         if priority not in PRIORITIES:
             raise ValueError(f"priority must be one of {PRIORITIES}, "
                              f"got {priority!r}")
+        tr = tuple(trace) if self.spans is not None and trace else None
         with self._lock:
             # checked under the lock: stop() sets the flag BEFORE its own
             # locked inbox drain, so an append here either precedes the
@@ -98,13 +111,37 @@ class LMServingLoop:
                      presence_penalty, frequency_penalty, stop, seed)
             if self.gateway is None:
                 self._inbox.append(entry)
+        if self.gateway is None:
+            if tr is not None:   # outside the lock: _book_trace takes it
+                sp = self.spans.record(
+                    "lm.admit", trace=tr[0], parent=tr[1],
+                    attrs={"rid": rid, "tenant": tenant,
+                           "priority": priority, "gateway": False})
+                self._book_trace(rid, tr[0], sp.span_id, sp.t_end)
         if self.gateway is not None:
             # outside self._lock: the gateway has its own lock, and a shed
             # must not leave loop state half-mutated (rid gaps are fine)
-            self.gateway.admit(rid, entry, tenant=tenant, priority=priority,
-                               deadline_ms=deadline_ms,
-                               pool_gauges=self._pool_gauges(),
-                               readmit=readmit)
+            t0 = self.spans.clock() if tr is not None else None
+            try:
+                self.gateway.admit(rid, entry, tenant=tenant,
+                                   priority=priority,
+                                   deadline_ms=deadline_ms,
+                                   pool_gauges=self._pool_gauges(),
+                                   readmit=readmit)
+            except AdmissionShed as e:
+                if tr is not None:   # shed is terminal — trace records it
+                    self.spans.record(
+                        "lm.shed", trace=tr[0], parent=tr[1], t_start=t0,
+                        attrs={"rid": rid, "reason": e.reason,
+                               "tenant": tenant, "priority": priority})
+                raise
+            if tr is not None:
+                sp = self.spans.record(
+                    "lm.admit", trace=tr[0], parent=tr[1], t_start=t0,
+                    attrs={"rid": rid, "tenant": tenant,
+                           "priority": priority, "gateway": True,
+                           "readmit": bool(readmit)})
+                self._book_trace(rid, tr[0], sp.span_id, sp.t_end)
             # a stop() racing in between admit and here has already drained
             # the gateway; pull our entry back out and error like any other
             # post-stop submit (cancel() returning None = stop drained it,
@@ -113,6 +150,31 @@ class LMServingLoop:
                 raise ValueError("serving pool is stopped")
         self._wake.set()
         return rid
+
+    def _book_trace(self, rid: int, tid: str, sid: str,
+                    t_enq: float) -> None:
+        """Remember an admitted request's trace: in-flight tuple for the
+        queue-wait/finish spans, plus the rid → trace_id map the `trace`
+        verb resolves after completion (bounded FIFO)."""
+        with self._lock:
+            self._traces[rid] = (tid, sid, t_enq)
+            self._trace_ids[rid] = tid
+            while len(self._trace_ids) > 4096:
+                self._trace_ids.pop(next(iter(self._trace_ids)))
+
+    def _trace_done(self, rid: int, name: str, **attrs) -> None:
+        """Record the terminal span (finish/cancel/expire) for ``rid`` and
+        retire its in-flight trace entry."""
+        tr = self._traces.pop(rid, None)
+        if tr is not None and self.spans is not None:
+            self.spans.record(name, trace=tr[0], parent=tr[1],
+                              attrs={"rid": rid, **attrs})
+
+    def trace_of(self, rid: int) -> str | None:
+        """Trace id of a public request id (live or recently finished);
+        None for untraced/unknown ids."""
+        with self._lock:
+            return self._trace_ids.get(rid)
 
     def _pool_gauges(self) -> dict:
         """Live occupancy snapshot for backpressure. Reads of the server's
@@ -150,6 +212,7 @@ class LMServingLoop:
                         prompt_len=len(full), cancelled=True,
                         logprobs=([] if self.server.track_logprobs
                                   else None)))
+                self._trace_done(rid, "lm.cancel", where="gateway")
                 return True
         with self._lock:
             for i, entry in enumerate(self._inbox):
@@ -161,6 +224,7 @@ class LMServingLoop:
                         prompt_len=len(full), cancelled=True,
                         logprobs=([] if self.server.track_logprobs
                                   else None)))
+                    self._trace_done(rid, "lm.cancel", where="inbox")
                     return True
             sid = next((s for s, r in self._id_map.items() if r == rid),
                        None)
@@ -211,6 +275,7 @@ class LMServingLoop:
             if self.gateway is not None:
                 dropped = dropped + [e.payload for e in self.gateway.drain()]
             for entry in dropped:
+                self._traces.pop(entry[0], None)
                 if len(self._errors) < 100:
                     self._errors.append(
                         f"request {entry[0]} dropped: pool stopped")
@@ -222,14 +287,32 @@ class LMServingLoop:
             batch, self._inbox = self._inbox, []
         for (rid, tokens, max_new, temperature, top_p, top_k, pres,
              freq, stop, seed) in batch:
+            ctx = self._queue_wait_span(rid)
             sid = self.server.submit(tokens, max_new,
                                      temperature=temperature, top_p=top_p,
                                      top_k=top_k, presence_penalty=pres,
                                      frequency_penalty=freq, stop=stop,
-                                     seed=rid if seed is None else seed)
+                                     seed=rid if seed is None else seed,
+                                     trace=ctx)
             # under the lock: cancel() iterates this map from RPC threads
             with self._lock:
                 self._id_map[sid] = rid
+
+    def _queue_wait_span(self, rid: int,
+                         t_enq: float | None = None) -> tuple | None:
+        """Record the queue-wait span for ``rid`` (admission → dispatch to
+        the server) and return the (trace_id, admit_span_id) context the
+        server's prefill span chains under; None when untraced.
+        ``t_enq`` overrides the booked enqueue time (the gateway entry's
+        own timestamp — same clock in fake-clock tests)."""
+        tr = self._traces.get(rid)
+        if tr is None or self.spans is None:
+            return None
+        self.spans.record(
+            "lm.queue_wait", trace=tr[0], parent=tr[1],
+            t_start=tr[2] if t_enq is None else float(t_enq),
+            attrs={"rid": rid})
+        return tr[0], tr[1]
 
     def _drain_gateway(self) -> None:
         """Pull admitted work from the gateway under a dispatch budget
@@ -247,14 +330,17 @@ class LMServingLoop:
                     id=e.rid, tokens=full, prompt_len=len(full),
                     rejected="expired",
                     logprobs=([] if self.server.track_logprobs else None)))
+            self._trace_done(e.rid, "lm.expire", reason="expired")
         for e in ready:
             (rid, tokens, max_new, temperature, top_p, top_k, pres,
              freq, stop, seed) = e.payload
+            ctx = self._queue_wait_span(rid, t_enq=e.t_enq)
             sid = self.server.submit(tokens, max_new,
                                      temperature=temperature, top_p=top_p,
                                      top_k=top_k, presence_penalty=pres,
                                      frequency_penalty=freq, stop=stop,
-                                     seed=rid if seed is None else seed)
+                                     seed=rid if seed is None else seed,
+                                     trace=ctx)
             with self._lock:
                 self._id_map[sid] = rid
 
@@ -275,8 +361,16 @@ class LMServingLoop:
                 if len(self._errors) < 100:
                     self._errors.append(f"snapshot: {type(e).__name__}: {e}")
         with self._lock:
-            self._snap = [dict(e, id=self._id_map.get(e["id"], e["id"]))
-                          for e in snap]
+            rows = []
+            for e in snap:
+                rid = self._id_map.get(e["id"], e["id"])
+                tr = self._traces.get(rid)
+                tid = tr[0] if tr else self._trace_ids.get(rid)
+                # untraced rows gain no `trace` key — the streaming
+                # surface predates tracing and clients diff it exactly
+                rows.append(dict(e, id=rid, **({"trace": tid} if tid
+                                               else {})))
+            self._snap = rows
         self._snap_want.clear()
         self._snap_done.set()
 
@@ -297,11 +391,16 @@ class LMServingLoop:
             if done:
                 with self._lock:
                     for c in done:
+                        rid = self._id_map.pop(c.id, c.id)
                         self._outbox.append(Completion(
-                            id=self._id_map.pop(c.id, c.id),
+                            id=rid,
                             tokens=c.tokens, prompt_len=c.prompt_len,
                             service_s=c.service_s, cancelled=c.cancelled,
                             logprobs=c.logprobs))
+                        self._trace_done(
+                            rid,
+                            "lm.cancel" if c.cancelled else "lm.finish",
+                            tokens=len(c.tokens))
             if live == 0:
                 self._wake.wait(timeout=0.5)
                 self._wake.clear()

@@ -185,6 +185,62 @@ class MetricsTracker:
         s = self.processing_stats(model)
         return s.avg if s else 0.0
 
+    # -- Prometheus text exposition (ISSUE 6 tentpole) -------------------
+
+    def prometheus_text(self, node: str,
+                        extra_counters: dict[str, int] | None = None,
+                        extra_gauges: dict[str, float] | None = None) -> str:
+        """Text-format exposition (prometheus.io/docs/instrumenting/
+        exposition_formats) of everything this tracker holds: event
+        counters, per-model rates/percentiles, LM prefix-cache and QoS
+        gateway gauges. ``extra_counters``/``extra_gauges`` merge
+        process-wide series the tracker doesn't own (comm/retry.py
+        counters, span-store depth) into the same scrape."""
+        esc = (lambda s: str(s).replace("\\", "\\\\").replace('"', '\\"'))
+        lines: list[str] = []
+
+        def emit(metric: str, kind: str, value, **labels) -> None:
+            if not any(ln.startswith(f"# TYPE {metric} ")
+                       for ln in lines):
+                lines.append(f"# TYPE {metric} {kind}")
+            lab = ",".join(f'{k}="{esc(v)}"' for k, v
+                           in [("node", node), *sorted(labels.items())])
+            lines.append(f"{metric}{{{lab}}} {float(value):g}")
+
+        with self._lock:
+            counters = dict(self._counters)
+            models = sorted(set(self._finished_images)
+                            | set(self._finished_queries))
+            lm_gauges = {p: dict(g) for p, g in self._lm_gauges.items()}
+            gw_gauges = {p: dict(g) for p, g in self._gw_gauges.items()}
+        for name, v in sorted({**counters,
+                               **(extra_counters or {})}.items()):
+            emit("idunno_events_total", "counter", v, name=name)
+        for m in models:
+            emit("idunno_finished_images_total", "counter",
+                 self.finished_images(m), model=m)
+            emit("idunno_finished_queries_total", "counter",
+                 self.finished_queries(m), model=m)
+            emit("idunno_image_rate", "gauge", self.image_rate(m), model=m)
+            ps = self.processing_stats(m)
+            if ps is not None:
+                for q, v in (("avg", ps.avg), ("p25", ps.q1),
+                             ("p50", ps.q2), ("p75", ps.q3)):
+                    emit("idunno_processing_seconds", "gauge", v,
+                         model=m, quantile=q)
+        for pool, g in sorted(lm_gauges.items()):
+            for k, v in sorted(g.items()):
+                if isinstance(v, (int, float)):
+                    emit("idunno_lm_gauge", "gauge", v, pool=pool, name=k)
+        for pool, g in sorted(gw_gauges.items()):
+            for k, v in sorted(g.items()):
+                if isinstance(v, (int, float)):
+                    emit("idunno_gateway_gauge", "gauge", v,
+                         pool=pool, name=k)
+        for name, v in sorted((extra_gauges or {}).items()):
+            emit("idunno_gauge", "gauge", v, name=name)
+        return "\n".join(lines) + "\n"
+
     # -- failover serialization ------------------------------------------
 
     def to_wire(self) -> dict:

@@ -24,6 +24,7 @@ from idunno_tpu.serve.lm_manager import LMPoolManager
 from idunno_tpu.serve.metrics import MetricsTracker
 from idunno_tpu.store.sdfs import FileStoreService
 from idunno_tpu.utils.logging import setup_node_logging
+from idunno_tpu.utils.spans import SpanStore
 
 
 class Node:
@@ -36,9 +37,14 @@ class Node:
         self.config = config
         self.transport = transport
         self.log = setup_node_logging(host, log_dir or data_dir)
+        # per-node span ring buffer: always on (Dapper-style), bounded
+        # memory, read back via the spans_dump / trace / metrics_export
+        # verbs (utils/spans.py)
+        self.spans = SpanStore(host)
         self.membership = MembershipService(host, config, transport)
         self.store = FileStoreService(host, config, transport,
                                       self.membership, data_dir)
+        self.store.spans = self.spans
         if engine is None:
             # deferred import: pure-control-plane nodes shouldn't pay for jax
             from idunno_tpu.engine.inference import InferenceEngine
@@ -50,8 +56,10 @@ class Node:
                                           self.membership, engine,
                                           metrics=self.metrics,
                                           dataset_root=dataset_root)
+        self.inference.spans = self.spans
         self.lm_manager = LMPoolManager(host, config, transport,
                                         self.membership, self.inference)
+        self.lm_manager.spans = self.spans
         self.failover = FailoverManager(host, config, transport,
                                         self.membership, self.inference,
                                         lm_manager=self.lm_manager)

@@ -40,6 +40,7 @@ from idunno_tpu.membership.epoch import (check_payload, observe_payload,
                                          reply_is_stale)
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.utils.ring import ring_order
+from idunno_tpu.utils.spans import stamp_trace, trace_from_payload
 from idunno_tpu.utils.types import MemberStatus, MessageType
 
 SERVICE = "store"
@@ -165,6 +166,8 @@ class FileStoreService:
         # repairs themselves run OFF the membership monitor loop
         self._repair_serial = threading.Lock()
         self._repair_threads: list[threading.Thread] = []
+        # SpanStore wired by serve/node.py; None = tracing off
+        self.spans = None
         transport.serve(SERVICE, self._handle)
         membership.on_change(self._on_member_change)
 
@@ -240,9 +243,21 @@ class FileStoreService:
         # this logical put (transport-level AND the failover hop to the
         # standby) dedupes to one version bump server-side
         idem = f"{self.host}:{uuid.uuid4().hex}"
-        out = self._master_call(Message(MessageType.PUT, self.host,
-                                        {"name": sdfs_name, "idem": idem},
-                                        blob=blob))
+        payload = {"name": sdfs_name, "idem": idem}
+        sp = None
+        if self.spans is not None:
+            sp = self.spans.start("sdfs.put", attrs={"name": sdfs_name,
+                                                     "bytes": len(blob)})
+            stamp_trace(payload, sp.ctx)
+        try:
+            out = self._master_call(Message(MessageType.PUT, self.host,
+                                            payload, blob=blob))
+        except Exception:
+            if sp is not None:
+                self.spans.finish(sp, error=True)
+            raise
+        if sp is not None:
+            self.spans.finish(sp, version=int(out.payload["version"]))
         return int(out.payload["version"])
 
     def get(self, sdfs_name: str, local_path: str) -> int:
@@ -257,7 +272,20 @@ class FileStoreService:
         payload: dict = {"name": sdfs_name}
         if version is not None:
             payload["version"] = version
-        out = self._master_call(Message(MessageType.GET, self.host, payload))
+        sp = None
+        if self.spans is not None:
+            sp = self.spans.start("sdfs.get", attrs={"name": sdfs_name})
+            stamp_trace(payload, sp.ctx)
+        try:
+            out = self._master_call(Message(MessageType.GET, self.host,
+                                            payload))
+        except Exception:
+            if sp is not None:
+                self.spans.finish(sp, error=True)
+            raise
+        if sp is not None:
+            self.spans.finish(sp, version=int(out.payload["version"]),
+                              bytes=len(out.blob or b""))
         return out.blob, int(out.payload["version"])
 
     def get_versions(self, sdfs_name: str, num_versions: int,
@@ -338,11 +366,13 @@ class FileStoreService:
         name = msg.payload.get("name", "")
         if msg.type is MessageType.PUT:
             return self._master_put(name, msg.blob,
-                                    idem=msg.payload.get("idem"))
+                                    idem=msg.payload.get("idem"),
+                                    trace=trace_from_payload(msg.payload))
         if msg.type is MessageType.GET:
             want = msg.payload.get("version")
             return self._master_get(name,
-                                    None if want is None else int(want))
+                                    None if want is None else int(want),
+                                    trace=trace_from_payload(msg.payload))
         if msg.type is MessageType.GET_VERSIONS:
             return self._master_get_versions(name, int(msg.payload["k"]))
         if msg.type is MessageType.DELETE:
@@ -363,12 +393,19 @@ class FileStoreService:
     # -- master verb implementations --------------------------------------
 
     def _master_put(self, name: str, blob: bytes,
-                    idem: str | None = None) -> Message:
+                    idem: str | None = None,
+                    trace: tuple | None = None) -> Message:
         with self._meta_lock:
             if idem is not None and idem in self._put_idem:
                 # client retry of an already-completed put (lost ACK):
                 # same version, no second replica push
                 version, hosts = self._put_idem[idem]
+                if self.spans is not None and trace is not None:
+                    self.spans.record(
+                        "sdfs.replicate", trace=trace[0], parent=trace[1],
+                        t_start=self.spans.clock(),
+                        attrs={"name": name, "version": version,
+                               "duplicate": True})
                 return Message(MessageType.ACK, self.host,
                                {"version": version, "hosts": hosts,
                                 "duplicate": True})
@@ -377,6 +414,12 @@ class FileStoreService:
                           self.local.tombstones().get(name, 0)) + 1
             self._versions[name] = version       # reserve
         replicas = self._replica_hosts(name)
+        rsp = None
+        if self.spans is not None and trace is not None:
+            rsp = self.spans.start(
+                "sdfs.replicate", trace=trace[0], parent=trace[1],
+                attrs={"name": name, "version": version,
+                       "replicas": len(replicas)})
         push = Message(MessageType.PUT, self.host,
                        {"name": name, "version": version, "internal": True,
                         "epoch": list(self.membership.epoch.view())},
@@ -387,16 +430,31 @@ class FileStoreService:
                 self.local.write(name, version, blob)
                 stored.add(h)
                 continue
+            psp = None
+            if rsp is not None:
+                # one child span per replica push: the fan-out is visible
+                # host-by-host, a dead replica shows as an error span
+                psp = self.spans.start("sdfs.push", trace=rsp.trace_id,
+                                       parent=rsp.span_id,
+                                       attrs={"name": name, "to": h})
             try:
                 out = self.transport.call(h, SERVICE, push, timeout=30.0)
             except TransportError:
+                if psp is not None:
+                    self.spans.finish(psp, error="TransportError")
                 continue
+            if psp is not None:
+                self.spans.finish(psp)
             if reply_is_stale(self.membership.epoch, out):
                 # a replica fenced us mid-push: we are deposed — abort
                 # rather than keep spraying a dead epoch's write
+                if rsp is not None:
+                    self.spans.finish(rsp, error="stale_epoch")
                 return self._err("deposed mid-put (stale epoch)")
             if out is not None:
                 stored.add(h)
+        if rsp is not None:
+            self.spans.finish(rsp, stored=len(stored))
         if not stored:
             return self._err("no replica stored")
         with self._meta_lock:
@@ -434,7 +492,8 @@ class FileStoreService:
                 return None
             return self._versions[name], set(self._locations.get(name, set()))
 
-    def _master_get(self, name: str, want: int | None = None) -> Message:
+    def _master_get(self, name: str, want: int | None = None,
+                    trace: tuple | None = None) -> Message:
         snap = self._snapshot(name)
         if snap is None:
             return self._err("file not found")   # FILE_NOT_EXIST (`:443-448`)
@@ -443,7 +502,15 @@ class FileStoreService:
             if not 1 <= want <= version:
                 return self._err(f"version {want} out of range 1..{version}")
             version = want
+        fsp = None
+        if self.spans is not None and trace is not None:
+            fsp = self.spans.start(
+                "sdfs.fetch", trace=trace[0], parent=trace[1],
+                attrs={"name": name, "version": version,
+                       "holders": len(holders)})
         blob = self._fetch_version(name, version, holders)
+        if fsp is not None:
+            self.spans.finish(fsp, found=blob is not None)
         if blob is None:
             return self._err("no holder reachable")
         return Message(MessageType.ACK, self.host, {"version": version},

@@ -140,3 +140,39 @@ def test_sdfs_put_retry_after_lost_ack_writes_once(tmp_path):
     assert version == v == 1
     blob, got_v = c.stores["n3"].get_bytes("once.bin")
     assert blob == b"exactly-once" and got_v == v
+
+
+def test_invariant_trip_snapshots_span_dump(tmp_path):
+    """Chaos-causal dumps: when any invariant trips, `check_invariants`
+    snapshots every host's span window BEFORE re-raising, so the failing
+    request's trace is in hand without re-running the schedule (the soak
+    driver surfaces the same dump per failure record)."""
+    c = ChaosCluster(818, str(tmp_path))
+    # register the attempt like op_lm would: the delivery-vs-attempted
+    # invariant must see this hand-rolled submit as legitimate
+    c.lm_attempted.append({"serial": 0, "prompt": [1, 2, 3],
+                           "seed": 1, "max_new": 4})
+    root = c.spans["n3"].start("client.lm_submit")
+    out = c._client_control(
+        "n3", {"verb": "lm_submit", "name": c.LM_POOL,
+               "prompt": [1, 2, 3], "max_new": 4, "seed": 1,
+               "trace": [root.trace_id, root.span_id]}, idem="n3:dump1")
+    c.spans["n3"].finish(root, rid=int(out["id"]))
+    c.converge()
+    assert c.check_invariants()["final_master"] == "n0"
+    assert c.last_span_dump is None, "clean pass takes no snapshot"
+    # forge a double delivery of exactly that request's token stream
+    key = tuple(lm_tokens([1, 2, 3], 1, 4))
+    c.lm_delivered[key] = 2
+    with pytest.raises(AssertionError, match="delivered 2x"):
+        c.check_invariants()
+    dump = c.last_span_dump
+    assert dump is not None and set(dump) == set(c.cfg.hosts)
+    traces = {s["trace_id"] for spans in dump.values() for s in spans}
+    assert root.trace_id in traces, \
+        "dump names the failing request's trace"
+    # both the client hop (n3) and the master's journal booking (n0) are
+    # in the snapshot under that one trace
+    assert any(s["name"] == "client.lm_submit" for s in dump["n3"])
+    assert any(s["name"] == "lm.submit"
+               and s["trace_id"] == root.trace_id for s in dump["n0"])

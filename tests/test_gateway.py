@@ -311,3 +311,57 @@ def test_gateway_pool_overload(lm):
         assert loop.errors() == []
     finally:
         loop.stop()
+
+
+def test_traced_expiry_waterfall_is_fake_clock_exact(lm):
+    """Tracing rides the same injected clock as the gateway: a traced
+    request that expires in-queue leaves a waterfall whose offsets are
+    exact fake-clock arithmetic — admission at 0 ms, expiry at precisely
+    the 600 ms we advanced, nothing timed by the wall clock."""
+    from idunno_tpu.engine.serve_lm import DecodeServer
+    from idunno_tpu.serve.lm_pool import LMServingLoop
+    from idunno_tpu.utils.spans import SpanStore
+    from tools.trace_export import waterfall
+
+    model, params = lm
+    clk = FakeClock(200.0)
+    spans = SpanStore("q0", clock=clk)
+    srv = DecodeServer(model, params, slots=1, prompt_len=8, max_len=256)
+    loop = LMServingLoop(srv, gateway=AdmissionGateway(
+        {"batch_wait_slack": 50.0}, clock=clk), spans=spans)
+    try:
+        # two fillers occupy the slot and the server queue: the dispatch
+        # budget (2*slots - pending) pins at 0, so the traced batch
+        # request waits in the gateway until its deadline passes
+        loop.submit([1, 2, 3], 200)
+        loop.submit([4, 5, 6], 200)
+        root = spans.start("client.lm_submit")
+        rid = loop.submit([7, 8, 9], 5, priority="batch",
+                          deadline_ms=500.0, trace=root.ctx)
+        clk.advance(0.6)                 # past the deadline — fake time
+        done = {}
+        deadline = time.monotonic() + 60.0
+        while rid not in done and time.monotonic() < deadline:
+            for c in loop.poll():
+                done[c.id] = c
+            time.sleep(0.005)
+        assert done[rid].rejected == "expired"
+        spans.finish(root)
+
+        raw = spans.dump(trace_id=root.trace_id)
+        by_name = {s["name"]: s for s in raw}
+        assert set(by_name) == {"client.lm_submit", "lm.admit", "lm.expire"}
+        assert by_name["lm.admit"]["parent"] == root.span_id
+        assert by_name["lm.expire"]["parent"] \
+            == by_name["lm.admit"]["span_id"]
+        wf = waterfall(root.trace_id, raw)
+        rows = {r["name"]: r for r in wf["rows"]}
+        assert rows["lm.admit"]["offset_ms"] == 0.0
+        assert rows["lm.admit"]["ms"] == 0.0
+        assert rows["lm.expire"]["offset_ms"] == 600.0
+        assert rows["lm.expire"]["ms"] == 0.0
+        assert rows["client.lm_submit"]["ms"] == 600.0
+        assert wf["duration_ms"] == 600.0
+        assert rows["lm.expire"]["attrs"]["reason"] == "expired"
+    finally:
+        loop.stop()

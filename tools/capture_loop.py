@@ -76,6 +76,13 @@ STEPS = [
      {"BENCH_SUITE": "lm_gateway", "BENCH_TIME_BUDGET_S": "600"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_lm_gateway.json"),
+    # ISSUE 6: one traced request through a real pool on chip — the
+    # admit→queue_wait→prefill→decode_step waterfall with TPU latencies
+    # (tools/trace_export.py --capture; cheap: tiny model, one request)
+    ("trace_suite",
+     {},
+     [sys.executable, "tools/trace_export.py", "--capture"],
+     "TRACE_WATERFALL.json"),
     ("headline_resnet18",
      {"BENCH_TIME_BUDGET_S": "600"},
      [sys.executable, "bench.py"],

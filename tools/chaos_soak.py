@@ -35,7 +35,8 @@ def main() -> int:
     passed, failures = 0, []
     worst_convergence = 0.0
     epochs_total = 0
-    work = {"cnn_acked": 0, "lm_acked": 0, "sdfs_acked": 0}
+    work = {"cnn_acked": 0, "lm_acked": 0, "sdfs_acked": 0,
+            "spans_recorded": 0}
     for i in range(args.schedules):
         seed = args.seed0 + i
         try:
@@ -45,8 +46,18 @@ def main() -> int:
                     chaos={"drop": args.drop, "dup": args.dup,
                            "delay": args.delay, "seed": seed})
         except Exception as e:  # noqa: BLE001 - invariant trip is data
-            failures.append({"seed": seed, "error":
-                             f"{type(e).__name__}: {e}"[:300]})
+            rec = {"seed": seed, "error":
+                   f"{type(e).__name__}: {e}"[:300]}
+            dump = getattr(e, "span_dump", None)
+            if dump:
+                # chaos-causal dump: which traces were live on each host
+                # when the invariant tripped (replay with this seed and
+                # pipe the full dump through tools/trace_export.py)
+                rec["span_dump"] = {
+                    h: {"spans": len(spans),
+                        "traces": sorted({s["trace_id"] for s in spans})[:8]}
+                    for h, spans in dump.items()}
+            failures.append(rec)
             continue
         passed += 1
         worst_convergence = max(worst_convergence, out["convergence_s"])

@@ -1,0 +1,99 @@
+"""Prometheus scrape client for the `metrics_export` control verb.
+
+A node-agent-shaped ops tool: connect to a running node's control service
+(`comm/net.py:oneshot_call`, no listener needed), ask for its Prometheus
+text exposition (C8 counters/rates/percentiles, LM prefix-cache and QoS
+gateway gauges, comm/retry.py retry counters, span-store depth — see
+`serve/metrics.py:prometheus_text`), and print it — what a real Prometheus
+node-exporter sidecar would serve over HTTP, without growing an HTTP
+server into the control plane.
+
+    python tools/metrics_scrape.py --ip 10.0.0.2 --port 9400
+    python tools/metrics_scrape.py --selftest      # fast lane, in-process
+
+``--selftest`` builds a MetricsTracker + SpanStore in-process, renders the
+exposition, and asserts the format invariants (every series line matches
+``name{labels} value``, one ``# TYPE`` per metric, the extra counters and
+gauges land) — then prints ONE JSON line, bench.py-style.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+_SERIES = re.compile(r'^[a-z_]+\{[^}]*\} -?[0-9.e+-]+$')
+
+
+def scrape(ip: str, port: int, timeout: float = 10.0) -> str:
+    from idunno_tpu.comm.message import Message
+    from idunno_tpu.comm.net import oneshot_call
+    from idunno_tpu.utils.types import MessageType
+
+    out = oneshot_call(ip, port, "control",
+                       Message(MessageType.INFERENCE, "metrics-scrape",
+                               {"verb": "metrics_export"}),
+                       timeout=timeout)
+    if out is None or out.type is not MessageType.ACK:
+        raise RuntimeError(f"scrape failed: {out and out.payload}")
+    return out.payload["text"]
+
+
+def selftest() -> dict:
+    from idunno_tpu.serve.metrics import MetricsTracker
+    from idunno_tpu.utils.spans import SpanStore
+
+    clk = {"t": 50.0}
+    m = MetricsTracker(clock=lambda: clk["t"])
+    m.record_counter("stale_epoch_rejected", 3)
+    m.record_counter("gateway_shed_quota", 2)
+    m.record_task("resnet18", 100, 1.5, 100)
+    m.record_query_done("resnet18")
+    m.record_lm_gauges("pool", {"prefix_hit_rate": 0.5, "note": "str-skip"})
+    m.record_gateway_gauges("pool", {"queued": 4})
+    spans = SpanStore("n0", clock=lambda: clk["t"])
+    spans.record("x")
+    text = m.prometheus_text(
+        "n0", extra_counters={"retry_attempts": 7},
+        extra_gauges={"span_buffer_depth": spans.depth(),
+                      "spans_recorded_total": spans.recorded_total()})
+    lines = text.strip().split("\n")
+    series = [ln for ln in lines if not ln.startswith("# TYPE")]
+    bad = [ln for ln in series if not _SERIES.match(ln)]
+    assert not bad, f"malformed series lines: {bad}"
+    types = [ln for ln in lines if ln.startswith("# TYPE")]
+    assert len(types) == len({t.split()[2] for t in types}), \
+        "duplicate # TYPE headers"
+    for needle in ('name="stale_epoch_rejected"} 3',
+                   'name="gateway_shed_quota"} 2',
+                   'name="retry_attempts"} 7',
+                   'name="span_buffer_depth"} 1',
+                   'model="resnet18"'):
+        assert needle in text, f"missing {needle!r} in exposition"
+    assert 'note' not in text, "non-numeric gauge leaked"
+    return {"selftest": "ok", "series": len(series),
+            "metrics": len(types)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--selftest", action="store_true")
+    ap.add_argument("--ip", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9400,
+                    help="node TCP port (config.tcp_port of the target)")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args()
+    if args.selftest:
+        print(json.dumps(selftest()))
+        return
+    sys.stdout.write(scrape(args.ip, args.port, timeout=args.timeout))
+
+
+if __name__ == "__main__":
+    main()
