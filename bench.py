@@ -42,11 +42,11 @@ REFERENCE_IMAGES_PER_S = 400 / 9.0   # ≈44.4, whole reference cluster
 # beyond-parity capability and carries its own surface,
 # utils/train_bench.py).
 BENCH_SUITE = os.environ.get("BENCH_SUITE", "cnn")
-if BENCH_SUITE not in ("cnn", "lm", "lm_prefix", "lm_slots", "lm_gateway",
-                       "train"):
+if BENCH_SUITE not in ("cnn", "lm", "lm_prefix", "lm_slots", "lm_paged",
+                       "lm_gateway", "train"):
     raise SystemExit(
         f"BENCH_SUITE={BENCH_SUITE!r}: want "
-        "cnn|lm|lm_prefix|lm_slots|lm_gateway|train")
+        "cnn|lm|lm_prefix|lm_slots|lm_paged|lm_gateway|train")
 # BENCH_MODEL selects the measured network: resnet18 (headline, matches the
 # reference's "resnet"), resnet50 (bottleneck — ~4x the FLOPs/image, the
 # MXU-utilisation probe), alexnet (the other half of the reference's
@@ -65,6 +65,7 @@ METRIC = {"cnn": f"{BENCH_MODEL}_imagenet_inference_throughput",
           "lm": "lm_decode_throughput",
           "lm_prefix": "lm_prefix_cache_throughput",
           "lm_slots": "lm_slot_scaling_throughput",
+          "lm_paged": "lm_paged_decode_throughput",
           "lm_gateway": "lm_gateway_goodput",
           "train": "lm_train_throughput"}[BENCH_SUITE]
 
@@ -79,6 +80,7 @@ _LAST_GOOD = os.path.join(
      else "BENCH_LAST_GOOD_lm.json" if BENCH_SUITE == "lm"
      else "BENCH_LAST_GOOD_lm_prefix.json" if BENCH_SUITE == "lm_prefix"
      else "BENCH_LAST_GOOD_lm_slots.json" if BENCH_SUITE == "lm_slots"
+     else "BENCH_LAST_GOOD_lm_paged.json" if BENCH_SUITE == "lm_paged"
      else "BENCH_LAST_GOOD_lm_gateway.json" if BENCH_SUITE == "lm_gateway"
      else "BENCH_LAST_GOOD_train.json" if BENCH_SUITE == "train"
      else f"BENCH_LAST_GOOD_{BENCH_MODEL}.json"))
@@ -743,6 +745,17 @@ def run_lm_slots_suite(devices) -> None:
                       "lm slot-scaling measurement failed", compact=False)
 
 
+def run_lm_paged_suite(devices) -> None:
+    """BENCH_SUITE=lm_paged: steady-state decode with radix hits consumed
+    in place through the KV block table (ops/paged_attention.py) vs
+    gathered into contiguous rows, at 16/32 slots x 1k/4k contexts on
+    TPU. Headline is the best paged point's tokens/sec; per-point
+    paged-vs-gathered ratios and the pallas candidate ride in details."""
+    from idunno_tpu.utils.lm_bench import run_lm_paged_bench
+    _run_record_suite(devices, run_lm_paged_bench, "best",
+                      "lm paged-decode measurement failed", compact=False)
+
+
 def run_lm_gateway_suite(devices) -> None:
     """BENCH_SUITE=lm_gateway: goodput vs offered load through the QoS
     admission gateway — open-loop Poisson arrivals at 2x the pool's
@@ -806,6 +819,8 @@ def main() -> None:
             run_lm_prefix_suite(devices)
         elif BENCH_SUITE == "lm_slots":
             run_lm_slots_suite(devices)
+        elif BENCH_SUITE == "lm_paged":
+            run_lm_paged_suite(devices)
         elif BENCH_SUITE == "lm_gateway":
             run_lm_gateway_suite(devices)
         elif BENCH_SUITE == "train":

@@ -155,7 +155,14 @@ class ChaosControl:
             name = p["name"]
             if name in self._loops and not p.get("reload"):
                 return {"already": True}
-            self._loops[name] = {"next": 0, "done": []}
+            # prefill_chunk rides the spec (journaled, replayed on
+            # failover like every serving knob): the fake tier models a
+            # chunked admission as completion deferred by one poll round
+            # — the watchdog/poll-retry machinery must tolerate a pool
+            # that holds work across a poll without losing or duping it
+            self._loops[name] = {"next": 0, "done": [], "defer": [],
+                                 "chunk": int(p.get("prefill_chunk")
+                                              or 0)}
             for k in [k for k in self._lm_idem if k[0] == name]:
                 del self._lm_idem[k]
             return {"slots": int(p.get("slots", 4))}
@@ -174,9 +181,14 @@ class ChaosControl:
             prompt = [int(t) for t in p["prompt"]]
             toks = lm_tokens(prompt, int(p.get("seed") or 0),
                              int(p["max_new"]))
-            loop["done"].append({"id": rid, "tokens": toks,
-                                 "prompt_len": len(prompt),
-                                 "service_s": 0.001})
+            comp = {"id": rid, "tokens": toks,
+                    "prompt_len": len(prompt), "service_s": 0.001}
+            # chunked pools admit over multiple steps: completion lands
+            # a poll round later (tokens identical — chunking is pure
+            # scheduling, the exactness ledger must not notice)
+            dest = "defer" if loop["chunk"] and \
+                len(prompt) > loop["chunk"] else "done"
+            loop[dest].append(comp)
             if key is not None:
                 self._lm_idem[(name, key)] = rid
             return {"id": rid}
@@ -185,7 +197,9 @@ class ChaosControl:
             if name not in self._loops:
                 raise ValueError(f"no lm_serve pool for {name!r}; "
                                  "call lm_serve first")
-            done, self._loops[name]["done"] = self._loops[name]["done"], []
+            loop = self._loops[name]
+            done = loop["done"]
+            loop["done"], loop["defer"] = loop["defer"], []
             return {"completions": done}
         if verb == "lm_stop":
             self._loops.pop(p["name"], None)
@@ -202,8 +216,10 @@ class ChaosCluster:
 
     LM_POOL = "chaos-lm"
 
-    def __init__(self, seed: int, data_dir: str, n_hosts: int = 5) -> None:
+    def __init__(self, seed: int, data_dir: str, n_hosts: int = 5,
+                 prefill_chunk: int = 0) -> None:
         self.seed = seed
+        self.prefill_chunk = prefill_chunk
         self.rng = random.Random(seed)
         self.cfg = ClusterConfig(
             hosts=tuple(f"n{i}" for i in range(n_hosts)),
@@ -283,7 +299,9 @@ class ChaosCluster:
         # one managed decode pool up-front; its journal rides failover
         out = self._client_control("n2", {
             "verb": "lm_serve", "placement": "auto", "name": self.LM_POOL,
-            "prompt_len": 8, "max_len": 64, "slots": 4})
+            "prompt_len": 8, "max_len": 64, "slots": 4,
+            **({"prefill_chunk": self.prefill_chunk}
+               if self.prefill_chunk else {})})
         assert out.get("node") or out.get("already"), out
 
     # -- probes -----------------------------------------------------------
@@ -645,10 +663,15 @@ class ChaosCluster:
 
 
 def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
-                        chaos: dict | None = None) -> dict:
+                        chaos: dict | None = None,
+                        prefill_chunk: int = 0) -> dict:
     """One full seeded chaos run: schedule -> converge -> invariants.
-    Returns the invariant summary plus convergence time."""
-    c = ChaosCluster(seed, data_dir)
+    Returns the invariant summary plus convergence time.
+    ``prefill_chunk`` rides the managed pool's lm_serve spec (ISSUE 7):
+    the fake tier defers long-prompt completions by a poll round, so the
+    schedule exercises journaled specs + watchdog retries against a pool
+    with in-flight chunked admissions."""
+    c = ChaosCluster(seed, data_dir, prefill_chunk=prefill_chunk)
     try:
         c.run_schedule(steps=steps,
                        chaos=chaos if chaos is not None

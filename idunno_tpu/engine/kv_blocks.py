@@ -73,13 +73,17 @@ def _write_block(store: jnp.ndarray, row_leaf: jnp.ndarray,
     k_scale leaf [L, 1, T, kvh] has the same rank as an unscanned
     cached_k [1, T, h, d], so only the caller knows the layout."""
     if stacked:
-        # row_leaf is [L, 1, T, ...]; block slivers keep the depth axis
+        # row_leaf is [L, 1, T, ...]; block slivers keep the depth axis.
+        # Stacked stores are [L, N, bs, ...] (depth LEADS, block second)
+        # so the paged decode path can hand `store[l]` — a ready-made
+        # [N, bs, ...] page array — to the per-layer scan body with no
+        # moveaxis/copy (ops/paged_attention.py).
         bs = store.shape[2]
         chunk = jax.lax.dynamic_slice_in_dim(row_leaf[:, 0], off, bs,
                                              axis=1)
-    else:
-        bs = store.shape[1]
-        chunk = jax.lax.dynamic_slice_in_dim(row_leaf[0], off, bs, axis=0)
+        return store.at[:, bid].set(chunk.astype(store.dtype))
+    bs = store.shape[1]
+    chunk = jax.lax.dynamic_slice_in_dim(row_leaf[0], off, bs, axis=0)
     return store.at[bid].set(chunk.astype(store.dtype))
 
 
@@ -89,9 +93,9 @@ def _gather_blocks(store: jnp.ndarray, bids: jnp.ndarray,
     """[n blocks] → one contiguous leaf: [1, n·block_size, ...] per-block,
     [L, 1, n·block_size, ...] stacked (depth leads, batch-1 second)."""
     if stacked:
-        picked = jnp.moveaxis(store[bids], 0, 1)   # [L, n, bs, ...]
+        picked = store[:, bids]                    # [L, n, bs, ...]
         return picked.reshape(
-            (store.shape[1], 1, n * store.shape[2]) + store.shape[3:])
+            (store.shape[0], 1, n * store.shape[2]) + store.shape[3:])
     return store[bids].reshape((1, n * store.shape[1]) + store.shape[2:])
 
 
@@ -130,22 +134,32 @@ class KVBlockPool:
         self.num_blocks = num_blocks
         self.block_size = block_size
         # scanned models carry depth-stacked caches ([L, 1, bs, ...]);
-        # the stores keep the depth axis inside each block so one
-        # write/gather moves every layer's sliver at once
+        # the stores lead with the depth axis ([L, N, bs, ...]) so one
+        # write/gather moves every layer's sliver at once AND store[l]
+        # is directly the per-layer page array the paged kernel reads
         self._stacked = bool(getattr(model, "scan_layers", False))
         # batch-1 length-block_size template names the K/V leaves and
         # their per-token shapes; the stores add a leading block axis
         shapes = jax.eval_shape(lambda: init_cache(model, 1, block_size))
         self._stores: dict[str, jnp.ndarray] = {}
+        # leaf NAME ("cached_k", …) → store keystr, for kv_pages(); a
+        # stacked pool has exactly one cache leaf per name, unscanned
+        # pools have one per layer (name collisions → kv_pages refuses)
+        self._leaf_names: dict[str, str | None] = {}
         for path, leaf in jax.tree_util.tree_flatten_with_path(shapes)[0]:
             if _is_kv(path):
                 if self._stacked:
-                    shape = ((num_blocks, leaf.shape[0], block_size)
+                    # depth LEADS: [L, N, bs, ...] — store[l] is the
+                    # per-layer page array the paged kernel consumes
+                    shape = ((leaf.shape[0], num_blocks, block_size)
                              + leaf.shape[3:])
                 else:
                     shape = (num_blocks, block_size) + leaf.shape[2:]
-                self._stores[jax.tree_util.keystr(path)] = jnp.zeros(
-                    shape, leaf.dtype)
+                key = jax.tree_util.keystr(path)
+                self._stores[key] = jnp.zeros(shape, leaf.dtype)
+                name = path[-1].key
+                self._leaf_names[name] = (
+                    None if name in self._leaf_names else key)
         if not self._stores:
             raise ValueError("model's decode cache has no K/V leaves")
         self._free: list[int] = list(range(num_blocks - 1, -1, -1))
@@ -199,15 +213,56 @@ class KVBlockPool:
         """Copy token positions [offset, offset+block_size) of a batch-1
         prefill cache's K/V leaves into block ``bid``. The offset is an
         ABSOLUTE cache position — with a pool-level static prefix ahead
-        of the request tokens, the caller passes prefix_len + i."""
+        of the request tokens, the caller passes prefix_len + i.
+
+        The window must lie inside the row cache: `dynamic_slice` clamps
+        out-of-range starts SILENTLY, which would duplicate the tail
+        block's tokens into the next block and poison every later prefix
+        hit — so out-of-range offsets raise here instead."""
         src = {jax.tree_util.keystr(p): leaf for p, leaf
                in jax.tree_util.tree_flatten_with_path(row_cache)[0]
                if _is_kv(p)}
+        tok_axis = 2 if self._stacked else 1
+        row_len = next(iter(src.values())).shape[tok_axis]
+        if offset < 0 or offset + self.block_size > row_len:
+            raise ValueError(
+                f"write_block offset {offset} + block_size "
+                f"{self.block_size} outside row cache of {row_len} "
+                f"tokens (offset is an ABSOLUTE cache position — did the "
+                f"caller forget/double-count the static prefix length?)")
         b = jnp.int32(bid)
         off = jnp.int32(offset)
         for key, store in self._stores.items():
             self._stores[key] = _write_block(store, src[key], b, off,
                                              stacked=self._stacked)
+
+    def kv_pages(self) -> dict[str, jnp.ndarray]:
+        """Raw page stores by leaf name ({"cached_k", "cached_v"} plus
+        {"k_scale", "v_scale"} on int8 pools), each ``[L, N, bs, ...]``
+        — the arrays the paged decode path (`ops/paged_attention.py`)
+        reads THROUGH the block table instead of gathering. Stacked
+        (scanned) pools only: an unscanned multi-layer pool has one
+        store per layer under the same leaf name, which has no single
+        per-name page array to hand out."""
+        if not self._stacked:
+            raise ValueError(
+                "kv_pages() requires a depth-stacked (scanned) pool; "
+                "unscanned pools keep the gather path")
+        out = {}
+        for name, key in self._leaf_names.items():
+            if key is None:
+                raise ValueError(
+                    f"ambiguous page store for leaf {name!r} "
+                    f"(per-layer leaves collide)")
+            out[name] = self._stores[key]
+        return out
+
+    @property
+    def bytes_per_block(self) -> int:
+        """Bytes one block occupies across every K/V leaf store — the
+        unit of the `kv_gather_bytes_saved` gauge."""
+        return sum(int(s.size // self.num_blocks) * s.dtype.itemsize
+                   for s in self._stores.values())
 
     def gather(self, blocks: list[int]) -> Any:
         """Chain → a batch-1, length-``len(blocks)·block_size`` cache

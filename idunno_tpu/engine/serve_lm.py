@@ -47,6 +47,8 @@ from idunno_tpu.engine.kv_blocks import concat_kv_prefix
 from idunno_tpu.models.transformer import (TransformerLM, decode_apply,
                                            scan_compatible,
                                            stack_block_params)
+from idunno_tpu.ops.paged_attention import (PagedContext,
+                                            resolve_paged_kernel)
 from idunno_tpu.ops.quantize import dequantize_tree, quantize_tree
 from idunno_tpu.ops.sampling import (filter_on as _filter_on,
                                      filtered_probs, fused_decode_tail,
@@ -177,7 +179,20 @@ def _prefill_suffix(model: TransformerLM, params: Any, prefix_cache: Any,
     static ``prefix_len`` values stay a bounded compile set."""
     total = prefix_len + prompt_len
     dec = decode_model(model, total)
-    cache = init_cache(model, 1, total)
+    cache = _splice_prefix(init_cache(model, 1, total), prefix_cache)
+    cache = _set_scalar_cursor(cache, prefix_len)
+    params = dequantize_tree(params)
+    logits, cache = decode_apply(dec, params, cache,
+                                 suffix.astype(jnp.int32))
+    last = jax.lax.dynamic_index_in_dim(logits[0], true_len - 1, axis=0,
+                                        keepdims=False)     # [vocab]
+    return cache, last
+
+
+def _splice_prefix(cache: Any, prefix_cache: Any) -> Any:
+    """Write a cached prefix's K/V leaves into the head of a (longer)
+    fresh cache — the splice `_prefill_suffix` does inline, shared with
+    the paged/chunked prefill twins."""
     src = {jax.tree_util.keystr(p): leaf for p, leaf
            in jax.tree_util.tree_flatten_with_path(prefix_cache)[0]}
 
@@ -188,14 +203,89 @@ def _prefill_suffix(model: TransformerLM, params: Any, prefix_cache: Any,
         kv = src[jax.tree_util.keystr(path)]
         return jax.lax.dynamic_update_slice(dst, kv, (0,) * dst.ndim)
 
-    cache = jax.tree_util.tree_map_with_path(put, cache)
+    return jax.tree_util.tree_map_with_path(put, cache)
+
+
+def _make_paged_ctx(pages: dict, tables: jnp.ndarray, lengths: jnp.ndarray,
+                    start: int, kernel: str, interpret: bool
+                    ) -> PagedContext:
+    """PagedContext from a `KVBlockPool.kv_pages()` dict (int8 pools
+    carry scale pages; the resolver already forced kernel='xla' there)."""
+    return PagedContext(
+        pages["cached_k"], pages["cached_v"], tables, lengths,
+        k_scale_pages=pages.get("k_scale"),
+        v_scale_pages=pages.get("v_scale"),
+        start=start, kernel=kernel, interpret=interpret)
+
+
+@partial(jax.jit, static_argnames=("model", "prefix_len", "prompt_len",
+                                  "start", "kernel", "interpret"))
+def _prefill_suffix_paged(model: TransformerLM, params: Any,
+                          prefix_cache: Any, suffix: jnp.ndarray,
+                          true_len: jnp.ndarray, prefix_len: int,
+                          prompt_len: int, tables: jnp.ndarray,
+                          plen: jnp.ndarray, pages: dict, *, start: int,
+                          kernel: str, interpret: bool):
+    """The gather-free twin of `_prefill_suffix`: the radix-hit region
+    [start, prefix_len) is NOT spliced into the fresh cache — it stays
+    zero (and the paged mask exclusion keeps it invisible) while the
+    suffix attends those positions THROUGH the block table
+    (`ops.paged_attention`). Only the pool-level static prefix
+    [0, start), if any, is spliced contiguously. ``prefix_len`` is still
+    static (block-aligned hits keep the compile set bounded, exactly as
+    in `_prefill_suffix`); the written suffix then lands at the same
+    absolute positions as the gathered path, so the radix insert from
+    this row cache stays block-exact."""
+    total = prefix_len + prompt_len
+    dec = decode_model(model, total)
+    cache = init_cache(model, 1, total)
+    if prefix_cache is not None:
+        cache = _splice_prefix(cache, prefix_cache)
     cache = _set_scalar_cursor(cache, prefix_len)
     params = dequantize_tree(params)
+    ctx = _make_paged_ctx(pages, tables, plen, start, kernel, interpret)
     logits, cache = decode_apply(dec, params, cache,
-                                 suffix.astype(jnp.int32))
+                                 suffix.astype(jnp.int32), paged=ctx)
     last = jax.lax.dynamic_index_in_dim(logits[0], true_len - 1, axis=0,
                                         keepdims=False)     # [vocab]
     return cache, last
+
+
+@partial(jax.jit, static_argnames=("model", "total"))
+def _chunk_init(model: TransformerLM, prefix_cache: Any, total: int):
+    """Fresh batch-1 length-``total`` cache with an optional contiguous
+    prefix spliced in — the starting state of a chunked prefill
+    (`DecodeServer._advance_prefill`). The cursor is set per chunk."""
+    cache = init_cache(model, 1, total)
+    if prefix_cache is not None:
+        cache = _splice_prefix(cache, prefix_cache)
+    return cache
+
+
+@partial(jax.jit, static_argnames=("model", "total", "start", "kernel",
+                                   "interpret"))
+def _prefill_chunk(model: TransformerLM, params: Any, cache: Any,
+                   tok: jnp.ndarray, cursor: jnp.ndarray, total: int,
+                   tables: jnp.ndarray | None, plen: jnp.ndarray | None,
+                   pages: dict | None, *, start: int = 0,
+                   kernel: str = "xla", interpret: bool = False):
+    """ONE chunk of a chunked prefill: ``tok`` [1, n] applies from
+    ``cursor`` (traced — every chunk of every admission reuses the same
+    compile per (total, n)). The scalar-cursor t>1 branch writes K/V at
+    cursor..cursor+n-1 and masks per position, so chunk boundaries are
+    invisible: N chunks produce the identical cache and logits as one
+    length-``Σn`` apply (`tests/test_serve_lm.py` pins this). ``tables``
+    None = no paged radix hit for this admission."""
+    dec = decode_model(model, total)
+    cache = _set_scalar_cursor(cache, cursor)
+    params = dequantize_tree(params)
+    ctx = None
+    if tables is not None:
+        ctx = _make_paged_ctx(pages, tables, plen, start, kernel,
+                              interpret)
+    logits, cache = decode_apply(dec, params, cache,
+                                 tok.astype(jnp.int32), paged=ctx)
+    return cache, logits
 
 
 # _safe_log/_filter_on/_row_sample_logits live in `ops.sampling` (shared
@@ -407,7 +497,9 @@ class DecodeServer:
                  penalties: bool = False,
                  prefix: list[int] | None = None,
                  kv_block_size: int = 0,
-                 kv_cache_blocks: int = 0) -> None:
+                 kv_cache_blocks: int = 0,
+                 paged_kernel: str | None = None,
+                 prefill_chunk: int = 0) -> None:
         if not model.causal:
             raise ValueError("continuous batching needs a causal LM")
         if prompt_len > max_len:
@@ -439,6 +531,27 @@ class DecodeServer:
                 f"kv_block_size {kv_block_size} must be >= 0 (0 = off)")
         if kv_cache_blocks and not self.kv_block_size:
             raise ValueError("kv_cache_blocks needs kv_block_size > 0")
+        # block-native paged attention (ops/paged_attention.py): radix
+        # hits attend THROUGH the block table instead of being gathered
+        # back into the slot cache. None = legacy gathered path (the
+        # earn-it-or-swap default until `paged_suite` blesses the kernel
+        # on real hardware).
+        if paged_kernel is not None and not self.kv_block_size:
+            raise ValueError("paged_kernel needs kv_block_size > 0")
+        self.paged_kernel = (None if paged_kernel is None else
+                             resolve_paged_kernel(
+                                 paged_kernel,
+                                 int8=model.kv_cache_dtype == "int8"))
+        self._paged = paged_kernel is not None
+        # chunked prefill: long suffixes apply prefill_chunk tokens at a
+        # time, one chunk per step() call, so resident rows keep decoding
+        # between chunks. 0 = off (one-shot prefill). Independent of the
+        # paged path — the gathered path chunks too.
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} must be >= 0 (0 = off)")
+        self._pending: dict | None = None   # in-flight chunked admission
         self._block_pool = self._radix = None
         self._held: dict[int, list] = {}   # live request id → pinned chain
         # optional per-node span recorder (utils/spans.py), set by the
@@ -521,6 +634,16 @@ class DecodeServer:
             model = dataclasses.replace(model, scan_layers=True)
             params = stack_block_params(params, model.depth)
         self._scan = bool(getattr(model, "scan_layers", False))
+        if self._paged and not self._scan:
+            # decode_apply threads PagedContext through the ONE lax.scan
+            # body; the unscanned per-layer loop never grew the plumbing
+            # (MoE pools keep the gathered path)
+            raise ValueError("paged_kernel requires the scanned decode "
+                             "layout (dense scan-compatible blocks)")
+        # CPU tier runs the real kernel under the Pallas interpreter so
+        # tier-1 tests exercise the exact kernel the TPU compiles
+        self._paged_interpret = jax.devices()[0].platform != "tpu"
+        self._pl_static = len(self.prefix) if self.prefix else 0
         self.model = model
         self.params = params
         self.slots = slots
@@ -596,6 +719,18 @@ class DecodeServer:
             cache_shapes)
         self._cursors = zeros((slots,), jnp.int32)
         self._remaining = zeros((slots,), jnp.int32)
+        # paged decode state: per-slot block table + paged-region length
+        # (tokens resident in blocks, always a block multiple). Width =
+        # the longest possible radix hit — capped one block short of the
+        # largest bucket by `_admit`'s hit cap. Retired rows leave stale
+        # entries behind: finite garbage whose outputs are gated by
+        # remaining == 0, never read as live state.
+        self._tables = self._plens = None
+        if self._paged:
+            self._max_chain = max(
+                1, (prompt_len - 1) // self.kv_block_size)
+            self._tables = zeros((slots, self._max_chain), jnp.int32)
+            self._plens = zeros((slots,), jnp.int32)
         # host cache of (remaining, cursors), fetched as ONE stacked D2H
         # transfer and reused until a device-side mutation invalidates it:
         # step() consults these arrays several times per dispatch, and
@@ -644,7 +779,9 @@ class DecodeServer:
                        # padded suffix tokens actually computed by
                        # admission prefills — the work the prefix cache
                        # exists to shrink (bench comparison counter)
-                       "prefill_tokens": 0}
+                       "prefill_tokens": 0,
+                       # paged/chunked win counters (gauges via lm_stats)
+                       "prefill_chunks": 0, "kv_gather_bytes_saved": 0}
         # prefix-cache counters (zero-cost when the cache is off)
         self._pc_lookups = self._pc_hits = self._pc_tokens_saved = 0
 
@@ -697,10 +834,20 @@ class DecodeServer:
         dec = self._dec
         track = self.track_logprobs     # static: traced once
         pen = self.penalties            # static: traced once
+        paged = self._paged             # static: traced once
 
         def run(params, tokens, cache, cursors, remaining, temps,
-                top_ps, top_ks, keys, logprobs, pres, freq, counts):
+                top_ps, top_ks, keys, logprobs, pres, freq, counts,
+                tables=None, plens=None, pages=None):
             params = dequantize_tree(params)   # int8 stays HBM-resident
+            # paged pool: every step attends the radix-hit region through
+            # the block table (ops/paged_attention.py) — the pool's pages
+            # ride in as read-only args (NOT donated: blocks are shared
+            # across rows and with the radix tree)
+            ctx = (_make_paged_ctx(pages, tables, plens, self._pl_static,
+                                   self.paged_kernel,
+                                   self._paged_interpret)
+                   if paged else None)
 
             def body(_, carry):
                 (tokens, cache, cursors, remaining, keys, logprobs,
@@ -710,7 +857,8 @@ class DecodeServer:
                 # decode_apply: the scanned step (one lax.scan over the
                 # stacked layers) on scan-compatible pools, the flax
                 # per-layer loop otherwise
-                logits, cache = decode_apply(dec, params, cache, tok)
+                logits, cache = decode_apply(dec, params, cache, tok,
+                                             paged=ctx)
                 # the whole post-model tail — penalties, sampling pick,
                 # token/logprob scatter, cursor/remaining/EOS/count
                 # bookkeeping — is ONE fused helper (`ops.sampling.
@@ -774,9 +922,18 @@ class DecodeServer:
         track = self.track_logprobs     # static: traced once
 
         def run(params, dparams, tokens, cache, dcache, cursors,
-                remaining, temps, top_ps, top_ks, keys, logprobs):
+                remaining, temps, top_ps, top_ks, keys, logprobs,
+                tables=None, plens=None, pages=None):
             params = dequantize_tree(params)
             dparams = dequantize_tree(dparams)
+            # paged pool: only the TARGET verify attends through the
+            # block table — the draft keeps its own contiguous cache (it
+            # prefills the full prompt through its own weights, so its
+            # hit region is never zeroed)
+            ctx = (_make_paged_ctx(pages, tables, plens, self._pl_static,
+                                   self.paged_kernel,
+                                   self._paged_interpret)
+                   if self._paged else None)
             s = tokens.shape[0]
             rows = jnp.arange(s)
             sampled = temps > 0.0                            # [S]
@@ -892,7 +1049,8 @@ class DecodeServer:
                 # -- 2. target: verify the whole chunk in one apply ----------
                 cache = _set_cursors(cache, cursors)
                 tin = jnp.concatenate([prev[:, None], proposals], axis=1)
-                logits, cache = decode_apply(dec, params, cache, tin)
+                logits, cache = decode_apply(dec, params, cache, tin,
+                                             paged=ctx)
                 logits = logits.astype(jnp.float32)
                 tpred = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S,γ+1]
 
@@ -1054,6 +1212,22 @@ class DecodeServer:
         tokens it had ("live"); anything else — already completed or never
         seen — is "unknown". Idempotent: cancelling twice is "unknown" the
         second time."""
+        if self._pending is not None and self._pending["req"].id == rid:
+            # mid-chunked-prefill: drop the pending admission whole — it
+            # was never live, so the completion mirrors the queued shape
+            p, self._pending = self._pending, None
+            if p["hit_chain"]:          # the temporary hit pins
+                self._radix.release(p["hit_chain"])
+            if p["span"] is not None:
+                self.spans.finish(p["span"], cancelled=True,
+                                  chunks=p["chunks"])
+            full = (self.prefix or []) + list(p["per_req"])
+            self._done.append(Completion(
+                id=rid, tokens=full, prompt_len=len(full),
+                cancelled=True,
+                logprobs=[] if self.track_logprobs else None))
+            self._stats["cancelled"] += 1
+            return "queued"
         for i, req in enumerate(self._queue):
             if req.id == rid:
                 del self._queue[i]
@@ -1101,7 +1275,8 @@ class DecodeServer:
                 for slot, req in sorted(self._live.items())]
 
     def pending(self) -> int:
-        return len(self._queue) + len(self._live)
+        return (len(self._queue) + len(self._live)
+                + (1 if self._pending is not None else 0))
 
     def stats(self) -> dict:
         """Serving counters: decode dispatches (``decode_steps`` tokens —
@@ -1126,6 +1301,8 @@ class DecodeServer:
                                       if self._draft_model is not None
                                       else None),
             "kv_block_size": self.kv_block_size,
+            "paged_kernel": self.paged_kernel,
+            "prefill_chunk": self.prefill_chunk,
             "kv_cache_blocks": (self._block_pool.num_blocks
                                 if self._block_pool is not None else 0),
             "scan_layers": self._scan,
@@ -1198,6 +1375,11 @@ class DecodeServer:
                     self._radix.release(chain)
 
     def _admit(self) -> None:
+        if self._pending is not None:
+            # a chunked prefill is in flight: its slot is reserved and
+            # admissions stay FIFO behind it (`step` advances it by one
+            # chunk per call, decode dispatches landing in between)
+            return
         free = [s for s in range(self.slots) if s not in self._live]
         while free and self._queue:
             slot = free.pop(0)
@@ -1246,7 +1428,62 @@ class DecodeServer:
             suffix = np.zeros((1, suffix_bucket), np.int32)
             suffix[0, :suffix_true - hit] = per_req[hit:]
             self._stats["prefill_tokens"] += suffix_bucket
-            if hit:
+            # paged pools never gather the hit back: the batch-1 table
+            # (exact chain width — the compile set is already keyed on
+            # hit via prefix_len) lets the suffix attend the hit region
+            # through the blocks
+            tab_np = plen_np = None
+            if self._paged and hit:
+                nb = hit // self.kv_block_size
+                tab_np = np.asarray(
+                    [[nd.block for nd in hit_chain[:nb]]], np.int32)
+                plen_np = np.asarray([hit], np.int32)
+            if self.prefill_chunk and suffix_bucket > self.prefill_chunk:
+                # chunked prefill: park the admission as `_pending` and
+                # apply `prefill_chunk` tokens per step() call, decode
+                # dispatches of resident rows landing between chunks.
+                # The scalar-cursor apply writes K/V per position and
+                # masks per query, so N chunks build the identical row
+                # cache and last-token logits as the one-shot apply.
+                total = pl + hit + suffix_bucket
+                if hit and tab_np is None:
+                    gathered = self._block_pool.gather(
+                        [nd.block for nd in hit_chain])
+                    pre = (concat_kv_prefix(
+                        self._prefix_cache, gathered,
+                        token_axis=2 if self._scan else 1)
+                        if self.prefix else gathered)
+                else:   # paged hit (hit region stays zero) or no hit
+                    pre = self._prefix_cache if self.prefix else None
+                sp = None
+                if t_prefill0 is not None:
+                    sp = self.spans.start(
+                        "lm.prefill", trace=req.trace[0],
+                        parent=req.trace[1],
+                        attrs={"id": req.id, "prompt_len": suffix_true,
+                               "prefix_hit": hit,
+                               "bucket": suffix_bucket, "chunked": True})
+                    sp.t_start = t_prefill0
+                self._pending = {
+                    "req": req, "slot": slot,
+                    "cache": _chunk_init(self._prefill_model, pre, total),
+                    "suffix": suffix, "true": suffix_true - hit,
+                    "suffix_true": suffix_true, "cursor0": pl + hit,
+                    "bucket": suffix_bucket, "off": 0, "hit": hit,
+                    "hit_chain": hit_chain, "per_req": per_req, "pl": pl,
+                    "last": None, "total": total, "tables": tab_np,
+                    "plen": plen_np, "span": sp, "chunks": 0}
+                self._advance_prefill()   # first chunk lands this step
+                return
+            if hit and tab_np is not None:
+                row_cache, last_logits = _prefill_suffix_paged(
+                    self._prefill_model, self.params, self._prefix_cache,
+                    jnp.asarray(suffix), jnp.int32(suffix_true - hit),
+                    pl + hit, suffix_bucket, jnp.asarray(tab_np),
+                    jnp.asarray(plen_np), self._block_pool.kv_pages(),
+                    start=pl, kernel=self.paged_kernel,
+                    interpret=self._paged_interpret)
+            elif hit:
                 gathered = self._block_pool.gather(
                     [nd.block for nd in hit_chain])
                 # stacked caches carry the token axis at 2 (depth, batch,
@@ -1267,96 +1504,180 @@ class DecodeServer:
                 row_cache, last_logits = _prefill(
                     self._prefill_model, self.params, jnp.asarray(suffix),
                     jnp.int32(suffix_true), suffix_bucket)
-            if self._radix is not None:
-                # seed/extend the tree from this prefill's row cache and
-                # pin the request's full chain for its lifetime (insert
-                # returns it acquired); the temporary hit pins drop
-                chain = self._radix.insert(per_req, row_cache, pl)
-                if hit_chain:
-                    self._radix.release(hit_chain)
-                if chain:
-                    self._held[req.id] = chain
-            if hit or self.prefix:
-                # downstream state (tokens row, cursors, prompt_len,
-                # stop/logprob regions) sees the FULL prompt
-                full = np.zeros((1, pl + hit + suffix_bucket), np.int32)
-                if self.prefix:
-                    full[0, :pl] = self.prefix
-                    req = dataclasses.replace(
-                        req, tokens=self.prefix + per_req)
-                full[0, pl:pl + suffix_true] = per_req
-                prompt, true_len = full, pl + suffix_true
-                bucket = pl + hit + suffix_bucket
-            else:
-                prompt, true_len, bucket = suffix, suffix_true, suffix_bucket
-            temp = jnp.float32(req.temperature)
-            topp = jnp.float32(req.top_p)
-            topk = jnp.int32(req.top_k)
-            seed = req.id if req.seed is None else req.seed
-            first, key = _pick_first(last_logits, temp,
-                                     jax.random.PRNGKey(seed), topp, topk)
-            self._tokens, self._cache = _insert(
-                self._tokens, self._cache, row_cache, jnp.asarray(prompt),
-                first, jnp.int32(true_len), jnp.int32(slot), bucket,
-                stacked=self._scan)
-            if self._draft_model is not None:
-                # the draft needs the FULL request prompt through ITS
-                # OWN weights (a radix hit only covers the target's
-                # cache; suffix-only applies just past the pool's shared
-                # static prefix)
-                dbucket = next(b for b in self.prompt_buckets
-                               if b >= suffix_true)
-                dsuffix = np.zeros((1, dbucket), np.int32)
-                dsuffix[0, :suffix_true] = per_req
-                if self.prefix:
-                    drow, _ = _prefill_suffix(
-                        self._draft_model, self._draft_params,
-                        self._draft_prefix_cache, jnp.asarray(dsuffix),
-                        jnp.int32(suffix_true), len(self.prefix),
-                        dbucket)
-                else:
-                    drow, _ = _prefill(
-                        self._draft_model, self._draft_params,
-                        jnp.asarray(dsuffix), jnp.int32(suffix_true),
-                        dbucket)
-                self._draft_cache = _insert_cache(
-                    self._draft_cache, drow, jnp.int32(slot),
-                    stacked=bool(getattr(self._draft_model, "scan_layers",
-                                         False)))
-            self._cursors = self._cursors.at[slot].set(true_len)
-            self._temps = self._temps.at[slot].set(temp)
-            self._top_ps = self._top_ps.at[slot].set(topp)
-            self._top_ks = self._top_ks.at[slot].set(topk)
-            self._keys = self._keys.at[slot].set(key)
-            if self.track_logprobs:   # the prefill-picked token's logprob
-                lp0 = jax.nn.log_softmax(
-                    last_logits.astype(jnp.float32))[first]
-                self._logprobs = self._logprobs.at[slot, true_len].set(lp0)
-            if self.penalties:   # fresh row; the first token counts.
-                # validate() guarantees zero penalties off-flag, so the
-                # buffers are only ever touched when the kernel reads them
-                self._pres = self._pres.at[slot].set(
-                    jnp.float32(req.presence_penalty))
-                self._freq = self._freq.at[slot].set(
-                    jnp.float32(req.frequency_penalty))
-                self._counts = self._counts.at[slot].set(0)
-                self._counts = self._counts.at[slot, first].set(1)
-            rem = req.max_new - 1
-            if self.eos_id is not None and int(first) == self.eos_id:
-                rem = 0                   # the prompt's very next token
-            self._remaining = self._remaining.at[slot].set(rem)
-            self._rc_invalidate()
-            if t_prefill0 is not None:
-                sp = self.spans.record(
-                    "lm.prefill", trace=req.trace[0], parent=req.trace[1],
-                    t_start=t_prefill0,
-                    attrs={"id": req.id, "prompt_len": suffix_true,
-                           "prefix_hit": hit, "bucket": suffix_bucket})
-                # decode-step spans chain under the prefill
+            self._finish_admission(
+                req, slot, row_cache, last_logits, hit=hit,
+                hit_chain=hit_chain, per_req=per_req, pl=pl,
+                suffix_true=suffix_true, suffix_bucket=suffix_bucket,
+                suffix=suffix, t_prefill0=t_prefill0)
+            # max_new == 1: the prefill's token was the only one; the next
+            # _retire_finished pass (step() runs one post-admission)
+            # retires the row before any decode dispatch
+
+    def _advance_prefill(self) -> None:
+        """Apply ONE chunk of the pending chunked admission. Called once
+        per `step` (before `_admit`), so every chunk of a long prompt has
+        a decode dispatch of the resident rows between it and the next —
+        the fairness property `tests/test_serve_lm.py` asserts."""
+        p = self._pending
+        n = min(self.prefill_chunk, p["bucket"] - p["off"])
+        tok = jnp.asarray(p["suffix"][:, p["off"]:p["off"] + n])
+        cursor = jnp.int32(p["cursor0"] + p["off"])
+        if p["tables"] is not None:
+            cache, logits = _prefill_chunk(
+                self._prefill_model, self.params, p["cache"], tok,
+                cursor, p["total"], jnp.asarray(p["tables"]),
+                jnp.asarray(p["plen"]), self._block_pool.kv_pages(),
+                start=p["pl"], kernel=self.paged_kernel,
+                interpret=self._paged_interpret)
+        else:
+            cache, logits = _prefill_chunk(
+                self._prefill_model, self.params, p["cache"], tok,
+                cursor, p["total"], None, None, None)
+        p["cache"] = cache
+        # the first-token logits live at true-1 (suffix coordinates) —
+        # capture them from whichever chunk covers that position
+        t = p["true"]
+        if p["off"] <= t - 1 < p["off"] + n:
+            p["last"] = logits[0, t - 1 - p["off"]]
+        p["chunks"] += 1
+        self._stats["prefill_chunks"] += 1
+        if p["span"] is not None:
+            self.spans.record(
+                "lm.prefill_chunk", trace=p["span"].trace_id,
+                parent=p["span"].span_id,
+                attrs={"id": p["req"].id, "chunk": p["chunks"] - 1,
+                       "tokens": int(n)})
+        p["off"] += n
+        if p["off"] >= p["bucket"]:
+            self._pending = None
+            self._finish_admission(
+                p["req"], p["slot"], p["cache"], p["last"], hit=p["hit"],
+                hit_chain=p["hit_chain"], per_req=p["per_req"],
+                pl=p["pl"], suffix_true=p["suffix_true"],
+                suffix_bucket=p["bucket"], suffix=p["suffix"],
+                open_span=p["span"], chunks=p["chunks"])
+
+    def _finish_admission(self, req, slot: int, row_cache, last_logits, *,
+                          hit: int, hit_chain: list, per_req: list,
+                          pl: int, suffix_true: int, suffix_bucket: int,
+                          suffix: np.ndarray, t_prefill0=None,
+                          open_span=None, chunks: int = 0) -> None:
+        """Everything after the row cache exists: radix insert + pinning,
+        paged table install, slot splice, per-slot sampler state, spans.
+        Shared verbatim by the one-shot (`_admit`) and chunked
+        (`_advance_prefill`) prefill paths so they cannot drift."""
+        if self._radix is not None:
+            # seed/extend the tree from this prefill's row cache and
+            # pin the request's full chain for its lifetime (insert
+            # returns it acquired); the temporary hit pins drop. On the
+            # paged path the hit region of `row_cache` is ZERO — insert
+            # walks the existing (hit) nodes without writing them, so
+            # zeros never reach the blocks, and the returned chain keeps
+            # the table's blocks pinned in `_held`.
+            chain = self._radix.insert(per_req, row_cache, pl)
+            if hit_chain:
+                self._radix.release(hit_chain)
+            if chain:
+                self._held[req.id] = chain
+        if self._paged:
+            nb = hit // self.kv_block_size
+            tab = np.zeros((self._max_chain,), np.int32)
+            if nb:
+                tab[:nb] = [nd.block for nd in hit_chain[:nb]]
+                # the gathered path would have copied these blocks into
+                # the contiguous prefix at admission — the win the gauge
+                # counts
+                self._stats["kv_gather_bytes_saved"] += (
+                    nb * self._block_pool.bytes_per_block)
+            self._tables = self._tables.at[slot].set(jnp.asarray(tab))
+            self._plens = self._plens.at[slot].set(hit)
+        if hit or self.prefix:
+            # downstream state (tokens row, cursors, prompt_len,
+            # stop/logprob regions) sees the FULL prompt
+            full = np.zeros((1, pl + hit + suffix_bucket), np.int32)
+            if self.prefix:
+                full[0, :pl] = self.prefix
                 req = dataclasses.replace(
-                    req, trace=(req.trace[0], sp.span_id))
-            self._live[slot] = req
-            self._stats["admitted"] += 1
+                    req, tokens=self.prefix + per_req)
+            full[0, pl:pl + suffix_true] = per_req
+            prompt, true_len = full, pl + suffix_true
+            bucket = pl + hit + suffix_bucket
+        else:
+            prompt, true_len, bucket = suffix, suffix_true, suffix_bucket
+        temp = jnp.float32(req.temperature)
+        topp = jnp.float32(req.top_p)
+        topk = jnp.int32(req.top_k)
+        seed = req.id if req.seed is None else req.seed
+        first, key = _pick_first(last_logits, temp,
+                                 jax.random.PRNGKey(seed), topp, topk)
+        self._tokens, self._cache = _insert(
+            self._tokens, self._cache, row_cache, jnp.asarray(prompt),
+            first, jnp.int32(true_len), jnp.int32(slot), bucket,
+            stacked=self._scan)
+        if self._draft_model is not None:
+            # the draft needs the FULL request prompt through ITS
+            # OWN weights (a radix hit only covers the target's
+            # cache; suffix-only applies just past the pool's shared
+            # static prefix)
+            dbucket = next(b for b in self.prompt_buckets
+                           if b >= suffix_true)
+            dsuffix = np.zeros((1, dbucket), np.int32)
+            dsuffix[0, :suffix_true] = per_req
+            if self.prefix:
+                drow, _ = _prefill_suffix(
+                    self._draft_model, self._draft_params,
+                    self._draft_prefix_cache, jnp.asarray(dsuffix),
+                    jnp.int32(suffix_true), len(self.prefix),
+                    dbucket)
+            else:
+                drow, _ = _prefill(
+                    self._draft_model, self._draft_params,
+                    jnp.asarray(dsuffix), jnp.int32(suffix_true),
+                    dbucket)
+            self._draft_cache = _insert_cache(
+                self._draft_cache, drow, jnp.int32(slot),
+                stacked=bool(getattr(self._draft_model, "scan_layers",
+                                     False)))
+        self._cursors = self._cursors.at[slot].set(true_len)
+        self._temps = self._temps.at[slot].set(temp)
+        self._top_ps = self._top_ps.at[slot].set(topp)
+        self._top_ks = self._top_ks.at[slot].set(topk)
+        self._keys = self._keys.at[slot].set(key)
+        if self.track_logprobs:   # the prefill-picked token's logprob
+            lp0 = jax.nn.log_softmax(
+                last_logits.astype(jnp.float32))[first]
+            self._logprobs = self._logprobs.at[slot, true_len].set(lp0)
+        if self.penalties:   # fresh row; the first token counts.
+            # validate() guarantees zero penalties off-flag, so the
+            # buffers are only ever touched when the kernel reads them
+            self._pres = self._pres.at[slot].set(
+                jnp.float32(req.presence_penalty))
+            self._freq = self._freq.at[slot].set(
+                jnp.float32(req.frequency_penalty))
+            self._counts = self._counts.at[slot].set(0)
+            self._counts = self._counts.at[slot, first].set(1)
+        rem = req.max_new - 1
+        if self.eos_id is not None and int(first) == self.eos_id:
+            rem = 0                   # the prompt's very next token
+        self._remaining = self._remaining.at[slot].set(rem)
+        self._rc_invalidate()
+        if open_span is not None:
+            # chunked path: close the span opened at admission (its
+            # children are the per-chunk records)
+            sp = self.spans.finish(open_span, chunks=chunks)
+            req = dataclasses.replace(
+                req, trace=(req.trace[0], sp.span_id))
+        elif t_prefill0 is not None:
+            sp = self.spans.record(
+                "lm.prefill", trace=req.trace[0], parent=req.trace[1],
+                t_start=t_prefill0,
+                attrs={"id": req.id, "prompt_len": suffix_true,
+                       "prefix_hit": hit, "bucket": suffix_bucket})
+            # decode-step spans chain under the prefill
+            req = dataclasses.replace(
+                req, trace=(req.trace[0], sp.span_id))
+        self._live[slot] = req
+        self._stats["admitted"] += 1
             # max_new == 1: the prefill's token was the only one; the next
             # _retire_finished pass (step() runs one post-admission) retires
             # the row before any decode dispatch
@@ -1414,12 +1735,18 @@ class DecodeServer:
         max_new=1 admission can retire instantly, leaving 0 live rows with
         the queue non-empty, so live alone would end a client loop early)."""
         self._retire_finished()
+        if self._pending is not None:
+            # one chunk of the in-flight long admission, THEN the decode
+            # dispatch below — resident rows advance between chunks
+            self._advance_prefill()
         self._admit()
         self._retire_finished()           # max_new == 1 admissions
         if self._live:
             t_step0 = (self.spans.clock() if self.spans is not None
                        and any(r.trace for r in self._live.values())
                        else None)
+            pg = ((self._tables, self._plens,
+                   self._block_pool.kv_pages()) if self._paged else ())
             if self._draft_model is not None:
                 (self._tokens, self._cache, self._draft_cache,
                  self._cursors, self._remaining,
@@ -1427,7 +1754,7 @@ class DecodeServer:
                     self.params, self._draft_params, self._tokens,
                     self._cache, self._draft_cache, self._cursors,
                     self._remaining, self._temps, self._top_ps,
-                    self._top_ks, self._keys, self._logprobs)
+                    self._top_ks, self._keys, self._logprobs, *pg)
             else:
                 (self._tokens, self._cache, self._cursors,
                  self._remaining, self._keys, self._logprobs,
@@ -1435,7 +1762,7 @@ class DecodeServer:
                     self.params, self._tokens, self._cache, self._cursors,
                     self._remaining, self._temps, self._top_ps,
                     self._top_ks, self._keys, self._logprobs,
-                    self._pres, self._freq, self._counts)
+                    self._pres, self._freq, self._counts, *pg)
             self._stats["dispatches"] += 1
             if t_step0 is not None:
                 batch = len(self._live)
@@ -1448,7 +1775,8 @@ class DecodeServer:
             self._rc_invalidate()         # the dispatch advanced the rows
             self._apply_stops()
             self._retire_finished()
-        return len(self._live) + len(self._queue)
+        return (len(self._live) + len(self._queue)
+                + (1 if self._pending is not None else 0))
 
     def run_until_drained(self, max_steps: int = 10_000) -> list[Completion]:
         """Drive `step` until queue and slots are empty; returns every

@@ -19,6 +19,8 @@ import flax.linen as nn
 import jax.numpy as jnp
 import jax
 
+from idunno_tpu.ops.paged_attention import (merge_attention,
+                                            paged_attention_grouped)
 from idunno_tpu.parallel.ring_attention import full_attention
 
 AttnFn = Callable[..., jnp.ndarray]     # (q, k, v, *, causal) -> out
@@ -136,7 +138,7 @@ class MultiHeadAttention(nn.Module):
         return kv
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, paged=None):
         b, t, _ = x.shape
         head_dim = self.dim // self.num_heads
         kv_heads = self._kv_heads
@@ -146,7 +148,9 @@ class MultiHeadAttention(nn.Module):
         k = dense(features=(kv_heads, head_dim), name="k")(x)
         v = dense(features=(kv_heads, head_dim), name="v")(x)
         if self.decode:
-            return self._decode_step(q, k, v)
+            return self._decode_step(q, k, v, paged=paged)
+        if paged is not None:
+            raise ValueError("paged KV attention is a decode-mode feature")
         if self.use_rope:
             q, k = rope(q), rope(k)
         if kv_heads != self.num_heads:
@@ -163,7 +167,7 @@ class MultiHeadAttention(nn.Module):
                                param_dtype=self.param_dtype,
                                name="out")(out)
 
-    def _decode_step(self, q, k, v):
+    def _decode_step(self, q, k, v, paged=None):
         """Autoregressive serving against the KV cache — three shapes:
 
         scalar cursor, t=1: one token in, one out (``engine.generate``);
@@ -180,7 +184,16 @@ class MultiHeadAttention(nn.Module):
         Uses its own cached softmax-attention kernel — any correct causal
         ``attn_fn`` (full/ring/flash) is numerically equivalent, so the
         training-time kernel choice does not matter here; non-causal models
-        cannot be decoded autoregressively and are rejected."""
+        cannot be decoded autoregressively and are rejected.
+
+        ``paged`` (an `ops.paged_attention.PagedContext`) splits the key
+        space: cache positions [paged.start, paged.start + lengths[r])
+        of row r are EXCLUDED from the slot-local mask and served from
+        the block pool THROUGH the block table instead (no contiguous
+        gather); the two normalized partials merge exactly via their
+        log-sum-exps (`merge_attention`). A row's own chunk positions
+        always sit beyond its paged region, so the local partial is
+        never empty; zero-length chains contribute weight exactly 0."""
         if self.max_decode_len <= 0:
             raise ValueError("decode=True needs max_decode_len > 0")
         if not self.causal:
@@ -259,8 +272,14 @@ class MultiHeadAttention(nn.Module):
                 if quant:
                     ks.value, vs.value = new_ks, new_vs
             # [B, 1, t, T]: row r's chunk position j attends slots ≤ i[r]+j
-            mask = (jnp.arange(self.max_decode_len)[None, None, :]
-                    <= pos_bt[:, :, None])[:, None, :, :]
+            ax = jnp.arange(self.max_decode_len)[None, None, :]
+            live = ax <= pos_bt[:, :, None]
+            if paged is not None:
+                # the paged interval is served through the block table —
+                # exclude it here so the merge never double-counts keys
+                live &= ~((ax >= paged.start)
+                          & (ax < paged.start + paged.lengths[:, None, None]))
+            mask = live[:, None, :, :]
             poison = overflow[:, None, None, None, None]
         else:
             cur = self.variable("cache", "cursor",
@@ -302,8 +321,13 @@ class MultiHeadAttention(nn.Module):
                 if quant:
                     ks.value, vs.value = new_ks, new_vs
             # [q, T]: chunk position j attends cache slots ≤ i + j
-            mask = (jnp.arange(self.max_decode_len)[None, :]
-                    <= (i + jnp.arange(t))[:, None])[None, None, :, :]
+            ax = jnp.arange(self.max_decode_len)[None, :]
+            live = ax <= (i + jnp.arange(t))[:, None]
+            if paged is not None:
+                # batch-1 in the scalar-cursor shape: one chain length
+                live &= ~((ax >= paged.start)
+                          & (ax < paged.start + paged.lengths[0]))
+            mask = live[None, None, :, :]
             poison = overflow
         # grouped attention against the (possibly narrower) cache: query
         # heads reshape to [.., kv_heads, group, d] so the einsum reads
@@ -323,9 +347,32 @@ class MultiHeadAttention(nn.Module):
         mask = mask[:, :, None]          # broadcast over the group axis
         scores = jnp.where(poison, jnp.nan, scores)
         scores = jnp.where(mask, scores, -jnp.inf)
-        weights = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhgqt,bthd->bqhgd", weights,
-                         new_v.astype(jnp.float32)).astype(self.dtype)
+        if paged is None:
+            weights = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bhgqt,bthd->bqhgd", weights,
+                             new_v.astype(jnp.float32)).astype(self.dtype)
+        else:
+            # explicit softmax so the local partial exposes its lse for
+            # the exact merge with the paged partial; the query's own
+            # chunk positions are always live locally, so m_l is finite
+            # (NaN poison still propagates — overflow stays loud)
+            m_l = jnp.max(scores, axis=-1, keepdims=True)
+            p_l = jnp.exp(scores - jax.lax.stop_gradient(m_l))
+            l_l = jnp.sum(p_l, axis=-1, keepdims=True)
+            # normalize BEFORE the value einsum — the exact op order of
+            # jax.nn.softmax + einsum above, so a row whose paged chain
+            # is empty reproduces the dense branch bit-for-bit
+            o_l = jnp.einsum("bhgqt,bthd->bqhgd", p_l / l_l,
+                             new_v.astype(jnp.float32))
+            lse_l = jnp.transpose((m_l + jnp.log(l_l))[..., 0],
+                                  (0, 3, 1, 2))           # [b, t, kvh, g]
+            o_p, lse_p = paged_attention_grouped(
+                q5.astype(jnp.float32), paged.k_pages, paged.v_pages,
+                paged.tables, paged.lengths,
+                k_scale_pages=paged.k_scale_pages,
+                v_scale_pages=paged.v_scale_pages,
+                kernel=paged.kernel, interpret=paged.interpret)
+            out = merge_attention(o_l, lse_l, o_p, lse_p).astype(self.dtype)
         out = out.reshape(b, t, h, d)
         return nn.DenseGeneral(features=self.dim, axis=(-2, -1),
                                dtype=self.dtype,
@@ -354,7 +401,7 @@ class Block(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, paged=None):
         ln = partial(nn.LayerNorm, dtype=self.dtype,
                      param_dtype=self.param_dtype)
         x = x + MultiHeadAttention(
@@ -365,7 +412,8 @@ class Block(nn.Module):
             decode_per_row=self.decode_per_row,
             kv_cache_dtype=self.kv_cache_dtype,
             dtype=self.dtype,
-            param_dtype=self.param_dtype, name="attn")(ln(name="ln1")(x))
+            param_dtype=self.param_dtype, name="attn")(
+                ln(name="ln1")(x), paged=paged)
         h_in = ln(name="ln2")(x)
         if self.ffn_factory is not None:
             return x + self.ffn_factory(
@@ -485,12 +533,17 @@ def stack_block_params(params, depth: int):
     }
 
 
-def scanned_apply(model: TransformerLM, params, cache, tokens):
+def scanned_apply(model: TransformerLM, params, cache, tokens, paged=None):
     """One decode/prefill step of a ``scan_layers=True`` model: embed →
     `lax.scan` of `Block.apply` over the depth-stacked (params, cache) →
     final norm → logits. Returns ``(float32 logits, new cache)`` — the
     same contract as ``model.apply(..., mutable=["cache"])`` unpacked,
-    with the cache's leading axis the layer index."""
+    with the cache's leading axis the layer index.
+
+    ``paged`` carries depth-stacked page stores (``[L, N, bs, ...]``,
+    `engine.kv_blocks.KVBlockPool.kv_pages`); the scan slices each
+    layer's page array alongside its params/cache slice, so the block
+    pool is read in place — never gathered."""
     blk = Block(model.dim, model.num_heads,
                 num_kv_heads=model.num_kv_heads,
                 causal=model.causal,
@@ -506,13 +559,27 @@ def scanned_apply(model: TransformerLM, params, cache, tokens):
                  param_dtype=model.param_dtype).apply(
         {"params": params["embed"]}, tokens)
 
-    def body(h, layer):
-        p_l, c_l = layer
-        h, mut = blk.apply({"params": p_l, "cache": c_l}, h,
-                           mutable=["cache"])
-        return h, mut["cache"]
+    if paged is None:
+        def body(h, layer):
+            p_l, c_l = layer
+            h, mut = blk.apply({"params": p_l, "cache": c_l}, h,
+                               mutable=["cache"])
+            return h, mut["cache"]
 
-    x, new_cache = jax.lax.scan(body, x, (params["blocks"], cache))
+        x, new_cache = jax.lax.scan(body, x, (params["blocks"], cache))
+    else:
+        pages = (paged.k_pages, paged.v_pages,
+                 paged.k_scale_pages, paged.v_scale_pages)
+
+        def body(h, layer):
+            p_l, c_l, (kp, vp, ksp, vsp) = layer
+            h, mut = blk.apply({"params": p_l, "cache": c_l}, h,
+                               paged=paged.layer(kp, vp, ksp, vsp),
+                               mutable=["cache"])
+            return h, mut["cache"]
+
+        x, new_cache = jax.lax.scan(
+            body, x, (params["blocks"], cache, pages))
     x = nn.LayerNorm(dtype=model.dtype, param_dtype=model.param_dtype
                      ).apply({"params": params["ln_f"]}, x)
     logits = nn.Dense(model.vocab, dtype=model.dtype,
@@ -521,12 +588,16 @@ def scanned_apply(model: TransformerLM, params, cache, tokens):
     return logits.astype(jnp.float32), new_cache
 
 
-def decode_apply(model: TransformerLM, params, cache, tokens):
+def decode_apply(model: TransformerLM, params, cache, tokens, paged=None):
     """THE decode-step entry point: dispatches on ``model.scan_layers``
     so callers (`engine.serve_lm`, `engine.generate`) are layout-blind.
     Returns ``(float32 logits, new cache)``."""
     if getattr(model, "scan_layers", False):
-        return scanned_apply(model, params, cache, tokens)
+        return scanned_apply(model, params, cache, tokens, paged=paged)
+    if paged is not None:
+        raise ValueError(
+            "paged KV attention requires the scanned decode layout "
+            "(scan_layers=True): page stores are depth-stacked")
     logits, mut = model.apply({"params": params, "cache": cache}, tokens,
                               mutable=["cache"])
     return logits, mut["cache"]

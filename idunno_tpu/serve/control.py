@@ -318,7 +318,12 @@ class ControlService:
                     # manager recovery rebuild gets the same pool with an
                     # EMPTY tree — cold misses, never stale KV
                     kv_block_size=int(p.get("kv_block_size", 0)),
-                    kv_cache_blocks=int(p.get("kv_cache_blocks", 0)))
+                    kv_cache_blocks=int(p.get("kv_cache_blocks", 0)),
+                    # block-native paged attention + chunked prefill
+                    # (ops/paged_attention.py); both ride the journaled
+                    # spec like the block-pool keys above
+                    paged_kernel=p.get("paged_kernel"),
+                    prefill_chunk=int(p.get("prefill_chunk", 0)))
                 if p.get("warmup"):
                     # pay the pool's one-time compiles BEFORE the loop
                     # accepts traffic and reset its accounting, so the
@@ -457,7 +462,14 @@ class ControlService:
             if pc is not None:
                 # surface the prefix-cache gauges on the node's C8
                 # metrics tracker so the cluster metrics plane sees them
-                node.metrics.record_lm_gauges(p["name"], pc)
+                # — plus the paged/chunked win counters, which belong to
+                # the same cache story (gather traffic avoided, long
+                # admissions split)
+                node.metrics.record_lm_gauges(p["name"], dict(
+                    pc,
+                    kv_gather_bytes_saved=stats.get(
+                        "kv_gather_bytes_saved", 0),
+                    prefill_chunks=stats.get("prefill_chunks", 0)))
             gw = stats.get("gateway")
             if gw is not None:
                 node.metrics.record_gateway_gauges(p["name"], {

@@ -850,6 +850,118 @@ def run_lm_prefix_bench(platform: str, device_kind: str, n_devices: int,
     return out
 
 
+def lm_paged_grid(platform: str) -> list[tuple[int, int]]:
+    """(slots, context) points for BENCH_SUITE=lm_paged. TPU measures the
+    serving-relevant 16/32 slots x 1k/4k contexts; CPU proves the
+    machinery on a miniature. BENCH_LM_PAGED_GRID=s:c,s:c overrides."""
+    env = os.environ.get("BENCH_LM_PAGED_GRID")
+    if env:
+        return [(int(s), int(c)) for s, c in
+                (p.split(":") for p in env.split(",") if p.strip())]
+    if platform == "tpu":
+        return [(16, 1024), (32, 1024), (16, 4096), (32, 4096)]
+    return [(2, 32), (2, 64)]
+
+
+def run_lm_paged_bench(platform: str, device_kind: str, n_devices: int,
+                       peak_bf16: float | None, *, deadline: float,
+                       compact: bool = False) -> dict:
+    """BENCH_SUITE=lm_paged: steady-state decode through radix hits
+    consumed IN PLACE via the block table (`ops/paged_attention.py`) vs
+    gathered into contiguous rows at admission — the paged path's
+    serving-level evidence (ISSUE 7). Every slot serves the SAME full-
+    context prompt (one shared chain, the shared-prefix regime the radix
+    cache exists for), so admission is a full-depth hit and the timed
+    dispatches are pure decode. Per grid point: ``paged`` (auto kernel =
+    the shipped default) first — a deadline hit must cost the baseline —
+    then ``gathered``, then ``paged_pallas`` (the AUTO_KERNEL flip
+    candidate; kernel-level grid lives in tools/flash_sweep.py)."""
+    from idunno_tpu.engine.serve_lm import DecodeServer
+    from idunno_tpu.models.transformer import TransformerLM
+
+    cfg = lm_bench_config(platform)
+    tpu = platform == "tpu"
+    block = _env_int("BENCH_LM_KV_BLOCK", 16 if tpu else 4)
+    out: dict = {"config": {k: v for k, v in cfg.items()},
+                 "platform": platform, "device_kind": device_kind,
+                 "n_devices": n_devices, "kv_block_size": block}
+    dt = jnp.bfloat16
+    model = TransformerLM(vocab=cfg["vocab"], dim=cfg["dim"],
+                          depth=cfg["depth"], num_heads=cfg["heads"],
+                          causal=True, dtype=dt, param_dtype=dt)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    n_params, _ = _count_params(params)
+    out["n_params"] = n_params
+    max_new = cfg["decode_steps"] * 3 + 1
+
+    def run_point(slots: int, ctx: int, paged_kernel) -> dict:
+        per_chain = -(-ctx // block)
+        srv = DecodeServer(model, params, slots=slots, prompt_len=ctx,
+                           max_len=ctx + max_new + 1,
+                           decode_steps=cfg["decode_steps"],
+                           kv_block_size=block,
+                           kv_cache_blocks=2 * per_chain + 4,
+                           paged_kernel=paged_kernel)
+        prompt = [int(t) for t in np.random.default_rng(5).integers(
+            1, cfg["vocab"], size=ctx)]
+        t0 = time.perf_counter()
+        srv.submit(prompt, max_new=2)      # seed the tree (cold compile)
+        srv.run_until_drained()
+        c_s = time.perf_counter() - t0
+        for _ in range(slots):             # full-depth hits, shared chain
+            srv.submit(prompt, max_new=max_new)
+        srv.step()                         # admissions + first dispatch
+        k = max(1, (max_new - 1) // cfg["decode_steps"] - 1)
+        t0 = time.perf_counter()
+        for _ in range(k):
+            srv.step()
+        disp = (time.perf_counter() - t0) / k
+        st = srv.stats()
+        rec = {"tokens_per_s": round(
+                   slots * cfg["decode_steps"] / disp, 1),
+               "dispatch_s": round(disp, 4), "timed_dispatches": k,
+               "seed_s": round(c_s, 2),
+               "prefill_tokens": st["prefill_tokens"],
+               "kv_gather_bytes_saved": st["kv_gather_bytes_saved"],
+               "prefix_hits": st["prefix_cache"]["hits"]}
+        if peak_bf16:
+            rec["mfu"] = round(rec["tokens_per_s"] * 2.0 * n_params
+                               / peak_bf16, 4)
+        del srv
+        return rec
+
+    points: list[dict] = []
+    out["points"] = points
+    modes = [("paged", "auto"), ("gathered", None)]
+    if tpu or os.environ.get("BENCH_LM_PAGED_PALLAS") == "1":
+        modes.append(("paged_pallas", "pallas"))
+    for slots, ctx in lm_paged_grid(platform):
+        point: dict = {"slots": slots, "context": ctx}
+        points.append(point)
+        for name, kern in modes:
+            if points[:-1] and time.perf_counter() > deadline:
+                point[name] = {"skipped": "time budget"}
+                continue
+            try:
+                point[name] = run_point(slots, ctx, kern)
+            except Exception as e:  # noqa: BLE001 - record, never hide
+                point[name] = {"error": f"{type(e).__name__}: {e}"}
+        if "tokens_per_s" in point.get("paged", {}) and \
+                "tokens_per_s" in point.get("gathered", {}):
+            point["paged_vs_gathered"] = round(
+                point["paged"]["tokens_per_s"]
+                / point["gathered"]["tokens_per_s"], 3)
+    ok = [p for p in points if "tokens_per_s" in p.get("paged", {})]
+    if ok:
+        best = max(ok, key=lambda p: p["paged"]["tokens_per_s"])
+        # headline for BENCH_LAST_GOOD_lm_paged.json (bench.py reads
+        # out[value_key]["tokens_per_s"])
+        out["best"] = {"slots": best["slots"], "context": best["context"],
+                       "tokens_per_s": best["paged"]["tokens_per_s"]}
+    return out
+
+
 def run_lm_gateway_bench(platform: str, device_kind: str, n_devices: int,
                          peak_bf16: float | None, *, deadline: float,
                          compact: bool = False) -> dict:

@@ -1,0 +1,236 @@
+"""Block-native paged decode attention (`ops/paged_attention.py`).
+
+Fast lane: the pallas kernel runs in interpret mode on the forced-CPU
+mesh, so tier-1 exercises the exact kernel the TPU compiles. Numerics
+oracle is a straight numpy softmax over the gathered chain; the
+structural tests assert the *absence of a contiguous gather* on the
+pallas path the same way `tests/test_scanned_decode.py` proves depth
+invariance — on the jaxpr, not on timings. The reference system has no
+counterpart (every query recomputes from scratch,
+`mp4_machinelearning.py:541-616`); the design point is vLLM's
+PagedAttention (PAPERS.md).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from idunno_tpu.ops.paged_attention import (
+    AUTO_KERNEL, PagedContext, merge_attention, paged_attention,
+    paged_attention_grouped, resolve_paged_kernel)
+
+B, T, KVH, G, D = 3, 4, 2, 2, 16
+BS, C = 4, 3          # block size x chain capacity = 12 kv positions
+
+
+def make_case(seed=0, n_blocks=8):
+    rng = np.random.default_rng(seed)
+    q5 = rng.standard_normal((B, T, KVH, G, D)).astype(np.float32)
+    kp = rng.standard_normal((n_blocks, BS, KVH, D)).astype(np.float32)
+    vp = rng.standard_normal((n_blocks, BS, KVH, D)).astype(np.float32)
+    # distinct physical blocks per row, deliberately out of order
+    tables = np.array([[5, 2, 7], [1, 6, 0], [3, 4, 2]], np.int32)
+    lengths = np.array([3 * BS, BS, 0], np.int32)   # full / partial / empty
+    return q5, kp, vp, tables, lengths
+
+
+def ref_paged(q5, kp, vp, tables, lengths):
+    """numpy oracle: gather the chain contiguously, masked softmax."""
+    out = np.zeros_like(q5)
+    lse = np.full(q5.shape[:-1], -1e30, np.float32)
+    scale = 1.0 / np.sqrt(q5.shape[-1])
+    kvh, d = kp.shape[-2:]
+    for b in range(q5.shape[0]):
+        n = int(lengths[b])
+        if n == 0:
+            continue
+        k = kp[tables[b]].reshape(-1, kvh, d)[:n]    # [n, kvh, d]
+        v = vp[tables[b]].reshape(-1, kvh, d)[:n]
+        for h in range(kvh):
+            s = q5[b, :, h] @ k[:, h].T * scale      # [T, G, n]
+            m = s.max(-1, keepdims=True)
+            p = np.exp(s - m)
+            l = p.sum(-1, keepdims=True)
+            out[b, :, h] = (p / l) @ v[:, h]
+            lse[b, :, h] = (m + np.log(l))[..., 0]
+    return out, lse
+
+
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_kernel_matches_reference(kernel):
+    q5, kp, vp, tables, lengths = make_case()
+    want_o, want_lse = ref_paged(q5, kp, vp, tables, lengths)
+    got_o, got_lse = paged_attention_grouped(
+        jnp.asarray(q5), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lengths),
+        kernel=kernel, interpret=True)
+    live = lengths > 0
+    np.testing.assert_allclose(np.asarray(got_o)[live], want_o[live],
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_lse)[live], want_lse[live],
+                               rtol=2e-5, atol=2e-5)
+    # empty-chain rows must hit the exact (zeros, -inf-ish) contract on
+    # BOTH backends — the merge relies on the weight underflowing to 0
+    np.testing.assert_array_equal(np.asarray(got_o)[~live], 0.0)
+    assert (np.asarray(got_lse)[~live] <= -1e30).all()
+
+
+@pytest.mark.parametrize("t", [1, 5])
+def test_flat_wrapper_gqa_shapes(t):
+    """[B,T,H,D] wrapper reshapes into the page store's KVH grouping."""
+    q5, kp, vp, tables, lengths = make_case(seed=3)
+    q = jnp.asarray(q5[:, :1].repeat(t, axis=1)).reshape(B, t, KVH * G, D)
+    o, lse = paged_attention(q, jnp.asarray(kp), jnp.asarray(vp),
+                             jnp.asarray(tables), jnp.asarray(lengths),
+                             kernel="xla")
+    assert o.shape == (B, t, KVH * G, D) and lse.shape == (B, t, KVH * G)
+    with pytest.raises(ValueError, match="multiple of kv_heads"):
+        paged_attention(q[..., :3, :], jnp.asarray(kp), jnp.asarray(vp),
+                        jnp.asarray(tables), jnp.asarray(lengths))
+
+
+def test_int8_scales_dequantize_on_xla_path():
+    q5, kp, vp, tables, lengths = make_case(seed=5)
+    scl = 0.25
+    kq = (kp / scl).astype(np.float32)     # pretend-quantized pages
+    vq = (vp / scl).astype(np.float32)
+    ks = np.full(kp.shape[:-1], scl, np.float32)
+    want_o, _ = ref_paged(q5, kp, vp, tables, lengths)
+    got_o, _ = paged_attention_grouped(
+        jnp.asarray(q5), jnp.asarray(kq), jnp.asarray(vq),
+        jnp.asarray(tables), jnp.asarray(lengths),
+        k_scale_pages=jnp.asarray(ks), v_scale_pages=jnp.asarray(ks),
+        kernel="xla")
+    live = lengths > 0
+    np.testing.assert_allclose(np.asarray(got_o)[live], want_o[live],
+                               rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError, match="int8"):
+        paged_attention_grouped(
+            jnp.asarray(q5), jnp.asarray(kq), jnp.asarray(vq),
+            jnp.asarray(tables), jnp.asarray(lengths),
+            k_scale_pages=jnp.asarray(ks), v_scale_pages=jnp.asarray(ks),
+            kernel="pallas", interpret=True)
+
+
+def test_merge_attention_exact_vs_union_softmax():
+    """merge(partial_A, partial_B) == softmax over A∪B, and an empty
+    partial (lse=-1e30) is a bitwise no-op — the zero-hit-row guarantee
+    the transformer merge depends on."""
+    rng = np.random.default_rng(11)
+    q = rng.standard_normal((1, 1, 1, 1, D)).astype(np.float32)
+    kp = rng.standard_normal((4, BS, 1, D)).astype(np.float32)
+    vp = rng.standard_normal((4, BS, 1, D)).astype(np.float32)
+    ta = np.array([[0, 1]], np.int32)
+    tb = np.array([[2, 3]], np.int32)
+    full = np.array([[0, 1, 2, 3]], np.int32)
+    ln2 = np.array([2 * BS], np.int32)
+    ln4 = np.array([4 * BS], np.int32)
+    oa, la = ref_paged(q, kp, vp, ta, ln2)
+    ob, lb = ref_paged(q, kp, vp, tb, ln2)
+    want, _ = ref_paged(q, kp, vp, full, ln4)
+    got = merge_attention(jnp.asarray(oa), jnp.asarray(la),
+                          jnp.asarray(ob), jnp.asarray(lb))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+    empty = merge_attention(
+        jnp.asarray(oa), jnp.asarray(la),
+        jnp.zeros_like(jnp.asarray(ob)),
+        jnp.full_like(jnp.asarray(lb), -1e30))
+    np.testing.assert_array_equal(np.asarray(empty), oa)
+
+
+def test_resolve_kernel_earn_it_or_swap():
+    assert AUTO_KERNEL == "xla", \
+        "flip AUTO_KERNEL only after paged_suite blesses pallas on-chip"
+    assert resolve_paged_kernel("auto") == AUTO_KERNEL
+    assert resolve_paged_kernel("auto", int8=True) == "xla"
+    assert resolve_paged_kernel("pallas") == "pallas"
+    assert resolve_paged_kernel("xla", int8=True) == "xla"
+    with pytest.raises(ValueError, match="auto\\|pallas\\|xla"):
+        resolve_paged_kernel("fast")
+    with pytest.raises(ValueError, match="int8"):
+        resolve_paged_kernel("pallas", int8=True)
+
+
+# -- structural: no contiguous gather on the pallas path --------------------
+
+def _count_prims(jaxpr, name_contains: str) -> int:
+    n = 0
+    for eqn in jaxpr.eqns:
+        if name_contains in eqn.primitive.name:
+            n += 1
+        for v in eqn.params.values():
+            sub = getattr(v, "jaxpr", None)
+            if sub is not None:
+                n += _count_prims(sub, name_contains)
+            if isinstance(v, (list, tuple)):
+                for vv in v:
+                    sub = getattr(vv, "jaxpr", None)
+                    if sub is not None:
+                        n += _count_prims(sub, name_contains)
+    return n
+
+
+def test_pallas_path_has_no_gather_op():
+    """The op-count proxy (like `tests/test_scanned_decode.py`): the
+    pallas program must contain a pallas_call and ZERO gather primitives
+    — the DMA index_map does the addressing, nothing materializes the
+    chain. The xla fallback is the contrast: it gathers by design."""
+    q5, kp, vp, tables, lengths = make_case()
+    args = (jnp.asarray(q5), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(tables), jnp.asarray(lengths))
+
+    def run(kernel):
+        return jax.make_jaxpr(
+            lambda *a: paged_attention_grouped(
+                *a, kernel=kernel, interpret=kernel == "pallas"))(
+            *args).jaxpr
+
+    pallas_jaxpr = run("pallas")
+    assert _count_prims(pallas_jaxpr, "pallas_call") >= 1
+    assert _count_prims(pallas_jaxpr, "gather") == 0, \
+        "pallas paged path materialized a gather"
+    assert _count_prims(run("xla"), "gather") >= 1, \
+        "contrast broken: the xla fallback should gather"
+
+
+def test_serving_paged_path_never_calls_pool_gather(monkeypatch):
+    """End-to-end: a paged pool serving radix HITS must never touch
+    `KVBlockPool.gather` — admission prefill and every decode step read
+    the blocks through the table only."""
+    from idunno_tpu.engine.kv_blocks import KVBlockPool
+    from idunno_tpu.engine.serve_lm import DecodeServer
+    from idunno_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab=61, dim=32, depth=2, num_heads=4)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                       kv_block_size=2, kv_cache_blocks=16,
+                       paged_kernel="pallas")
+
+    def boom(self, bids):
+        raise AssertionError("paged pool gathered a block chain")
+    monkeypatch.setattr(KVBlockPool, "gather", boom)
+
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    srv.submit(prompt, max_new=4)
+    srv.run_until_drained()
+    rid = srv.submit(prompt, max_new=4)        # radix hit → paged prefill
+    done = {c.id: c for c in srv.run_until_drained()}
+    assert srv.prefix_cache_stats()["hits"] == 1
+    assert len(done[rid].tokens) == len(prompt) + 4
+    assert srv.stats()["kv_gather_bytes_saved"] > 0
+
+
+def test_paged_context_is_pytree():
+    """PagedContext must flatten losslessly (it rides through jit args
+    and the scanned decode body)."""
+    q5, kp, vp, tables, lengths = make_case()
+    ctx = PagedContext(jnp.asarray(kp), jnp.asarray(vp),
+                       jnp.asarray(tables), jnp.asarray(lengths),
+                       start=3, kernel="pallas", interpret=True)
+    leaves, treedef = jax.tree_util.tree_flatten(ctx)
+    back = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert (back.start, back.kernel, back.interpret) == (3, "pallas", True)
+    lyr = ctx.layer(jnp.asarray(kp[0]), jnp.asarray(vp[0]))
+    assert lyr.k_pages.shape == kp[0].shape and lyr.start == 3

@@ -297,6 +297,136 @@ def test_prompt_bucket_shrinks_after_hit(lm):
         "6-token hit should drop the 8-bucket prefill to the 2-bucket"
 
 
+# -- block-native paged decode path ----------------------------------------
+
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+@pytest.mark.parametrize("kind", ["mha", "gqa"])
+def test_hit_depths_paged_token_exact(lm, kind, kernel):
+    """The tentpole exactness claim: with ``paged_kernel`` set, radix
+    hits are consumed IN PLACE through the block table (no contiguous
+    gather) and every hit depth stays token-exact vs `generate` — the
+    zero hit region of the row cache is mask-excluded, the table chain
+    covers it."""
+    if kind == "gqa":
+        model = TransformerLM(vocab=VOCAB, dim=32, depth=2, num_heads=4,
+                              num_kv_heads=2)
+        params = model.init(jax.random.PRNGKey(1),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+    else:
+        model, params = lm
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                       kv_block_size=BS, kv_cache_blocks=16,
+                       paged_kernel=kernel)
+    saved_blocks = 0
+    for prompt, hit in hit_depth_prompts(np.random.default_rng(3)):
+        rid = srv.submit(prompt, max_new=6)
+        done = {c.id: c for c in srv.run_until_drained()}
+        assert done[rid].tokens == expected(model, params, prompt, 6), \
+            f"{kind}/{kernel}: diverged at expected hit depth {hit}"
+        saved_blocks += hit // BS
+    st = srv.stats()
+    assert st["kv_gather_bytes_saved"] == \
+        saved_blocks * srv._block_pool.bytes_per_block
+    assert st["config"]["paged_kernel"] == kernel
+    assert srv.prefix_cache_stats()["hits"] == 3
+
+
+def test_paged_seeded_sampling_matches_gathered(lm):
+    """Paged and gathered hit consumption must produce IDENTICAL sampled
+    streams under a pinned seed — same logits bit-for-bit, same
+    categorical draws — or managed-recovery replays would fork."""
+    model, params = lm
+    streams = {}
+    for kernel in (None, "xla", "pallas"):
+        srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                           kv_block_size=BS, kv_cache_blocks=16,
+                           paged_kernel=kernel)
+        out = []
+        for prompt, _ in hit_depth_prompts(np.random.default_rng(7)):
+            rid = srv.submit(prompt, max_new=6, temperature=0.8,
+                             top_p=0.9, seed=42)
+            out.append({c.id: c for c in srv.run_until_drained()}[rid].tokens)
+        streams[kernel] = out
+        assert srv.prefix_cache_stats()["hits"] == 3
+    assert streams["xla"] == streams[None], "paged xla forked the stream"
+    assert streams["pallas"] == streams[None], "paged pallas forked the stream"
+
+
+def test_paged_int8_static_prefix_auto_resolves_xla(lm):
+    """`paged_kernel="auto"` on a quantized pool must take the xla
+    dequant path and stay exact; pallas is refused outright."""
+    model = TransformerLM(vocab=VOCAB, dim=32, depth=2, num_heads=4,
+                          kv_cache_dtype="int8")
+    params = model.init(jax.random.PRNGKey(2),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    pre = [20, 21, 22]
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=32,
+                       prefix=pre, kv_block_size=BS, kv_cache_blocks=16,
+                       paged_kernel="auto")
+    assert srv.paged_kernel == "xla"
+    for prompt, _ in hit_depth_prompts(np.random.default_rng(5)):
+        rid = srv.submit(prompt, max_new=5)
+        done = {c.id: c for c in srv.run_until_drained()}
+        assert done[rid].tokens == expected(model, params, pre + prompt, 5)
+    assert srv.prefix_cache_stats()["hits"] == 3
+    assert srv.stats()["kv_gather_bytes_saved"] > 0
+    with pytest.raises(ValueError, match="int8"):
+        DecodeServer(model, params, slots=2, prompt_len=8, max_len=32,
+                     kv_block_size=BS, kv_cache_blocks=16,
+                     paged_kernel="pallas")
+
+
+def test_paged_speculative_token_exact(lm):
+    """Fused spec rounds verify the TARGET through the block table; the
+    draft stays contiguous. Greedy must remain token-exact."""
+    model, params = lm
+    draft = TransformerLM(vocab=VOCAB, dim=16, depth=1, num_heads=2)
+    dparams = draft.init(jax.random.PRNGKey(9),
+                         jnp.zeros((1, 4), jnp.int32))["params"]
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=32,
+                       draft=(draft, dparams), draft_len=3, decode_steps=2,
+                       kv_block_size=BS, kv_cache_blocks=16,
+                       paged_kernel="pallas")
+    for prompt, _ in hit_depth_prompts(np.random.default_rng(11)):
+        rid = srv.submit(prompt, max_new=8)
+        done = {c.id: c for c in srv.run_until_drained()}
+        assert done[rid].tokens == expected(model, params, prompt, 8)
+    assert srv.prefix_cache_stats()["hits"] == 3
+
+
+def test_paged_requires_blocks_and_scan(lm):
+    model, params = lm
+    with pytest.raises(ValueError, match="kv_block_size"):
+        DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                     paged_kernel="xla")
+
+
+def test_write_block_rejects_out_of_range_offset(lm):
+    """Regression for the absolute-position footgun: `write_block`
+    offsets are ABSOLUTE cache positions. A caller that forgets the
+    static prefix (or double-counts it) walks past the row cache — the
+    pool must refuse instead of silently storing zeros."""
+    model, params = lm
+    cache = row_cache_for(model, params, [5, 11, 17, 23])
+    pool = KVBlockPool(model, num_blocks=2, block_size=BS)
+    bid = pool.alloc()
+    with pytest.raises(ValueError, match="ABSOLUTE"):
+        pool.write_block(bid, cache, 4)        # 4 + BS > 4-token cache
+    with pytest.raises(ValueError, match="ABSOLUTE"):
+        pool.write_block(bid, cache, -1)
+    # the prefix-ahead layout that motivated the check: a 3-token static
+    # prefix shifts the request tokens to positions [3, 7) — block 0 of
+    # the request lives at absolute offset 3, NOT 0
+    pre_cache = row_cache_for(model, params, [20, 21, 22, 5, 11, 17, 23])
+    pool.write_block(bid, pre_cache, 3)
+    got = kv_leaves(pool.gather([bid]))
+    src = kv_leaves(pre_cache)
+    for key, leaf in got.items():
+        np.testing.assert_array_equal(
+            np.asarray(leaf), np.asarray(src[key][:, 3:3 + BS]),
+            err_msg=f"prefix-ahead write landed wrong at {key}")
+
+
 # -- eviction under slot churn (satellite: cache pressure never corrupts) --
 
 def test_eviction_under_churn_token_exact(lm):

@@ -1291,3 +1291,98 @@ def test_warmup_pays_compiles_then_resets_the_pool(lm):
     srv.submit([1], max_new=2)                         # pool no longer idle
     with pytest.raises(RuntimeError, match="idle"):
         srv.warmup()
+
+# -- chunked prefill --------------------------------------------------------
+
+@pytest.mark.parametrize("pool_kw", [
+    {},                                                    # plain pool
+    {"kv_block_size": 2, "kv_cache_blocks": 16},           # gathered radix
+    {"kv_block_size": 2, "kv_cache_blocks": 16,            # paged radix
+     "paged_kernel": "pallas"},
+])
+def test_chunked_prefill_token_exact(lm, pool_kw):
+    """Splitting a long prompt's prefill into fixed-size chunks must be
+    INVISIBLE in the streams: scalar cursors + per-position K/V writes +
+    per-query masks make the chunk boundaries pure scheduling. Same
+    oracle as one-shot admission, across radix hit reuse too."""
+    model, params = lm
+    rng = np.random.default_rng(23)
+    prompts = [[int(t) for t in rng.integers(0, VOCAB, size=n)]
+               for n in (8, 7, 8, 3)]
+    prompts.append(list(prompts[0]))          # radix hit on kv pools
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                       prefill_chunk=3, **pool_kw)
+    ids = {srv.submit(p, max_new=6): p for p in prompts}
+    done = {c.id: c for c in srv.run_until_drained()}
+    for rid, p in ids.items():
+        assert done[rid].tokens == expected(model, params, p, 6), \
+            f"chunked admission diverged for {p} under {pool_kw}"
+    st = srv.stats()
+    # 8-bucket prompts chunk (ceil(8/3)=3 each); the 3-token prompt pads
+    # to the single 8 bucket here too, so every admission chunks
+    assert st["prefill_chunks"] == 3 * len(prompts)
+    assert st["config"]["prefill_chunk"] == 3
+
+
+def test_chunked_prefill_interleaves_decode(lm):
+    """Fairness: while a long prompt's prefill is pending, resident rows
+    must keep decoding BETWEEN chunks — the head-of-line blocking cure
+    chunked prefill exists for (Sarathi-style stall-free batching)."""
+    model, params = lm
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=40,
+                       prompt_buckets=(2, 8), prefill_chunk=2)
+    a = srv.submit([1], max_new=24)           # 2-bucket: admits one-shot
+    srv.step()
+    snap0 = {r["id"]: len(r["tokens"]) for r in srv.snapshot()}
+    b = srv.submit([5, 6, 7, 8, 9, 10, 11], max_new=4)  # 8-bucket: 4 chunks
+    progress = []
+    while True:                               # b's admission in flight
+        srv.step()
+        if srv._pending is None:
+            break
+        live = {r["id"]: len(r["tokens"]) for r in srv.snapshot()}
+        progress.append(live.get(a, 0))
+    assert len(progress) >= 2, "8-bucket/chunk-2 prefill should take 4 steps"
+    assert progress[-1] > snap0[a], \
+        "resident row did not advance while the chunked prefill was pending"
+    assert all(y > x for x, y in zip(progress, progress[1:])), \
+        "every chunk step must also run a decode dispatch for resident rows"
+    done = {c.id: c for c in srv.run_until_drained()}
+    assert done[a].tokens == expected(model, params, [1], 24)
+    assert done[b].tokens == expected(
+        model, params, [5, 6, 7, 8, 9, 10, 11], 4)
+
+
+def test_cancel_mid_chunk(lm):
+    """A cancel landing between chunks drops the pending admission:
+    queued-shape completion (prompt only, cancelled), the slot it was
+    bound for admits the next prompt, stats count one cancel."""
+    model, params = lm
+    srv = DecodeServer(model, params, slots=1, prompt_len=8, max_len=24,
+                       prefill_chunk=2)
+    victim = [3, 1, 4, 1, 5, 9, 2, 6]
+    vid = srv.submit(victim, max_new=6)
+    srv.step()                                # first chunk only (of 4)
+    assert srv.pending() == 1
+    assert srv.cancel(vid) == "queued"
+    assert srv.pending() == 0
+    follow = srv.submit([7, 8], max_new=3)
+    done = {c.id: c for c in srv.run_until_drained()}
+    assert done[vid].cancelled and done[vid].tokens == victim
+    assert done[follow].tokens == expected(model, params, [7, 8], 3)
+    st = srv.stats()
+    assert st["cancelled"] == 1 and st["completed"] == 1
+    assert st["admitted"] == 1, "cancelled pending admission never admitted"
+
+
+def test_short_prompts_skip_chunking(lm):
+    """Prompts at or under the chunk size admit one-shot — no pending
+    state, no prefill_chunks counted."""
+    model, params = lm
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                       prompt_buckets=(2, 4, 8), prefill_chunk=4)
+    rid = srv.submit([5, 9], max_new=4)       # 2-bucket ≤ chunk 4
+    srv.step()
+    assert srv._pending is None and srv.stats()["prefill_chunks"] == 0
+    done = {c.id: c for c in srv.run_until_drained()}
+    assert done[rid].tokens == expected(model, params, [5, 9], 4)

@@ -69,6 +69,13 @@ STEPS = [
      {"BENCH_SUITE": "lm_prefix", "BENCH_TIME_BUDGET_S": "600"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_lm_prefix.json"),
+    # ISSUE 7: paged decode through the block table vs the gathered
+    # baseline at serving contexts — the serving-level half of the
+    # earn-it evidence (the kernel-level grid rides in flash_sweep)
+    ("paged_suite",
+     {"BENCH_SUITE": "lm_paged", "BENCH_TIME_BUDGET_S": "600"},
+     [sys.executable, "bench.py"],
+     "BENCH_LAST_GOOD_lm_paged.json"),
     # QoS admission gateway: open-loop Poisson overload at 2x measured
     # capacity (serve/gateway.py) — goodput tokens/sec + shed rate per
     # class on chip; 0.5x underload control rides in details
@@ -181,7 +188,10 @@ FORCE_RECAPTURE = {"lm_suite", "lm_suite_refresh", "lm_slots",
                    "prefix_suite", "spec_trace", "two_model_fairshare",
                    # flash_sweep: the committed artifact predates the
                    # 256x512/512x1024/512x256 neighbors + 4x4096 long-seq
+                   # AND (ISSUE 7) the decode-shaped paged_decode section
                    "flash_sweep",
+                   # paged_suite: new this round — never touched the chip
+                   "paged_suite",
                    # train_suite: BENCH_LAST_GOOD_train.json provenance is
                    # two rounds stale (round-5 VERDICT) — the committed
                    # record predates the scanned-decode rework's tree

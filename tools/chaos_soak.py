@@ -29,6 +29,8 @@ def main() -> int:
     ap.add_argument("--drop", type=float, default=0.05)
     ap.add_argument("--dup", type=float, default=0.03)
     ap.add_argument("--delay", type=float, default=0.10)
+    # chunked-prefill spec for schedule 0 (0 disables); see chaos.py
+    ap.add_argument("--prefill-chunk", type=int, default=2)
     args = ap.parse_args()
     logging.disable(logging.WARNING)   # wal-skip warnings are expected
 
@@ -44,7 +46,11 @@ def main() -> int:
                 out = run_seeded_schedule(
                     seed, d, steps=args.steps,
                     chaos={"drop": args.drop, "dup": args.dup,
-                           "delay": args.delay, "seed": seed})
+                           "delay": args.delay, "seed": seed},
+                    # first schedule runs the managed pool with chunked
+                    # prefill in its journaled spec (ISSUE 7): deferred
+                    # completions under the same fault surface
+                    prefill_chunk=args.prefill_chunk if i == 0 else 0)
         except Exception as e:  # noqa: BLE001 - invariant trip is data
             rec = {"seed": seed, "error":
                    f"{type(e).__name__}: {e}"[:300]}

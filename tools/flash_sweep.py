@@ -9,7 +9,14 @@ scan-tiled dispatch) through:
   - stock XLA attention (the swap candidate),
   - the Pallas flash kernel at several (block_q, block_k) configs,
 
-writing FLASH_SWEEP.json incrementally after EVERY variant (a window
+plus a decode-shaped paged-attention section (ISSUE 7): the block-native
+kernel (`ops/paged_attention.py`) vs its XLA gather fallback at serving
+shapes — q_len 1 and 8 (plain decode / fused spec verify) x KV 512 and
+4096 x block sizes 16/32/64 — the evidence `AUTO_KERNEL` needs before it
+may flip to "pallas" (earn-it-or-swap, same discipline as the prefill
+default above).
+
+Writes FLASH_SWEEP.json incrementally after EVERY variant (a window
 that closes mid-sweep still leaves the variants it measured). Each
 variant is one fresh compile through the tunnel (~40-75 s cold,
 disk-cached across windows via the persistent compile cache).
@@ -200,6 +207,93 @@ def main() -> int:
         if (ebq, ebk) != (bq, bk):
             label += f"_effective_{ebq}x{ebk}"
         record(label, kw, toks_long, ls)
+
+    # -- decode-shaped paged attention: block-table addressing (pallas)
+    # vs gather-then-attend (xla) at steady-serving shapes. Rides LAST:
+    # each point is a tiny compile, but the prefill sweep above is the
+    # older debt. 16 slots, MHA grouping (G=1) — the serving pool's
+    # paged path calls this exact function per scanned layer.
+    from idunno_tpu.ops.paged_attention import paged_attention_grouped
+    kvh, hd = cfg["heads"], cfg["dim"] // cfg["heads"]
+    slots = 16
+    pv: list = []
+    out["paged_decode"] = {"slots": slots, "kv_heads": kvh, "head_dim": hd,
+                           "variants": pv}
+    prng = np.random.default_rng(2)
+
+    def time_paged(kernel, q, kp, vp, tables, lengths):
+        f = jax.jit(lambda *a: paged_attention_grouped(
+            *a, kernel=kernel, interpret=args.cpu))
+        t0 = time.perf_counter()
+        f(q, kp, vp, tables, lengths)[0].block_until_ready()
+        c_s = time.perf_counter() - t0
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                o, _ = f(q, kp, vp, tables, lengths)
+            o.block_until_ready()
+            reps.append((time.perf_counter() - t0) / 10)
+        return float(np.median(reps)), c_s
+
+    for pbs in (32, 16, 64):              # likely winner first: budget
+        for kv_len in (512, 4096):        # clamps cut the grid edge
+            nb_row = kv_len // pbs
+            kp = jnp.asarray(prng.standard_normal(
+                (slots * nb_row, pbs, kvh, hd)), dt)
+            vp = jnp.asarray(prng.standard_normal(
+                (slots * nb_row, pbs, kvh, hd)), dt)
+            tables = jnp.asarray(prng.permutation(slots * nb_row)
+                                 .reshape(slots, nb_row), jnp.int32)
+            lengths = jnp.full((slots,), kv_len, jnp.int32)
+            kv_bytes = 2 * slots * kv_len * kvh * hd * np.dtype(
+                np.float32 if dt == jnp.float32 else np.float16).itemsize
+            for q_len in (1, 8):
+                q = jnp.asarray(prng.standard_normal(
+                    (slots, q_len, kvh, 1, hd)), dt)
+                for kern in ("pallas", "xla"):
+                    label = f"paged_{kern}_bs{pbs}_kv{kv_len}_q{q_len}"
+                    if time.perf_counter() - t_start > args.budget_s:
+                        pv.append({"variant": label,
+                                   "skipped": "time budget"})
+                        flush()
+                        continue
+                    try:
+                        sec, c_s = time_paged(kern, q, kp, vp,
+                                              tables, lengths)
+                        row = {"variant": label,
+                               "median_us": round(sec * 1e6, 1),
+                               "kv_gb_per_s": round(kv_bytes / sec / 1e9,
+                                                    2),
+                               "compile_s": round(c_s, 2)}
+                    except Exception as e:  # noqa: BLE001
+                        row = {"variant": label,
+                               "error": f"{type(e).__name__}: {e}"}
+                    pv.append(row)
+                    flush()
+                    print(json.dumps(row), flush=True)
+
+    # per-shape pallas-vs-xla verdict: AUTO_KERNEL may flip to "pallas"
+    # only if the kernel wins at EVERY measured serving shape — a split
+    # decision keeps the gather fallback (it is never wrong, only slow)
+    pairs = {}
+    for v in pv:
+        if "median_us" not in v:
+            continue
+        kern, shape = v["variant"].split("_", 2)[1], v["variant"].split(
+            "_", 2)[2]
+        pairs.setdefault(shape, {})[kern] = v["median_us"]
+    both = {s: d for s, d in pairs.items() if len(d) == 2}
+    if both:
+        wins = sum(d["pallas"] < d["xla"] for d in both.values())
+        out["paged_decode"]["pallas_wins"] = f"{wins}/{len(both)}"
+        out["paged_decode"]["recommendation"] = (
+            "flip ops/paged_attention.py:AUTO_KERNEL to 'pallas'"
+            if wins == len(both) else
+            "keep AUTO_KERNEL='xla' (gather fallback)")
+    else:
+        out["paged_decode"]["incomplete"] = (
+            "need pallas AND xla at >=1 shape for a default decision")
 
     ok = [v for v in out["variants"] if "tokens_per_s" in v]
     flash_ok = [v for v in ok if v["variant"].startswith("flash_")]
