@@ -43,10 +43,10 @@ REFERENCE_IMAGES_PER_S = 400 / 9.0   # ≈44.4, whole reference cluster
 # utils/train_bench.py).
 BENCH_SUITE = os.environ.get("BENCH_SUITE", "cnn")
 if BENCH_SUITE not in ("cnn", "lm", "lm_prefix", "lm_slots", "lm_paged",
-                       "lm_gateway", "train"):
+                       "lm_tp", "lm_gateway", "train"):
     raise SystemExit(
         f"BENCH_SUITE={BENCH_SUITE!r}: want "
-        "cnn|lm|lm_prefix|lm_slots|lm_paged|lm_gateway|train")
+        "cnn|lm|lm_prefix|lm_slots|lm_paged|lm_tp|lm_gateway|train")
 # BENCH_MODEL selects the measured network: resnet18 (headline, matches the
 # reference's "resnet"), resnet50 (bottleneck — ~4x the FLOPs/image, the
 # MXU-utilisation probe), alexnet (the other half of the reference's
@@ -66,6 +66,7 @@ METRIC = {"cnn": f"{BENCH_MODEL}_imagenet_inference_throughput",
           "lm_prefix": "lm_prefix_cache_throughput",
           "lm_slots": "lm_slot_scaling_throughput",
           "lm_paged": "lm_paged_decode_throughput",
+          "lm_tp": "lm_tp_decode_throughput",
           "lm_gateway": "lm_gateway_goodput",
           "train": "lm_train_throughput"}[BENCH_SUITE]
 
@@ -81,6 +82,7 @@ _LAST_GOOD = os.path.join(
      else "BENCH_LAST_GOOD_lm_prefix.json" if BENCH_SUITE == "lm_prefix"
      else "BENCH_LAST_GOOD_lm_slots.json" if BENCH_SUITE == "lm_slots"
      else "BENCH_LAST_GOOD_lm_paged.json" if BENCH_SUITE == "lm_paged"
+     else "BENCH_LAST_GOOD_lm_tp.json" if BENCH_SUITE == "lm_tp"
      else "BENCH_LAST_GOOD_lm_gateway.json" if BENCH_SUITE == "lm_gateway"
      else "BENCH_LAST_GOOD_train.json" if BENCH_SUITE == "train"
      else f"BENCH_LAST_GOOD_{BENCH_MODEL}.json"))
@@ -756,6 +758,18 @@ def run_lm_paged_suite(devices) -> None:
                       "lm paged-decode measurement failed", compact=False)
 
 
+def run_lm_tp_suite(devices) -> None:
+    """BENCH_SUITE=lm_tp: tensor-parallel scanned decode (Megatron
+    column/row split over the mesh's model axis, two psums per block
+    inside the one lax.scan) at n_model 1 vs 2, 16/32 slots on TPU.
+    Headline is the best TP point's tokens/sec; per-point speedups and
+    the on-chip token-exactness probe ride in details."""
+    from idunno_tpu.utils.lm_bench import run_lm_tp_bench
+    _run_record_suite(devices, run_lm_tp_bench, "best",
+                      "lm tensor-parallel measurement failed",
+                      compact=False)
+
+
 def run_lm_gateway_suite(devices) -> None:
     """BENCH_SUITE=lm_gateway: goodput vs offered load through the QoS
     admission gateway — open-loop Poisson arrivals at 2x the pool's
@@ -821,6 +835,8 @@ def main() -> None:
             run_lm_slots_suite(devices)
         elif BENCH_SUITE == "lm_paged":
             run_lm_paged_suite(devices)
+        elif BENCH_SUITE == "lm_tp":
+            run_lm_tp_suite(devices)
         elif BENCH_SUITE == "lm_gateway":
             run_lm_gateway_suite(devices)
         elif BENCH_SUITE == "train":

@@ -160,9 +160,13 @@ class ChaosControl:
             # chunked admission as completion deferred by one poll round
             # — the watchdog/poll-retry machinery must tolerate a pool
             # that holds work across a poll without losing or duping it
+            # n_model rides the spec the same way: the fake tier only
+            # records the TP shape (no real mesh), proving the knob
+            # journals/replays through failover like every serving knob
             self._loops[name] = {"next": 0, "done": [], "defer": [],
                                  "chunk": int(p.get("prefill_chunk")
-                                              or 0)}
+                                              or 0),
+                                 "n_model": int(p.get("n_model") or 1)}
             for k in [k for k in self._lm_idem if k[0] == name]:
                 del self._lm_idem[k]
             return {"slots": int(p.get("slots", 4))}
@@ -217,9 +221,10 @@ class ChaosCluster:
     LM_POOL = "chaos-lm"
 
     def __init__(self, seed: int, data_dir: str, n_hosts: int = 5,
-                 prefill_chunk: int = 0) -> None:
+                 prefill_chunk: int = 0, n_model: int = 1) -> None:
         self.seed = seed
         self.prefill_chunk = prefill_chunk
+        self.n_model = n_model
         self.rng = random.Random(seed)
         self.cfg = ClusterConfig(
             hosts=tuple(f"n{i}" for i in range(n_hosts)),
@@ -301,7 +306,9 @@ class ChaosCluster:
             "verb": "lm_serve", "placement": "auto", "name": self.LM_POOL,
             "prompt_len": 8, "max_len": 64, "slots": 4,
             **({"prefill_chunk": self.prefill_chunk}
-               if self.prefill_chunk else {})})
+               if self.prefill_chunk else {}),
+            **({"n_model": self.n_model}
+               if self.n_model > 1 else {})})
         assert out.get("node") or out.get("already"), out
 
     # -- probes -----------------------------------------------------------
@@ -664,14 +671,16 @@ class ChaosCluster:
 
 def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
                         chaos: dict | None = None,
-                        prefill_chunk: int = 0) -> dict:
+                        prefill_chunk: int = 0,
+                        n_model: int = 1) -> dict:
     """One full seeded chaos run: schedule -> converge -> invariants.
     Returns the invariant summary plus convergence time.
     ``prefill_chunk`` rides the managed pool's lm_serve spec (ISSUE 7):
     the fake tier defers long-prompt completions by a poll round, so the
     schedule exercises journaled specs + watchdog retries against a pool
     with in-flight chunked admissions."""
-    c = ChaosCluster(seed, data_dir, prefill_chunk=prefill_chunk)
+    c = ChaosCluster(seed, data_dir, prefill_chunk=prefill_chunk,
+                     n_model=n_model)
     try:
         c.run_schedule(steps=steps,
                        chaos=chaos if chaos is not None

@@ -576,7 +576,10 @@ class Shell:
                     f"weights={cfg['quantize']} "
                     f"decode_steps={cfg['decode_steps']}"
                     + (f" draft_len={cfg['speculative_draft_len']}"
-                       if cfg["speculative_draft_len"] else ""))
+                       if cfg["speculative_draft_len"] else "")
+                    + (f" n_model={cfg['n_model']} "
+                       f"tp_bytes/step={cfg['tp_collective_bytes']}"
+                       if cfg.get("n_model", 1) > 1 else ""))
 
         def prefix_line(stats: dict) -> str:
             pc = stats.get("prefix_cache")

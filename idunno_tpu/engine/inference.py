@@ -165,8 +165,16 @@ class InferenceEngine:
         elif self.config.quantize != "none":
             raise ValueError(f"EngineConfig.quantize="
                              f"{self.config.quantize!r}: want none|int8")
-        variables = jax.device_put(variables, replicated_sharding(self.mesh))
-        predict, predict_many = self._build_predict(module)
+        # pod-slice TP: on a mesh with a real "model" axis, wide conv/
+        # dense kernels shard their output-feature dim over it
+        # (`parallel/sharding.py:cnn_tp_specs`); narrow layers — incl.
+        # the folded preprocess stem, so `preprocess="auto"` folding is
+        # untouched — and every mesh without a model axis replicate,
+        # which is exactly the old behavior
+        from idunno_tpu.parallel.sharding import shard_cnn_variables
+        variables = shard_cnn_variables(self.mesh, variables)
+        vsharding = jax.tree.map(lambda leaf: leaf.sharding, variables)
+        predict, predict_many = self._build_predict(module, vsharding)
         self._models[name] = _LoadedModel(
             module=module, variables=variables,
             predict=predict, predict_many=predict_many,
@@ -356,12 +364,15 @@ class InferenceEngine:
             return False
         return self.mesh.devices.flatten()[0].platform == "tpu"
 
-    def _build_predict(self, module):
+    def _build_predict(self, module, vsharding=None):
         from jax.sharding import NamedSharding, PartitionSpec as P
         from idunno_tpu.parallel.mesh import DATA_AXIS
 
         bsharding = batch_sharding(self.mesh)
         rsharding = replicated_sharding(self.mesh)
+        # per-leaf variable shardings (TP: wide kernels split over the
+        # model axis); a plain replicated tree when vsharding is absent
+        vsharding = vsharding if vsharding is not None else rsharding
 
         folded = getattr(module, "fold_preprocess", False)
         if not folded and self._pallas_ok is None:
@@ -419,7 +430,7 @@ class InferenceEngine:
             return top1_from_logits(logits)
 
         predict = jax.jit(fwd,
-                          in_shardings=(rsharding, bsharding),
+                          in_shardings=(vsharding, bsharding),
                           out_shardings=bsharding)
 
         # Many staged batches in ONE dispatch: lax.scan over the leading
@@ -434,7 +445,7 @@ class InferenceEngine:
         staged_sharding = NamedSharding(self.mesh, P(None, DATA_AXIS))
         predict_many = jax.jit(
             fwd_many,
-            in_shardings=(rsharding, staged_sharding),
+            in_shardings=(vsharding, staged_sharding),
             out_shardings=NamedSharding(self.mesh, P(None, DATA_AXIS)))
         return predict, predict_many
 

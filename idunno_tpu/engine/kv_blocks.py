@@ -36,10 +36,15 @@ compute at those positions. That is the whole reason cross-request
 sharing can keep greedy decode token-exact (`tests/test_prefix_cache.py`
 pins this against `engine/generate.py`).
 
-The block stores are allocated unsharded (replicated under a mesh):
-blocks are batch-1 slivers the admission path gathers/scatters on the
-host-facing side of the pool; the big [slots, max_len] decode cache in
-`DecodeServer` keeps its mesh sharding unchanged.
+The block stores are allocated unsharded by default (replicated under a
+mesh): blocks are batch-1 slivers the admission path gathers/scatters on
+the host-facing side of the pool; the big [slots, max_len] decode cache
+in `DecodeServer` keeps its mesh sharding unchanged. Under tensor
+parallelism (``mesh=`` with a "model" axis of extent > 1) the stores
+shard their KV-head dim over the model axis — matching the decode
+cache's head split, so the paged kernel's page reads stay chip-local —
+while the block axis stays whole on every chip (the host-side free-list
+addresses any block from anywhere).
 
 The reference has no KV reuse at any granularity — every query
 recomputes from scratch (`mp4_machinelearning.py:541-616`).
@@ -125,7 +130,8 @@ class KVBlockPool:
     free: see `serve/prefix_cache.py` for the radix tree that decides
     what the blocks mean and when they are evicted."""
 
-    def __init__(self, model, num_blocks: int, block_size: int) -> None:
+    def __init__(self, model, num_blocks: int, block_size: int,
+                 mesh=None) -> None:
         if num_blocks < 1:
             raise ValueError(f"num_blocks {num_blocks} must be >= 1")
         if block_size < 1:
@@ -133,6 +139,17 @@ class KVBlockPool:
         self.model = model
         self.num_blocks = num_blocks
         self.block_size = block_size
+        # TP page sharding: shard the KV-head dim of every store over the
+        # mesh's "model" axis when the heads divide (mirrors the decode
+        # cache's split — `parallel/sharding.py:lm_cache_specs`); a
+        # non-dividing head count replicates, same as no mesh at all
+        self._head_shard = None
+        if mesh is not None:
+            from idunno_tpu.parallel.mesh import MODEL_AXIS
+            n_model = int(mesh.shape.get(MODEL_AXIS, 1))
+            kvh = getattr(model, "num_kv_heads", None) or model.num_heads
+            if n_model > 1 and kvh % n_model == 0:
+                self._head_shard = (mesh, n_model)
         # scanned models carry depth-stacked caches ([L, 1, bs, ...]);
         # the stores lead with the depth axis ([L, N, bs, ...]) so one
         # write/gather moves every layer's sliver at once AND store[l]
@@ -156,8 +173,9 @@ class KVBlockPool:
                 else:
                     shape = (num_blocks, block_size) + leaf.shape[2:]
                 key = jax.tree_util.keystr(path)
-                self._stores[key] = jnp.zeros(shape, leaf.dtype)
                 name = path[-1].key
+                self._stores[key] = self._alloc_store(shape, leaf.dtype,
+                                                      name)
                 self._leaf_names[name] = (
                     None if name in self._leaf_names else key)
         if not self._stores:
@@ -166,6 +184,23 @@ class KVBlockPool:
         self._refs: dict[int, int] = {}       # allocated block → refcount
         # eval_shape templates for gather output trees, keyed by length
         self._tree_templates: dict[int, Any] = {}
+
+    def _alloc_store(self, shape: tuple, dtype, name: str) -> jnp.ndarray:
+        """Zeroed store, head-sharded over the model axis under TP. The
+        KV-head dim is second-to-last on cached_k/v ([.., kvh, d]) and
+        last on the scale leaves ([.., kvh])."""
+        if self._head_shard is None:
+            return jnp.zeros(shape, dtype)
+        from jax.sharding import NamedSharding, PartitionSpec
+        from idunno_tpu.parallel.mesh import MODEL_AXIS
+        mesh, _ = self._head_shard
+        head_dim = len(shape) - (2 if name in ("cached_k", "cached_v")
+                                 else 1)
+        axes = [None] * len(shape)
+        axes[head_dim] = MODEL_AXIS
+        sh = NamedSharding(mesh, PartitionSpec(*axes))
+        return jax.jit(lambda: jnp.zeros(shape, dtype),
+                       out_shardings=sh)()
 
     # -- allocation -------------------------------------------------------
 

@@ -47,6 +47,7 @@ from idunno_tpu.engine.kv_blocks import concat_kv_prefix
 from idunno_tpu.models.transformer import (TransformerLM, decode_apply,
                                            scan_compatible,
                                            stack_block_params)
+from idunno_tpu.parallel.sharding import tp_collective_bytes
 from idunno_tpu.ops.paged_attention import (PagedContext,
                                             resolve_paged_kernel)
 from idunno_tpu.ops.quantize import dequantize_tree, quantize_tree
@@ -490,7 +491,8 @@ class DecodeServer:
     def __init__(self, model: TransformerLM, params: Any, *, slots: int,
                  prompt_len: int, max_len: int, decode_steps: int = 1,
                  quantize: str = "none", eos_id: int | None = None,
-                 mesh=None, draft: tuple | None = None,
+                 mesh=None, n_model: int = 1,
+                 draft: tuple | None = None,
                  draft_len: int = 4,
                  prompt_buckets: tuple[int, ...] | None = None,
                  track_logprobs: bool = False,
@@ -675,9 +677,48 @@ class DecodeServer:
 
         # mesh sharding: the pool's slot dimension spreads over the mesh's
         # data axis (every per-row decode op is elementwise over slots, so
-        # the step runs SPMD with zero cross-row collectives); params
-        # replicate. One pool then scales its co-resident sequences — and
-        # its KV-cache HBM — across chips.
+        # the step runs SPMD with zero cross-row collectives). n_model > 1
+        # — or a mesh whose "model" axis has extent > 1 — additionally
+        # activates tensor parallelism: the stacked scanned params take
+        # the Megatron column/row split over the model axis
+        # (`parallel/sharding.py:lm_tp_specs`), so GSPMD inserts the two
+        # per-block psums INSIDE the one `lax.scan`, and the KV caches
+        # shard their head dim while the slot axis stays on
+        # `P(None, "data")`. One pool then scales co-resident sequences
+        # across the data axis AND a too-big-for-one-chip model across
+        # the model axis.
+        n_model = int(n_model)
+        if n_model < 1:
+            raise ValueError(f"n_model {n_model} must be >= 1")
+        if mesh is None and n_model > 1:
+            # pure-TP mesh over n_model devices; pass an explicit mesh
+            # for combined data x model
+            from idunno_tpu.parallel.mesh import make_mesh
+            mesh = make_mesh(1, n_model)
+        if mesh is not None:
+            from idunno_tpu.parallel.mesh import MODEL_AXIS
+            mesh_model = int(mesh.shape.get(MODEL_AXIS, 1))
+            if n_model == 1:
+                n_model = mesh_model        # mesh is authoritative
+            elif n_model != mesh_model:
+                raise ValueError(
+                    f"n_model={n_model} conflicts with the mesh's model "
+                    f"axis extent {mesh_model}")
+        self.n_model = n_model
+        self._kv_shard = False
+        if n_model > 1:
+            if not self._scan:
+                # TP specs target the stacked layout; MoE/unscanned pools
+                # keep the per-layer loop and stay data-parallel only
+                raise ValueError(
+                    "n_model > 1 requires the scanned decode layout "
+                    "(dense scan-compatible blocks)")
+            from idunno_tpu.parallel.mesh import check_head_divisibility
+            check_head_divisibility(model.num_heads, n_model)
+            kvh = getattr(model, "num_kv_heads", None) or model.num_heads
+            # GQA divide-or-replicate: non-dividing KV heads replicate
+            # k/v params and the KV cache while Q still shards
+            self._kv_shard = kvh % n_model == 0
         self.mesh = mesh
         rows = None
         stacked_rows = None
@@ -685,7 +726,7 @@ class DecodeServer:
             from jax.sharding import NamedSharding, PartitionSpec
             from idunno_tpu.parallel.mesh import DATA_AXIS
             from idunno_tpu.parallel.sharding import (
-                batch_sharding, replicate, replicated_sharding)
+                batch_sharding, lm_tp_specs, replicate, replicated_sharding)
             n_data = mesh.shape[DATA_AXIS]
             if slots % n_data:
                 raise ValueError(f"slots={slots} must divide over the "
@@ -694,7 +735,15 @@ class DecodeServer:
             # scanned caches lead with DEPTH ([L, slots, ...]): the slot
             # split moves one dim right, depth stays whole on every chip
             stacked_rows = NamedSharding(mesh, PartitionSpec(None, DATA_AXIS))
-            self.params = replicate(mesh, self.params)
+            if self.n_model > 1:
+                specs = lm_tp_specs(self.params, n_model=self.n_model,
+                                    kv_shard=self._kv_shard)
+                self.params = jax.tree.map(
+                    lambda leaf, sp: jax.device_put(
+                        leaf, NamedSharding(mesh, sp)),
+                    self.params, specs)
+            else:
+                self.params = replicate(mesh, self.params)
 
         def zeros(shape, dtype, stacked=False):
             # allocate UNDER the sharding: materializing the full cache on
@@ -714,9 +763,22 @@ class DecodeServer:
         self._tokens = zeros((slots, max_len), jnp.int32)
         cache_shapes = jax.eval_shape(
             lambda: init_cache(self._dec_for_init(), slots, max_len))
-        self._cache = jax.tree.map(
-            lambda s: zeros(s.shape, s.dtype, stacked=self._scan),
-            cache_shapes)
+        if self.n_model > 1:
+            # TP cache layout: slot axis stays on the data axis, KV head
+            # dim shards over "model" when the heads divide
+            from jax.sharding import NamedSharding
+            from idunno_tpu.parallel.sharding import lm_cache_specs
+            cache_spec = lm_cache_specs(cache_shapes, n_model=self.n_model,
+                                        kv_shard=self._kv_shard)
+            self._cache = jax.tree.map(
+                lambda s, sp: jax.jit(
+                    lambda: jnp.zeros(s.shape, s.dtype),
+                    out_shardings=NamedSharding(mesh, sp))(),
+                cache_shapes, cache_spec)
+        else:
+            self._cache = jax.tree.map(
+                lambda s: zeros(s.shape, s.dtype, stacked=self._scan),
+                cache_shapes)
         self._cursors = zeros((slots,), jnp.int32)
         self._remaining = zeros((slots,), jnp.int32)
         # paged decode state: per-slot block table + paged-region length
@@ -761,12 +823,38 @@ class DecodeServer:
                 lambda: init_cache(ddec, slots, max_len))
             dstacked = bool(getattr(self._draft_model, "scan_layers",
                                     False))
-            self._draft_cache = jax.tree.map(
-                lambda s: zeros(s.shape, s.dtype, stacked=dstacked),
-                dshapes)
-            if mesh is not None:
-                from idunno_tpu.parallel.sharding import replicate
-                self._draft_params = replicate(mesh, self._draft_params)
+            # the draft TP-shards only when its own Q heads divide the
+            # model axis (no hard error: a tiny replicated draft is fine)
+            draft_tp = (self.n_model > 1 and dstacked and
+                        self._draft_model.num_heads % self.n_model == 0)
+            if draft_tp:
+                from jax.sharding import NamedSharding
+                from idunno_tpu.parallel.sharding import (lm_cache_specs,
+                                                          lm_tp_specs)
+                dkvh = (getattr(self._draft_model, "num_kv_heads", None)
+                        or self._draft_model.num_heads)
+                dkv_shard = dkvh % self.n_model == 0
+                dspec = lm_cache_specs(dshapes, n_model=self.n_model,
+                                       kv_shard=dkv_shard)
+                self._draft_cache = jax.tree.map(
+                    lambda s, sp: jax.jit(
+                        lambda: jnp.zeros(s.shape, s.dtype),
+                        out_shardings=NamedSharding(mesh, sp))(),
+                    dshapes, dspec)
+                pspec = lm_tp_specs(self._draft_params,
+                                    n_model=self.n_model,
+                                    kv_shard=dkv_shard)
+                self._draft_params = jax.tree.map(
+                    lambda leaf, sp: jax.device_put(
+                        leaf, NamedSharding(mesh, sp)),
+                    self._draft_params, pspec)
+            else:
+                self._draft_cache = jax.tree.map(
+                    lambda s: zeros(s.shape, s.dtype, stacked=dstacked),
+                    dshapes)
+                if mesh is not None:
+                    from idunno_tpu.parallel.sharding import replicate
+                    self._draft_params = replicate(mesh, self._draft_params)
 
         # host state
         self._queue: deque[Request] = deque()
@@ -814,8 +902,9 @@ class DecodeServer:
             from idunno_tpu.serve.prefix_cache import RadixPrefixCache
             nblocks = int(kv_cache_blocks) or slots * (
                 (prompt_len + self.kv_block_size - 1) // self.kv_block_size)
-            self._block_pool = KVBlockPool(model, nblocks,
-                                           self.kv_block_size)
+            self._block_pool = KVBlockPool(
+                model, nblocks, self.kv_block_size,
+                mesh=self.mesh if self.n_model > 1 else None)
             self._radix = RadixPrefixCache(self._block_pool)
 
     @staticmethod
@@ -1306,6 +1395,12 @@ class DecodeServer:
             "kv_cache_blocks": (self._block_pool.num_blocks
                                 if self._block_pool is not None else 0),
             "scan_layers": self._scan,
+            # tensor parallelism: model-axis extent + estimated psum
+            # payload per decode step (2 row-parallel reductions per
+            # block over a [slots, 1, dim] activation; 0 when TP is off)
+            "n_model": self.n_model,
+            "tp_collective_bytes": tp_collective_bytes(
+                self.model, self.slots, self.n_model),
         }
         out = dict(self._stats, live=len(self._live),
                    queued=len(self._queue), slots=self.slots,

@@ -21,14 +21,39 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+class MeshShapeError(ValueError):
+    """A requested (data, model) mesh shape cannot be built.
+
+    Typed (not bare ValueError) so callers can branch on "the mesh itself
+    is impossible" — wrong device count, non-dividing ``n_model``, or a
+    model axis the attention-head geometry can't split over — separately
+    from ordinary bad-argument errors. Carries the numbers that explain
+    the refusal:
+
+        n_devices  visible/offered device count (0 if not device-related)
+        n_model    requested model-axis extent
+        constraint one-line statement of the violated rule
+    """
+
+    def __init__(self, msg: str, *, n_devices: int = 0, n_model: int = 1,
+                 constraint: str = ""):
+        super().__init__(msg)
+        self.n_devices = n_devices
+        self.n_model = n_model
+        self.constraint = constraint
+
+
 def make_mesh(n_data: int, n_model: int = 1,
               devices: list | None = None) -> Mesh:
     """Build a (data, model) mesh over ``devices`` (default: all local)."""
     devices = devices if devices is not None else jax.devices()
     need = n_data * n_model
     if need > len(devices):
-        raise ValueError(f"mesh {n_data}x{n_model} needs {need} devices, "
-                         f"have {len(devices)}")
+        raise MeshShapeError(
+            f"mesh {n_data}x{n_model} needs {need} devices, "
+            f"have {len(devices)}",
+            n_devices=len(devices), n_model=n_model,
+            constraint=f"n_data*n_model <= {len(devices)} devices")
     grid = np.asarray(devices[:need]).reshape(n_data, n_model)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 
@@ -36,9 +61,26 @@ def make_mesh(n_data: int, n_model: int = 1,
 def local_mesh(n_model: int = 1) -> Mesh:
     """Mesh over every visible device, data-parallel by default."""
     n = len(jax.devices())
-    if n % n_model:
-        raise ValueError(f"{n} devices not divisible by model axis {n_model}")
+    if n_model < 1 or n % n_model:
+        raise MeshShapeError(
+            f"{n} devices not divisible by model axis {n_model}",
+            n_devices=n, n_model=n_model,
+            constraint=f"n_model must divide {n} devices")
     return make_mesh(n // n_model, n_model)
+
+
+def check_head_divisibility(num_heads: int, n_model: int) -> None:
+    """Attention-head constraint for a model-axis of ``n_model``: Q heads
+    must split evenly (Megatron column-parallel QKV). Raises the typed
+    MeshShapeError naming the constraint; KV heads are handled separately
+    (divide-or-replicate, see `parallel/sharding.py:lm_tp_specs`)."""
+    if n_model > 1 and num_heads % n_model:
+        raise MeshShapeError(
+            f"num_heads={num_heads} not divisible by model axis "
+            f"{n_model}",
+            n_model=n_model,
+            constraint=f"num_heads % n_model == 0 "
+                       f"(got {num_heads} % {n_model})")
 
 
 # -- multi-host bring-up ----------------------------------------------------
@@ -84,9 +126,11 @@ def global_mesh(n_model: int = 1) -> Mesh:
     arrays sharded over the data axis are globally sharded across hosts."""
     devices = jax.devices()                # global across processes
     n = len(devices)
-    if n % n_model:
-        raise ValueError(f"{n} global devices not divisible by model axis "
-                         f"{n_model}")
+    if n_model < 1 or n % n_model:
+        raise MeshShapeError(
+            f"{n} global devices not divisible by model axis {n_model}",
+            n_devices=n, n_model=n_model,
+            constraint=f"n_model must divide {n} global devices")
     return make_mesh(n // n_model, n_model, devices=devices)
 
 

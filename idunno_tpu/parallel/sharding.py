@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from idunno_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
@@ -43,3 +44,177 @@ def tp_param_spec(path: tuple, leaf: Any) -> P:
     if leaf.ndim >= 2 and leaf.shape[-1] % 2 == 0 and "fc" in name and leaf.size > 1 << 20:
         return P(*([None] * (leaf.ndim - 1) + [MODEL_AXIS]))
     return P()
+
+
+# -- LM tensor parallelism (stacked scanned layout) -------------------------
+#
+# Megatron-style intra-layer split (Shoeybi et al. 2019; Pope et al. MLSys
+# 2023 for the inference variant): Q/K/V and mlp_up are COLUMN-parallel
+# (output heads / hidden features sharded over the model axis), out and
+# mlp_down are ROW-parallel (contraction dim sharded → one psum each), so
+# GSPMD inserts exactly TWO collectives per block — and because the specs
+# ride the *stacked* `[depth, ...]` leaves, those collectives live inside
+# the scan body of the ONE `lax.scan`, not per unrolled layer. Embedding
+# and unembed stay replicated: sharding them saves little at serving sizes
+# and replicating keeps the logits bit-identical across n_model (the
+# token-exactness tests compare streams across mesh shapes).
+#
+# GQA rule: Q heads MUST divide n_model (`mesh.check_head_divisibility`);
+# KV heads divide-or-replicate — when `num_kv_heads % n_model != 0` the
+# k/v kernels and the KV cache stay replicated while Q still shards
+# (GSPMD reshards at the grouped einsum; correct, just more traffic).
+
+_PATH_STR_KEYS = ("key", "name", "idx")
+
+
+def _path_names(path: tuple) -> list[str]:
+    out = []
+    for p in path:
+        for attr in _PATH_STR_KEYS:
+            v = getattr(p, attr, None)
+            if isinstance(v, str):
+                out.append(v)
+                break
+    return out
+
+
+def _sanitize(spec: P, leaf: Any, n_model: int) -> P:
+    """Clamp a wished-for spec to what the leaf can actually carry: drop
+    the model axis from any dim the leaf lacks or that doesn't divide
+    (QTensor scales have broadcast 1-dims; odd hidden sizes replicate)."""
+    axes = list(spec) + [None] * (leaf.ndim - len(spec))
+    axes = axes[:leaf.ndim]
+    for i, ax in enumerate(axes):
+        if ax is not None and leaf.shape[i] % n_model:
+            axes[i] = None
+    while axes and axes[-1] is None:
+        axes.pop()
+    return P(*axes)
+
+
+def lm_tp_specs(params: Any, *, n_model: int,
+                kv_shard: bool = True) -> Any:
+    """PartitionSpec tree for a *stacked* scanned LM param tree
+    (`stack_block_params` output: block leaves under "blocks" with a
+    leading depth axis). QTensor leaves spec through their fields (q
+    shards like its kernel, broadcast scale dims auto-replicate).
+    ``kv_shard=False`` replicates k/v (GQA divide-or-replicate)."""
+    M = MODEL_AXIS
+    kernel_rules = {
+        "q": P(None, None, M, None),            # [L, dim, H, hd]
+        "k": P(None, None, M, None) if kv_shard else P(),
+        "v": P(None, None, M, None) if kv_shard else P(),
+        "out": P(None, M, None, None),          # [L, H, hd, dim]  (psum)
+        "mlp_up": P(None, None, M),             # [L, dim, hidden]
+        "mlp_down": P(None, M, None),           # [L, hidden, dim] (psum)
+    }
+    bias_rules = {
+        "q": P(None, M, None),                  # [L, H, hd]
+        "k": P(None, M, None) if kv_shard else P(),
+        "v": P(None, M, None) if kv_shard else P(),
+        "mlp_up": P(None, M),                   # [L, hidden]
+    }
+
+    def rule(path, leaf):
+        if n_model <= 1 or not hasattr(leaf, "ndim"):
+            return P()
+        names = _path_names(path)
+        if "blocks" not in names:
+            return P()                          # embed/head/ln_f replicated
+        # module name is the segment just before kernel/bias; QTensor
+        # fields ("q"/"scale") come AFTER, so cut the path there first
+        for kind, rules in (("kernel", kernel_rules), ("bias", bias_rules)):
+            if kind in names:
+                mod = names[names.index(kind) - 1]
+                return _sanitize(rules.get(mod, P()), leaf, n_model)
+        return P()                              # ln scales/biases
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def lm_cache_specs(cache: Any, *, n_model: int, kv_shard: bool = True) -> Any:
+    """PartitionSpec tree for the *stacked* decode cache: slot axis stays
+    on the data axis (`P(None, "data")` — dim 1 of every stacked leaf),
+    and the KV head dim (dim 3 of `cached_k`/`cached_v` [L, S, T, kvh, hd],
+    dim 3 of `k_scale`/`v_scale` [L, S, T, kvh]) shards over "model" when
+    the KV heads divide; cursors and everything else ride the data axis
+    only."""
+    M = MODEL_AXIS if (n_model > 1 and kv_shard) else None
+
+    def rule(path, leaf):
+        names = _path_names(path)
+        if M and names and names[-1] in ("cached_k", "cached_v"):
+            return _sanitize(P(None, DATA_AXIS, None, M, None),
+                             leaf, n_model)
+        if M and names and names[-1] in ("k_scale", "v_scale"):
+            return _sanitize(P(None, DATA_AXIS, None, M), leaf, n_model)
+        return P(None, DATA_AXIS) if leaf.ndim >= 2 else P()
+
+    return jax.tree_util.tree_map_with_path(rule, cache)
+
+
+def shard_lm_params(mesh: Mesh, model: Any, params: Any) -> Any:
+    """Device-put an LM param tree onto ``mesh`` with the TP specs,
+    stacking flat per-block params first if needed. The committed
+    shardings flow into `engine.generate`'s jit unchanged, so `generate`
+    runs the IDENTICAL sharded step the serving pool runs — exactness
+    across ``n_model`` stays structural. Raises `MeshShapeError` when the
+    Q heads can't split over the mesh's model axis."""
+    from idunno_tpu.models.transformer import stack_block_params
+    from idunno_tpu.parallel.mesh import check_head_divisibility
+
+    n_model = int(mesh.shape.get(MODEL_AXIS, 1))
+    if "blocks" not in params and "block0" in params:
+        params = stack_block_params(params, model.depth)
+    if n_model <= 1:
+        return replicate(mesh, params)
+    check_head_divisibility(model.num_heads, n_model)
+    kvh = getattr(model, "num_kv_heads", None) or model.num_heads
+    specs = lm_tp_specs(params, n_model=n_model,
+                        kv_shard=kvh % n_model == 0)
+    return jax.tree.map(
+        lambda leaf, sp: jax.device_put(leaf, NamedSharding(mesh, sp)),
+        params, specs)
+
+
+def tp_collective_bytes(model: Any, slots: int, n_model: int) -> int:
+    """Estimated psum payload per decode step: two row-parallel reductions
+    per block (attention out + mlp_down), each over a [slots, 1, dim]
+    activation. 0 when TP is off — the gauge reads as "bytes moved over
+    the model axis per dispatched token step"."""
+    if n_model <= 1:
+        return 0
+    itemsize = jnp.zeros((), model.dtype).dtype.itemsize
+    return 2 * model.depth * slots * model.dim * itemsize
+
+
+# -- CNN tensor parallelism (pod-slice serving) -----------------------------
+
+def cnn_tp_specs(variables: Any, *, n_model: int,
+                 min_features: int = 128) -> Any:
+    """PartitionSpec tree for CNN inference variables: shard the last
+    (output-features / cout) dim of wide kernels over the model axis,
+    replicate biases, norms, and narrow layers (the folded preprocess
+    stem's 64-channel conv stays replicated, so `preprocess="auto"`
+    folding is untouched). QTensor fields sanitize the same way as LM
+    params."""
+    def rule(path, leaf):
+        if (n_model > 1 and hasattr(leaf, "ndim") and leaf.ndim >= 2
+                and leaf.shape[-1] >= min_features
+                and leaf.shape[-1] % n_model == 0):
+            return P(*([None] * (leaf.ndim - 1) + [MODEL_AXIS]))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(rule, variables)
+
+
+def shard_cnn_variables(mesh: Mesh, variables: Any) -> Any:
+    """Device-put CNN variables with `cnn_tp_specs` (replicate when the
+    mesh has no model axis extent)."""
+    n_model = int(mesh.shape.get(MODEL_AXIS, 1))
+    if n_model <= 1:
+        return replicate(mesh, variables)
+    specs = cnn_tp_specs(variables, n_model=n_model)
+    return jax.tree.map(
+        lambda leaf, sp: jax.device_put(leaf, NamedSharding(mesh, sp)),
+        variables, specs)

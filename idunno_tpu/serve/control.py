@@ -323,7 +323,11 @@ class ControlService:
                     # (ops/paged_attention.py); both ride the journaled
                     # spec like the block-pool keys above
                     paged_kernel=p.get("paged_kernel"),
-                    prefill_chunk=int(p.get("prefill_chunk", 0)))
+                    prefill_chunk=int(p.get("prefill_chunk", 0)),
+                    # tensor parallelism over the mesh's "model" axis;
+                    # rides the journaled spec so manager placement and
+                    # recovery rebuilds keep the same mesh shape
+                    n_model=int(p.get("n_model", 1)))
                 if p.get("warmup"):
                     # pay the pool's one-time compiles BEFORE the loop
                     # accepts traffic and reset its accounting, so the
@@ -458,18 +462,23 @@ class ControlService:
             return {"qos": gw.stats() if gw is not None else None}
         if verb == "lm_stats":
             stats = self._lm_loop(p["name"]).stats()
+            # surface pool gauges on the node's C8 metrics tracker so the
+            # cluster metrics plane (metrics_export) sees them: tensor-
+            # parallel shape + per-step psum payload always, plus the
+            # prefix-cache gauges and the paged/chunked win counters when
+            # the cache is on (gather traffic avoided, admissions split)
+            cfg = stats.get("config", {})
+            gauges = {"n_model": cfg.get("n_model", 1),
+                      "tp_collective_bytes": cfg.get(
+                          "tp_collective_bytes", 0)}
             pc = stats.get("prefix_cache")
             if pc is not None:
-                # surface the prefix-cache gauges on the node's C8
-                # metrics tracker so the cluster metrics plane sees them
-                # — plus the paged/chunked win counters, which belong to
-                # the same cache story (gather traffic avoided, long
-                # admissions split)
-                node.metrics.record_lm_gauges(p["name"], dict(
+                gauges.update(
                     pc,
                     kv_gather_bytes_saved=stats.get(
                         "kv_gather_bytes_saved", 0),
-                    prefill_chunks=stats.get("prefill_chunks", 0)))
+                    prefill_chunks=stats.get("prefill_chunks", 0))
+            node.metrics.record_lm_gauges(p["name"], gauges)
             gw = stats.get("gateway")
             if gw is not None:
                 node.metrics.record_gateway_gauges(p["name"], {

@@ -962,6 +962,124 @@ def run_lm_paged_bench(platform: str, device_kind: str, n_devices: int,
     return out
 
 
+def lm_tp_grid(platform: str) -> list[tuple[int, int]]:
+    """(n_model, slots) points for BENCH_SUITE=lm_tp. TPU measures the
+    serving-relevant 16/32 slots at n_model 1 vs 2 (the two-chip split);
+    CPU proves the machinery on a miniature.
+    BENCH_LM_TP_GRID=m:s,m:s overrides."""
+    env = os.environ.get("BENCH_LM_TP_GRID")
+    if env:
+        return [(int(m), int(s)) for m, s in
+                (p.split(":") for p in env.split(",") if p.strip())]
+    if platform == "tpu":
+        return [(1, 16), (2, 16), (1, 32), (2, 32)]
+    return [(1, 2), (2, 2), (1, 4), (2, 4)]
+
+
+def run_lm_tp_bench(platform: str, device_kind: str, n_devices: int,
+                    peak_bf16: float | None, *, deadline: float,
+                    compact: bool = False) -> dict:
+    """BENCH_SUITE=lm_tp: steady-state decode throughput of the tensor-
+    parallel scanned pool (`parallel/sharding.py:lm_tp_specs` — Megatron
+    column/row split, two psums per block inside the ONE lax.scan) at
+    n_model 1 vs 2 (ISSUE 9). Each point times pure decode dispatches on
+    a pure-TP mesh; paired points report the TP speedup AND a token-
+    exactness probe (the first completion must match across n_model — the
+    structural-exactness claim, checked on-chip). A point whose n_model
+    exceeds the visible device count records a skip, not an error, so a
+    single-chip window still captures the n_model=1 baseline."""
+    from idunno_tpu.engine.serve_lm import DecodeServer
+    from idunno_tpu.models.transformer import TransformerLM
+
+    cfg = lm_bench_config(platform)
+    out: dict = {"config": {k: v for k, v in cfg.items()},
+                 "platform": platform, "device_kind": device_kind,
+                 "n_devices": n_devices}
+    dt = jnp.bfloat16
+    model = TransformerLM(vocab=cfg["vocab"], dim=cfg["dim"],
+                          depth=cfg["depth"], num_heads=cfg["heads"],
+                          causal=True, dtype=dt, param_dtype=dt)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    n_params, _ = _count_params(params)
+    out["n_params"] = n_params
+    max_new = cfg["decode_steps"] * 3 + 1
+    prompt_len = min(cfg["prompt_len"], 64)
+    prompt = [int(t) for t in np.random.default_rng(5).integers(
+        1, cfg["vocab"], size=prompt_len)]
+
+    def run_point(n_model: int, slots: int) -> dict:
+        srv = DecodeServer(model, params, slots=slots,
+                           prompt_len=prompt_len,
+                           max_len=prompt_len + max_new + 1,
+                           decode_steps=cfg["decode_steps"],
+                           n_model=n_model)
+        t0 = time.perf_counter()
+        srv.submit(prompt, max_new=2)          # cold compile
+        head = srv.run_until_drained()[0].tokens
+        c_s = time.perf_counter() - t0
+        for _ in range(slots):
+            srv.submit(prompt, max_new=max_new)
+        srv.step()                             # admissions + first dispatch
+        k = max(1, (max_new - 1) // cfg["decode_steps"] - 1)
+        t0 = time.perf_counter()
+        for _ in range(k):
+            srv.step()
+        disp = (time.perf_counter() - t0) / k
+        st = srv.stats()["config"]
+        rec = {"tokens_per_s": round(
+                   slots * cfg["decode_steps"] / disp, 1),
+               "dispatch_s": round(disp, 4), "timed_dispatches": k,
+               "compile_s": round(c_s, 2),
+               "tp_collective_bytes": st["tp_collective_bytes"],
+               "head_tokens": head}
+        if peak_bf16:
+            rec["mfu"] = round(rec["tokens_per_s"] * 2.0 * n_params
+                               / (peak_bf16 / max(1, n_model)), 4)
+        del srv
+        return rec
+
+    points: list[dict] = []
+    out["points"] = points
+    base_heads: dict[int, list] = {}           # slots -> n_model=1 stream
+    for n_model, slots in lm_tp_grid(platform):
+        point: dict = {"n_model": n_model, "slots": slots}
+        points.append(point)
+        if n_model > n_devices:
+            point["skipped"] = f"needs {n_model} devices, have {n_devices}"
+            continue
+        if points[:-1] and time.perf_counter() > deadline:
+            point["skipped"] = "time budget"
+            continue
+        try:
+            rec = run_point(n_model, slots)
+        except Exception as e:  # noqa: BLE001 - record, never hide
+            point["error"] = f"{type(e).__name__}: {e}"
+            continue
+        head = rec.pop("head_tokens")
+        point.update(rec)
+        if n_model == 1:
+            base_heads[slots] = head
+        elif slots in base_heads:
+            # the structural-exactness claim, measured where it runs
+            point["token_exact_vs_1"] = head == base_heads[slots]
+            base = next((p for p in points
+                         if p["n_model"] == 1 and p["slots"] == slots
+                         and "tokens_per_s" in p), None)
+            if base is not None:
+                point["speedup_vs_1"] = round(
+                    point["tokens_per_s"] / base["tokens_per_s"], 3)
+    ok = [p for p in points if "tokens_per_s" in p]
+    if ok:
+        tp = [p for p in ok if p["n_model"] > 1] or ok
+        best = max(tp, key=lambda p: p["tokens_per_s"])
+        # headline for BENCH_LAST_GOOD_lm_tp.json (bench.py reads
+        # out[value_key]["tokens_per_s"])
+        out["best"] = {"n_model": best["n_model"], "slots": best["slots"],
+                       "tokens_per_s": best["tokens_per_s"]}
+    return out
+
+
 def run_lm_gateway_bench(platform: str, device_kind: str, n_devices: int,
                          peak_bf16: float | None, *, deadline: float,
                          compact: bool = False) -> dict:

@@ -536,3 +536,42 @@ def test_metrics_lm_gauges_roundtrip():
     m2 = MetricsTracker()
     m2.load_wire(m.to_wire())
     assert m2.lm_gauges("pool") == g
+
+
+# -- tensor parallelism over the paged pool (ISSUE 9) -----------------------
+
+def test_paged_tp_hit_depths_token_exact(lm, eight_devices):
+    """TP composes with the paged block pool: the block stores shard
+    their KV-head dim over the model axis (block axis stays whole, so
+    the host-side free-list is unchanged) and every radix hit depth
+    stays token-exact vs `generate` under n_model=2 — greedy AND a
+    pinned-seed sampled stream."""
+    from idunno_tpu.parallel.mesh import MODEL_AXIS
+
+    model, params = lm
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                       kv_block_size=BS, kv_cache_blocks=16,
+                       paged_kernel="xla", n_model=2)
+    assert srv.n_model == 2
+    # the stores actually carry the model axis on the KV head dim
+    k_store = next(s for key, s in srv._block_pool._stores.items()
+                   if "cached_k" in key)
+    assert MODEL_AXIS in tuple(k_store.sharding.spec)
+    ref = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                       kv_block_size=BS, kv_cache_blocks=16,
+                       paged_kernel="xla")
+    for prompt, hit in hit_depth_prompts(np.random.default_rng(3)):
+        rid = srv.submit(prompt, max_new=6)
+        done = {c.id: c for c in srv.run_until_drained()}
+        assert done[rid].tokens == expected(model, params, prompt, 6), \
+            f"TP paged diverged at expected hit depth {hit}"
+        sid = srv.submit(prompt, max_new=6, temperature=0.8, top_p=0.9,
+                         seed=42)
+        sampled = {c.id: c for c in srv.run_until_drained()}[sid].tokens
+        fid = ref.submit(prompt, max_new=6, temperature=0.8, top_p=0.9,
+                         seed=42)
+        ref.submit(prompt, max_new=6)             # keep hit depths aligned
+        ref_sampled = {c.id: c for c in ref.run_until_drained()}[fid].tokens
+        assert sampled == ref_sampled, \
+            f"TP paged sampled stream forked at hit depth {hit}"
+    assert srv.prefix_cache_stats()["hits"] >= 3

@@ -132,3 +132,42 @@ def test_bless_slots_picks_knee_not_max():
     assert b["frac_of_max"] == pytest.approx(100 / 160, abs=1e-3)
     assert bless_slots(curve, frac=0.9)["slots"] == 4   # 150 >= 144
     assert bless_slots(curve, frac=0.99)["slots"] == 8  # only the max
+
+
+def test_tp_step_still_one_scan_and_collectives_depth_invariant():
+    """Tensor parallelism must not undo the scan win: the TP specs ride
+    the *stacked* leaves, so GSPMD's two per-block psums land INSIDE the
+    scan body — the traced step is still ONE `lax.scan`, and the
+    compiled program's all-reduce count is depth-invariant (adding
+    layers adds rows to the stacked operands, not collectives to the
+    program)."""
+    from jax.sharding import NamedSharding
+    from idunno_tpu.parallel.mesh import make_mesh
+    from idunno_tpu.parallel.sharding import lm_cache_specs, shard_lm_params
+
+    mesh = make_mesh(1, 2, devices=jax.devices()[:2])
+    counts = {}
+    for depth in (2, 4):
+        model = TransformerLM(vocab=VOCAB, dim=32, depth=depth,
+                              num_heads=4)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        dec_s = dataclasses.replace(decode_model(model, 16),
+                                    scan_layers=True)
+        sp = shard_lm_params(mesh, dec_s, params)
+        cache = init_cache(dec_s, 2, 16)
+        cache = jax.tree.map(
+            lambda l, s: jax.device_put(l, NamedSharding(mesh, s)),
+            cache, lm_cache_specs(cache, n_model=2))
+        tok = jnp.ones((2, 1), jnp.int32)
+        jx = jax.make_jaxpr(
+            lambda p, c, t: decode_apply(dec_s, p, c, t))(sp, cache, tok)
+        prims = [e.primitive.name for e in jx.jaxpr.eqns]
+        assert prims.count("scan") == 1, depth
+        text = jax.jit(
+            lambda p, c, t: decode_apply(dec_s, p, c, t)).lower(
+            sp, cache, tok).compile().as_text()
+        counts[depth] = text.count("all-reduce")
+    assert counts[2] > 0, "TP step must contain model-axis reductions"
+    assert counts[2] == counts[4], \
+        f"collective count grew with depth: {counts}"

@@ -1386,3 +1386,76 @@ def test_short_prompts_skip_chunking(lm):
     assert srv._pending is None and srv.stats()["prefill_chunks"] == 0
     done = {c.id: c for c in srv.run_until_drained()}
     assert done[rid].tokens == expected(model, params, [5, 9], 4)
+
+
+# -- tensor-parallel decode (ISSUE 9) ---------------------------------------
+
+@pytest.mark.parametrize("n_model", [2, 4])
+def test_tp_decode_token_exact(lm, eight_devices, n_model):
+    """The Megatron split over the model axis changes WHERE the math runs,
+    not what it computes: a TP pool must match the standalone generate
+    oracle token-for-token — greedy rows and seeded sampled rows alike."""
+    model, params = lm
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                       n_model=n_model)
+    assert srv.n_model == n_model
+    rng = np.random.default_rng(13)
+    reqs = [([int(t) for t in rng.integers(0, VOCAB, size=k)], m)
+            for k, m in [(3, 9), (8, 4), (5, 12), (2, 7)]]
+    ids = {srv.submit(p, m): (p, m, None) for p, m in reqs}
+    sp = [4, 17, 2]
+    sid = srv.submit(sp, max_new=8, temperature=0.8, top_p=0.9, seed=21)
+    done = {c.id: c for c in srv.run_until_drained()}
+    for rid, (p, m, _) in ids.items():
+        assert done[rid].tokens == expected(model, params, p, m), rid
+    # the sampled stream must reproduce the n_model=1 pool's stream
+    ref = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24)
+    ref_id = ref.submit(sp, max_new=8, temperature=0.8, top_p=0.9, seed=21)
+    ref_done = {c.id: c for c in ref.run_until_drained()}
+    assert done[sid].tokens == ref_done[ref_id].tokens, \
+        "seeded sampling diverged under TP"
+
+
+def test_tp_decode_2d_mesh_with_gqa(lm, eight_devices):
+    """4x2 (data, model) mesh: slots shard over data, heads over model,
+    and GQA KV heads that don't divide n_model replicate (divide-or-
+    replicate) — all still token-exact vs generate."""
+    from idunno_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(4, 2, devices=eight_devices)
+    for kvh in (2, 1):                    # divides / replicates (MQA)
+        gqa = TransformerLM(vocab=VOCAB, dim=32, depth=2, num_heads=4,
+                            num_kv_heads=kvh)
+        gparams = gqa.init(jax.random.PRNGKey(3),
+                           jnp.zeros((1, 8), jnp.int32))["params"]
+        srv = DecodeServer(gqa, gparams, slots=4, prompt_len=8,
+                           max_len=24, mesh=mesh)
+        assert srv.n_model == 2           # derived from the mesh
+        rids = {srv.submit([1 + kvh, 5, 9], max_new=6),
+                srv.submit([7, 2], max_new=8)}
+        done = {c.id: c for c in srv.run_until_drained()}
+        assert set(done) == rids
+        for c in done.values():
+            p = [1 + kvh, 5, 9] if len(c.tokens) == 9 else [7, 2]
+            assert c.tokens == expected(gqa, gparams, p,
+                                        len(c.tokens) - len(p)), kvh
+
+
+def test_tp_rejects_bad_shapes(lm, eight_devices):
+    """n_model must divide Q heads (typed MeshShapeError), conflict with
+    an explicit mesh raises, and the unscanned layout refuses TP."""
+    from idunno_tpu.parallel.mesh import MeshShapeError, make_mesh
+
+    model, params = lm
+    with pytest.raises(MeshShapeError):   # 4 heads over 3 shards
+        DecodeServer(model, params, slots=2, prompt_len=4, max_len=8,
+                     n_model=3)
+    mesh = make_mesh(4, 2, devices=eight_devices)
+    with pytest.raises(ValueError, match="conflicts"):
+        DecodeServer(model, params, slots=4, prompt_len=4, max_len=8,
+                     mesh=mesh, n_model=4)
+    moe_like = TransformerLM(vocab=VOCAB, dim=32, depth=2, num_heads=4,
+                             ffn_factory=lambda: None)
+    with pytest.raises(ValueError, match="scanned"):
+        DecodeServer(moe_like, params, slots=2, prompt_len=4, max_len=8,
+                     n_model=2)

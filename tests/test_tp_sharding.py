@@ -1,0 +1,199 @@
+"""Tensor-parallel sharding policy tests (fast lane, CPU mesh).
+
+Unit-level proofs for the ISSUE-9 TP surface: the typed `MeshShapeError`
+(8- and 5-device shapes), the Megatron spec rules for the stacked scanned
+LM layout (`lm_tp_specs` / `lm_cache_specs`), QTensor sanitization, the
+CNN pod-slice specs (`cnn_tp_specs` — folded stem stays replicated), and
+the `tp_collective_bytes` gauge. Token-exactness of the whole sharded
+decode path lives in tests/test_serve_lm.py / test_prefix_cache.py;
+structural one-scan proofs in tests/test_scanned_decode.py.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from idunno_tpu.models.transformer import TransformerLM, stack_block_params
+from idunno_tpu.ops.quantize import quantize_tree
+from idunno_tpu.parallel.mesh import (
+    DATA_AXIS, MODEL_AXIS, MeshShapeError, check_head_divisibility,
+    make_mesh)
+from idunno_tpu.parallel.sharding import (
+    cnn_tp_specs, lm_cache_specs, lm_tp_specs, shard_lm_params,
+    tp_collective_bytes)
+
+
+def _stacked_params(num_heads=4, num_kv_heads=None, quantized=False):
+    lm = TransformerLM(vocab=61, dim=32, depth=2, num_heads=num_heads,
+                       num_kv_heads=num_kv_heads)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.zeros((1, 4), jnp.int32))["params"]
+    if quantized:
+        params = quantize_tree(params)
+    return lm, stack_block_params(params, lm.depth)
+
+
+# -- MeshShapeError ---------------------------------------------------------
+
+def test_make_mesh_over_request_raises_typed(eight_devices):
+    with pytest.raises(MeshShapeError) as ei:
+        make_mesh(3, 4, devices=eight_devices)
+    e = ei.value
+    assert isinstance(e, ValueError)        # typed, still a ValueError
+    assert e.n_devices == 8 and e.n_model == 4
+    assert "8" in e.constraint
+
+
+def test_make_mesh_five_device_subset(eight_devices):
+    # odd subset: pure-DP builds, any model extent > 1 cannot tile 5
+    mesh = make_mesh(5, 1, devices=eight_devices[:5])
+    assert mesh.shape[DATA_AXIS] == 5 and mesh.shape[MODEL_AXIS] == 1
+    with pytest.raises(MeshShapeError) as ei:
+        make_mesh(2, 4, devices=eight_devices[:5])
+    assert ei.value.n_devices == 5 and ei.value.n_model == 4
+
+
+def test_check_head_divisibility():
+    check_head_divisibility(4, 2)           # divides: no raise
+    check_head_divisibility(3, 1)           # n_model=1: anything goes
+    with pytest.raises(MeshShapeError) as ei:
+        check_head_divisibility(4, 8)
+    e = ei.value
+    assert e.n_model == 8 and "num_heads" in e.constraint
+
+
+# -- LM param specs (stacked scanned layout) --------------------------------
+
+def test_lm_tp_specs_megatron_split():
+    _, stacked = _stacked_params(num_heads=4)
+    specs = lm_tp_specs(stacked, n_model=2)
+    b = specs["blocks"]
+    M = MODEL_AXIS
+    # column-parallel: heads / hidden sharded (trailing Nones popped)
+    assert b["attn"]["q"]["kernel"] == P(None, None, M)
+    assert b["mlp_up"]["kernel"] == P(None, None, M)
+    assert b["attn"]["q"]["bias"] == P(None, M)
+    # row-parallel: contraction dim sharded (the psum inputs)
+    assert b["attn"]["out"]["kernel"] == P(None, M)
+    assert b["mlp_down"]["kernel"] == P(None, M)
+    # psum outputs' biases + norms replicated
+    assert b["attn"]["out"]["bias"] == P()
+    # embed / unembed replicated (token-exactness across n_model)
+    assert specs["embed"]["embedding"] == P()
+    assert specs["head"]["kernel"] == P()
+    assert specs["ln_f"]["scale"] == P()
+
+
+def test_lm_tp_specs_gqa_divide_or_replicate():
+    # kv_shard=False: K/V replicate while Q still shards
+    _, stacked = _stacked_params(num_heads=4, num_kv_heads=1)
+    specs = lm_tp_specs(stacked, n_model=2, kv_shard=False)
+    b = specs["blocks"]
+    assert b["attn"]["q"]["kernel"] == P(None, None, MODEL_AXIS)
+    assert b["attn"]["k"]["kernel"] == P() and b["attn"]["v"]["kernel"] == P()
+    assert b["attn"]["k"]["bias"] == P()
+
+
+def test_lm_tp_specs_n_model_one_replicates_everything():
+    _, stacked = _stacked_params()
+    specs = lm_tp_specs(stacked, n_model=1)
+    assert all(sp == P() for sp in jax.tree.leaves(
+        specs, is_leaf=lambda x: isinstance(x, P)))
+
+
+def test_lm_tp_specs_qtensor_sanitize():
+    # QTensor leaves: int8 q shards like its kernel; the broadcast scale
+    # dims (size 1) auto-replicate via _sanitize instead of erroring
+    _, stacked = _stacked_params(quantized=True)
+    specs = lm_tp_specs(stacked, n_model=2)
+    qk = specs["blocks"]["attn"]["q"]["kernel"]
+    assert qk.q == P(None, None, MODEL_AXIS)
+    for ax in qk.scale:                     # [1,1,H,hd]-ish broadcast dims
+        assert ax in (None, MODEL_AXIS)
+    leaf = stacked["blocks"]["attn"]["q"]["kernel"].scale
+    for i, ax in enumerate(list(qk.scale)):
+        if ax == MODEL_AXIS:
+            assert leaf.shape[i] % 2 == 0   # only dividing dims shard
+
+
+# -- LM cache specs ---------------------------------------------------------
+
+def test_lm_cache_specs_slot_axis_and_kv_heads():
+    cache = {
+        "blocks": {
+            "attn": {
+                "cached_k": jnp.zeros((2, 4, 8, 4, 8)),   # [L,S,T,kvh,hd]
+                "cached_v": jnp.zeros((2, 4, 8, 4, 8)),
+                "k_scale": jnp.zeros((2, 4, 8, 4)),
+                "cache_index": jnp.zeros((2, 4), jnp.int32),
+            }
+        }
+    }
+    specs = lm_cache_specs(cache, n_model=2)
+    a = specs["blocks"]["attn"]
+    assert a["cached_k"] == P(None, DATA_AXIS, None, MODEL_AXIS)
+    assert a["k_scale"] == P(None, DATA_AXIS, None, MODEL_AXIS)
+    # slot axis rides the data axis everywhere else
+    assert a["cache_index"] == P(None, DATA_AXIS)
+    # kv_shard=False (GQA replicate): head dim drops, slots still shard
+    specs_r = lm_cache_specs(cache, n_model=2, kv_shard=False)
+    assert specs_r["blocks"]["attn"]["cached_k"] == P(None, DATA_AXIS)
+
+
+def test_lm_cache_specs_non_dividing_kv_heads_sanitize():
+    cache = {"cached_k": jnp.zeros((2, 4, 8, 3, 8))}      # kvh=3
+    specs = lm_cache_specs(cache, n_model=2)
+    assert specs["cached_k"] == P(None, DATA_AXIS)        # M dim dropped
+
+
+# -- end-to-end placement ---------------------------------------------------
+
+def test_shard_lm_params_places_on_model_axis(eight_devices):
+    lm, _ = _stacked_params(num_heads=4)
+    params = lm.init(jax.random.PRNGKey(0),
+                     jnp.zeros((1, 4), jnp.int32))["params"]
+    mesh = make_mesh(4, 2, devices=eight_devices)
+    sharded = shard_lm_params(mesh, lm, params)           # stacks flat tree
+    qspec = sharded["blocks"]["attn"]["q"]["kernel"].sharding.spec
+    assert MODEL_AXIS in qspec
+    assert sharded["embed"]["embedding"].sharding.spec == P()
+    # heads that can't split raise the typed error before any device_put
+    lm3 = TransformerLM(vocab=61, dim=30, depth=2, num_heads=3)
+    p3 = lm3.init(jax.random.PRNGKey(0),
+                  jnp.zeros((1, 4), jnp.int32))["params"]
+    with pytest.raises(MeshShapeError):
+        shard_lm_params(mesh, lm3, p3)
+
+
+# -- CNN pod-slice specs ----------------------------------------------------
+
+def test_cnn_tp_specs_wide_shard_narrow_replicate():
+    variables = {
+        "params": {
+            "stem": {"kernel": jnp.zeros((7, 7, 3, 64)),   # folded stem
+                     "bias": jnp.zeros((64,))},
+            "fc": {"kernel": jnp.zeros((256, 512)),
+                   "bias": jnp.zeros((512,))},
+            "odd": {"kernel": jnp.zeros((16, 130))},       # 130 % 4 != 0
+        },
+        "batch_stats": {"bn": {"mean": jnp.zeros((512,))}},
+    }
+    specs = cnn_tp_specs(variables, n_model=4)
+    p = specs["params"]
+    # wide dense kernel shards cout; narrow (<128) folded stem stays
+    # replicated so preprocess="auto" folding is untouched
+    assert p["fc"]["kernel"] == P(None, MODEL_AXIS)
+    assert p["stem"]["kernel"] == P()
+    assert p["odd"]["kernel"] == P()                       # non-dividing
+    assert p["fc"]["bias"] == P()                          # 1-D replicated
+    assert specs["batch_stats"]["bn"]["mean"] == P()
+
+
+# -- gauge ------------------------------------------------------------------
+
+def test_tp_collective_bytes():
+    lm = TransformerLM(vocab=61, dim=32, depth=2, num_heads=4)
+    assert tp_collective_bytes(lm, slots=4, n_model=1) == 0
+    itemsize = jnp.zeros((), lm.dtype).dtype.itemsize
+    assert tp_collective_bytes(lm, slots=4, n_model=2) == \
+        2 * 2 * 4 * 32 * itemsize

@@ -451,8 +451,14 @@ def test_two_node_cluster_collects_lm_trace(tmp_path):
         assert [s["name"] for s in local["spans"]] == ["client.lm_submit"]
 
         # metrics_export: local text, and forwarded to the peer via host=
+        # (lm_stats records the pool's TP gauges on the metrics plane, so
+        # the Prometheus text names n_model/tp_collective_bytes even for a
+        # plain n_model=1 pool)
+        _call(nodes["n0"], {"verb": "lm_stats", "name": "tlm"})
         text = _call(nodes["n0"], {"verb": "metrics_export"})["text"]
         assert 'node="n0"' in text and "span_buffer_depth" in text
+        assert 'name="n_model"' in text
+        assert 'name="tp_collective_bytes"' in text
         remote = _call(nodes["n0"], {"verb": "metrics_export",
                                      "host": "n1"})["text"]
         assert 'node="n1"' in remote

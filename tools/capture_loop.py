@@ -76,6 +76,13 @@ STEPS = [
      {"BENCH_SUITE": "lm_paged", "BENCH_TIME_BUDGET_S": "600"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_lm_paged.json"),
+    # ISSUE 9: tensor-parallel scanned decode at n_model 1 vs 2 — on the
+    # single tunnelled chip only the n_model=1 baseline lands (TP points
+    # record a skip); the paired points wait for a real pod slice
+    ("tp_suite",
+     {"BENCH_SUITE": "lm_tp", "BENCH_TIME_BUDGET_S": "600"},
+     [sys.executable, "bench.py"],
+     "BENCH_LAST_GOOD_lm_tp.json"),
     # QoS admission gateway: open-loop Poisson overload at 2x measured
     # capacity (serve/gateway.py) — goodput tokens/sec + shed rate per
     # class on chip; 0.5x underload control rides in details
@@ -192,6 +199,8 @@ FORCE_RECAPTURE = {"lm_suite", "lm_suite_refresh", "lm_slots",
                    "flash_sweep",
                    # paged_suite: new this round — never touched the chip
                    "paged_suite",
+                   # tp_suite: new this round (ISSUE 9) — never captured
+                   "tp_suite",
                    # train_suite: BENCH_LAST_GOOD_train.json provenance is
                    # two rounds stale (round-5 VERDICT) — the committed
                    # record predates the scanned-decode rework's tree

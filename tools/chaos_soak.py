@@ -31,6 +31,10 @@ def main() -> int:
     ap.add_argument("--delay", type=float, default=0.10)
     # chunked-prefill spec for schedule 0 (0 disables); see chaos.py
     ap.add_argument("--prefill-chunk", type=int, default=2)
+    # tensor-parallel spec for schedule 0 (1 disables): the managed fake
+    # pool carries n_model in its journaled lm_serve spec, so failover
+    # replays a TP pool under the same fault surface
+    ap.add_argument("--n-model", type=int, default=2)
     args = ap.parse_args()
     logging.disable(logging.WARNING)   # wal-skip warnings are expected
 
@@ -48,9 +52,11 @@ def main() -> int:
                     chaos={"drop": args.drop, "dup": args.dup,
                            "delay": args.delay, "seed": seed},
                     # first schedule runs the managed pool with chunked
-                    # prefill in its journaled spec (ISSUE 7): deferred
-                    # completions under the same fault surface
-                    prefill_chunk=args.prefill_chunk if i == 0 else 0)
+                    # prefill AND a TP shape in its journaled spec
+                    # (ISSUEs 7/9): deferred completions + replayed
+                    # n_model under the same fault surface
+                    prefill_chunk=args.prefill_chunk if i == 0 else 0,
+                    n_model=args.n_model if i == 0 else 1)
         except Exception as e:  # noqa: BLE001 - invariant trip is data
             rec = {"seed": seed, "error":
                    f"{type(e).__name__}: {e}"[:300]}
