@@ -43,10 +43,11 @@ REFERENCE_IMAGES_PER_S = 400 / 9.0   # ≈44.4, whole reference cluster
 # utils/train_bench.py).
 BENCH_SUITE = os.environ.get("BENCH_SUITE", "cnn")
 if BENCH_SUITE not in ("cnn", "lm", "lm_prefix", "lm_slots", "lm_paged",
-                       "lm_tp", "lm_gateway", "train"):
+                       "lm_tp", "lm_gateway", "lm_autoscale", "train"):
     raise SystemExit(
         f"BENCH_SUITE={BENCH_SUITE!r}: want "
-        "cnn|lm|lm_prefix|lm_slots|lm_paged|lm_tp|lm_gateway|train")
+        "cnn|lm|lm_prefix|lm_slots|lm_paged|lm_tp|lm_gateway|"
+        "lm_autoscale|train")
 # BENCH_MODEL selects the measured network: resnet18 (headline, matches the
 # reference's "resnet"), resnet50 (bottleneck — ~4x the FLOPs/image, the
 # MXU-utilisation probe), alexnet (the other half of the reference's
@@ -68,6 +69,7 @@ METRIC = {"cnn": f"{BENCH_MODEL}_imagenet_inference_throughput",
           "lm_paged": "lm_paged_decode_throughput",
           "lm_tp": "lm_tp_decode_throughput",
           "lm_gateway": "lm_gateway_goodput",
+          "lm_autoscale": "lm_autoscale_scaleout_goodput",
           "train": "lm_train_throughput"}[BENCH_SUITE]
 
 # The TPU sits behind a tunnel that is intermittently down; a successful TPU
@@ -84,6 +86,8 @@ _LAST_GOOD = os.path.join(
      else "BENCH_LAST_GOOD_lm_paged.json" if BENCH_SUITE == "lm_paged"
      else "BENCH_LAST_GOOD_lm_tp.json" if BENCH_SUITE == "lm_tp"
      else "BENCH_LAST_GOOD_lm_gateway.json" if BENCH_SUITE == "lm_gateway"
+     else "BENCH_LAST_GOOD_lm_autoscale.json"
+     if BENCH_SUITE == "lm_autoscale"
      else "BENCH_LAST_GOOD_train.json" if BENCH_SUITE == "train"
      else f"BENCH_LAST_GOOD_{BENCH_MODEL}.json"))
 # the compact LM sub-record captured during a default cnn run caches here
@@ -781,6 +785,18 @@ def run_lm_gateway_suite(devices) -> None:
                       "lm gateway measurement failed", compact=False)
 
 
+def run_lm_autoscale_suite(devices) -> None:
+    """BENCH_SUITE=lm_autoscale: what a replica spawn buys under SLO
+    breach — ramp/overload/underload Poisson regimes against one
+    gateway-fronted replica, then the overload regime against two
+    replicas behind the group's decode routing (headline: scaled-out
+    goodput tokens/sec), with the measured p95s driven through a real
+    `serve/autoscaler.py` loop so the record carries the decisions."""
+    from idunno_tpu.utils.lm_bench import run_lm_autoscale_bench
+    _run_record_suite(devices, run_lm_autoscale_bench, "overload_scaled",
+                      "lm autoscale measurement failed", compact=False)
+
+
 def run_train_suite(devices) -> None:
     """BENCH_SUITE=train: LM + CNN train-step throughput (trained
     tokens/sec; accum/fsdp/cnn points in details)."""
@@ -839,6 +855,8 @@ def main() -> None:
             run_lm_tp_suite(devices)
         elif BENCH_SUITE == "lm_gateway":
             run_lm_gateway_suite(devices)
+        elif BENCH_SUITE == "lm_autoscale":
+            run_lm_autoscale_suite(devices)
         elif BENCH_SUITE == "train":
             run_train_suite(devices)
         else:

@@ -133,8 +133,8 @@ class ChaosControl:
                                      "acting master")
                 return mgr.serve(p)
             name = p.get("name")
-            if verb in ("lm_submit", "lm_poll", "lm_stats") \
-                    and mgr.has_pool(name):
+            if verb in ("lm_submit", "lm_poll", "lm_stats", "lm_qos",
+                        "lm_autoscale") and mgr.has_pool(name):
                 if not self.membership.is_acting_master:
                     raise ValueError(f"{self.host} is not the acting "
                                      f"master; journal fenced")
@@ -144,11 +144,18 @@ class ChaosControl:
                         int(p["max_new"]),
                         seed=(int(p["seed"])
                               if p.get("seed") is not None else None),
+                        tenant=str(p.get("tenant", "default")),
                         idem_key=p.get("idem"),
                         trace=trace_from_payload(p))
                     return {"id": rid}
                 if verb == "lm_poll":
                     return mgr.poll(name)
+                if verb == "lm_qos":
+                    return mgr.qos(name)
+                if verb == "lm_autoscale":
+                    if p.get("policy"):
+                        return mgr.autoscale_set(name, dict(p["policy"]))
+                    return mgr.autoscale_get(name)
                 return {"stats": mgr.stats(name)}
         # -- node-local fake LM tier --
         if verb == "lm_serve":
@@ -210,6 +217,12 @@ class ChaosControl:
             for k in [k for k in self._lm_idem if k[0] == p["name"]]:
                 del self._lm_idem[k]
             return {"stopped": True}
+        if verb == "lm_qos":
+            # the fake tier has no gateway; the autoscaler's live-gauge
+            # reader treats a qos-less node as n=0 (never scales on it) —
+            # chaos schedules drive pressure through the injected
+            # gauges_fn instead
+            return {"qos": None}
         raise ValueError(f"unknown control verb {verb!r}")
 
 
@@ -219,12 +232,24 @@ class ChaosCluster:
     fault/workload schedule, and invariant recording."""
 
     LM_POOL = "chaos-lm"
+    LM_GROUP = "chaos-grp"
 
     def __init__(self, seed: int, data_dir: str, n_hosts: int = 5,
-                 prefill_chunk: int = 0, n_model: int = 1) -> None:
+                 prefill_chunk: int = 0, n_model: int = 1,
+                 autoscale: bool = False) -> None:
         self.seed = seed
         self.prefill_chunk = prefill_chunk
         self.n_model = n_model
+        # gate ALL group workload behind the flag: the group ops draw
+        # extra rng, which would shift every existing seed's schedule
+        self.autoscale = autoscale
+        # synthetic interactive-p95 the injected gauges_fn reports for
+        # group replicas; schedules script overload/underload through it
+        self.group_pressure = 0.0
+        self._steps_run = 0
+        # overload for the first chunk of a seeded schedule, then idle:
+        # one run crosses the scale-out threshold AND the scale-in one
+        self.overload_steps = 24
         self.rng = random.Random(seed)
         self.cfg = ClusterConfig(
             hosts=tuple(f"n{i}" for i in range(n_hosts)),
@@ -279,6 +304,15 @@ class ChaosCluster:
                 h, self.cfg, t, self.members[h], self.services[h],
                 lm_manager=mgr)
             self.services[h].wal_hook = self.failovers[h].wal_append
+            # node.py wiring: scaling decisions write ahead to the standby
+            mgr.failover = self.failovers[h]
+            # the autoscaler runs on the fake clock (dwell/drain windows
+            # are schedule-driven) and, when the group workload is on,
+            # reads scripted pressure instead of live gateway RPCs
+            mgr.autoscaler.clock = self.clock
+            if autoscale:
+                mgr.autoscaler.gauges_fn = (
+                    lambda name, _m=mgr: self._scripted_gauges(_m, name))
             self.controls[h] = ChaosControl(h, self.members[h], mgr)
             t.serve("control", self.controls[h].handle)
         # invariant recorders
@@ -295,6 +329,7 @@ class ChaosCluster:
         # and legitimately completes — but tokens from a request nobody
         # ever attempted would mean cross-wired journals
         self.lm_attempted: list[dict] = []
+        self.grp_acked: list[dict] = []      # group-routed lm submissions
         self.sdfs_acked: list[tuple[str, int, bytes]] = []
         self.lm_delivered: dict[tuple, int] = {}   # token tuple -> count
         for h in self.cfg.hosts:
@@ -310,6 +345,18 @@ class ChaosCluster:
             **({"n_model": self.n_model}
                if self.n_model > 1 else {})})
         assert out.get("node") or out.get("already"), out
+        if autoscale:
+            # a replica group under a tight policy: windows sized to the
+            # 0.3 s pump waves so one schedule crosses both thresholds
+            gout = self._client_control("n2", {
+                "verb": "lm_serve", "placement": "auto",
+                "name": self.LM_GROUP, "prompt_len": 8, "max_len": 64,
+                "slots": 4,
+                "autoscale": {"deadline_slack_s": 1.0,
+                              "scale_in_frac": 0.25,
+                              "dwell_s": 1.0, "drain_window_s": 1.0,
+                              "max_replicas": 3}})
+            assert gout.get("group") or gout.get("already"), gout
 
     # -- probes -----------------------------------------------------------
 
@@ -410,6 +457,49 @@ class ChaosCluster:
         self.lm_acked.append({"serial": s, "rid": int(out["id"]),
                               "prompt": prompt, "seed": s, "max_new": 4})
 
+    def op_lm_group(self, client: str) -> None:
+        """A group submission: routes like op_lm but lands on whichever
+        replica the group picks; the seed is pinned by the client, so
+        tokens are replica-independent and ride the same exactness
+        ledger as pool submissions."""
+        self._serial += 1
+        s = self._serial
+        prompt = [s % 251, (s * 7) % 251, (s * 13) % 251]
+        self.lm_attempted.append({"serial": s, "prompt": prompt,
+                                  "seed": s, "max_new": 4})
+        try:
+            out = self._client_control(
+                client, {"verb": "lm_submit", "name": self.LM_GROUP,
+                         "prompt": prompt, "max_new": 4, "seed": s,
+                         "tenant": f"t{s % 3}"},
+                idem=f"{client}:{s}:g")
+        except (TransportError, RuntimeError):
+            return
+        self.grp_acked.append({"serial": s, "grid": int(out["id"]),
+                               "prompt": prompt, "seed": s, "max_new": 4})
+
+    def _scripted_gauges(self, mgr: LMPoolManager, name: str) -> dict:
+        """Deterministic stand-in for `group_gauges`: scripted p95
+        pressure (one number for the whole group), real journal backlog
+        from the manager the autoscaler is ticking on."""
+        out: dict = {}
+        with mgr._lock:
+            g = mgr._groups.get(name)
+            if g is None:
+                return out
+            for r, meta in g["replicas"].items():
+                if meta["state"] != "active":
+                    continue
+                pool = mgr._pools.get(r)
+                backlog = 0
+                if pool is not None:
+                    backlog = sum(
+                        1 for q in pool["requests"].values()
+                        if q["status"] in ("pending", "inflight"))
+                out[r] = {"interactive_p95": float(self.group_pressure),
+                          "n": 8, "backlog": backlog}
+        return out
+
     def op_sdfs(self, client: str) -> None:
         self._serial += 1
         name = f"f{self._serial}"
@@ -461,12 +551,23 @@ class ChaosCluster:
     def step(self) -> None:
         """One seeded schedule step: a workload or fault op, then a pump
         wave, then fence sampling."""
+        self._steps_run += 1
+        if self.autoscale:
+            # scripted load curve: overload long enough to cross the
+            # scale-out threshold, then idle so the group scales back in
+            self.group_pressure = (5.0 if self._steps_run
+                                   <= self.overload_steps else 0.0)
         r = self.rng.random()
         client = self.rng.choice(self.cfg.hosts)
         if r < 0.22:
             self.op_cnn(client)
         elif r < 0.44:
-            self.op_lm(client)
+            # the extra draw is flag-gated: existing seeds' schedules
+            # must not shift when the group workload is off
+            if self.autoscale and self.rng.random() < 0.5:
+                self.op_lm_group(client)
+            else:
+                self.op_lm(client)
         elif r < 0.58:
             self.op_sdfs(client)
         elif r < 0.68:
@@ -556,6 +657,20 @@ class ChaosCluster:
                 for rid, r in pool["requests"].items():
                     if r["status"] in ("pending", "inflight"):
                         out.append(f"lm rid {rid} {r['status']}")
+            g = mgr._groups.get(self.LM_GROUP)
+            if g is not None:
+                replicas = list(g["replicas"])
+                placed = [r for r in replicas
+                          if (mgr._pools.get(r) or {}).get("node")]
+                if not placed:
+                    out.append("group has no placed replica")
+                for r in replicas:
+                    rpool = mgr._pools.get(r)
+                    if rpool is None:
+                        continue
+                    for rid, q in rpool["requests"].items():
+                        if q["status"] in ("pending", "inflight"):
+                            out.append(f"grp {r} rid {rid} {q['status']}")
         return out
 
     def _settled(self) -> bool:
@@ -579,21 +694,28 @@ class ChaosCluster:
         per-completion delivery counts (token tuple = logical request
         identity, since every prompt is serial-unique)."""
         got = []
+        names = [self.LM_POOL] + ([self.LM_GROUP] if self.autoscale
+                                  else [])
         for _ in range(3):
-            try:
-                out = self._client_control("n3", {"verb": "lm_poll",
-                                                  "name": self.LM_POOL})
-            except RuntimeError as e:
-                # the pool died with a doomed lineage (created but never
-                # replicated before the master was deposed): nothing to
-                # drain — its acks were lost, never wrong
-                if "pool" in str(e):
-                    return got
-                raise
-            for c in out.get("completions", ()):
-                key = tuple(c["tokens"])
-                self.lm_delivered[key] = self.lm_delivered.get(key, 0) + 1
-                got.append(c)
+            for name in list(names):
+                try:
+                    out = self._client_control("n3", {"verb": "lm_poll",
+                                                      "name": name})
+                except RuntimeError as e:
+                    # the pool died with a doomed lineage (created but
+                    # never replicated before the master was deposed):
+                    # nothing to drain — its acks were lost, never wrong
+                    if "pool" in str(e):
+                        names.remove(name)
+                        continue
+                    raise
+                for c in out.get("completions", ()):
+                    key = tuple(c["tokens"])
+                    self.lm_delivered[key] = (
+                        self.lm_delivered.get(key, 0) + 1)
+                    got.append(c)
+            if not names:
+                break
             self.pump_work()
         return got
 
@@ -659,6 +781,42 @@ class ChaosCluster:
                 continue        # doomed-lineage ack (lost, never wrong)
             assert got == blob, f"{name} v{version} corrupt"
             sdfs_survived += 1
+        # replica group: the scaling journal itself is an invariant
+        # surface — exactly-once decisions, fenced epochs, no replica
+        # double-spawned by a replayed decision (ISSUE 11)
+        grp_summary: dict = {}
+        if self.autoscale:
+            mgr = self.managers[self.final_master()]
+            with mgr._lock:
+                g = mgr._groups.get(self.LM_GROUP)
+                gview = (None if g is None
+                         else {"decisions": [dict(d)
+                                             for d in g["decisions"]],
+                               "next_seq": g["next_seq"],
+                               "replicas": {r: dict(m) for r, m
+                                            in g["replicas"].items()}})
+            assert gview is not None, "replica group lost from journal"
+            seqs = [d["seq"] for d in gview["decisions"]]
+            assert seqs == sorted(set(seqs)), \
+                f"scale decisions not strictly increasing: {seqs}"
+            assert not seqs or seqs[-1] == gview["next_seq"] - 1, \
+                f"decision journal truncated wrong: {seqs[-6:]} " \
+                f"vs next_seq {gview['next_seq']}"
+            spawned = [d["replica"] for d in gview["decisions"]
+                       if d["action"] == "spawn"]
+            assert len(spawned) == len(set(spawned)), \
+                f"replica double-spawned: {spawned}"
+            eps = [int(d["epoch"][0]) for d in gview["decisions"]]
+            assert eps == sorted(eps), \
+                f"scale-decision epochs regressed: {eps}"
+            # every replica the journal believes in must be a real
+            # {group}@r{i} name within the minted range
+            for r in gview["replicas"]:
+                idx = LMPoolManager._replica_index(r)
+                assert 0 <= idx, f"malformed replica name {r!r}"
+            grp_summary = {"grp_acked": len(self.grp_acked),
+                           "grp_replicas": len(gview["replicas"]),
+                           "grp_decisions": gview["next_seq"]}
         return {"cnn_acked": len(self.cnn_acked),
                 "cnn_survived": len(survived),
                 "lm_acked": len(self.lm_acked),
@@ -666,21 +824,26 @@ class ChaosCluster:
                 "sdfs_acked": len(self.sdfs_acked),
                 "sdfs_survived": sdfs_survived,
                 "epochs": max(self.epoch_owners, default=0),
-                "final_master": self.final_master()}
+                "final_master": self.final_master(),
+                **grp_summary}
 
 
 def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
                         chaos: dict | None = None,
                         prefill_chunk: int = 0,
-                        n_model: int = 1) -> dict:
+                        n_model: int = 1,
+                        autoscale: bool = False) -> dict:
     """One full seeded chaos run: schedule -> converge -> invariants.
     Returns the invariant summary plus convergence time.
     ``prefill_chunk`` rides the managed pool's lm_serve spec (ISSUE 7):
     the fake tier defers long-prompt completions by a poll round, so the
     schedule exercises journaled specs + watchdog retries against a pool
-    with in-flight chunked admissions."""
+    with in-flight chunked admissions. ``autoscale`` adds a replica
+    group with scripted overload→underload pressure (ISSUE 11): the
+    autoscaler's spawn/retire decisions ride the same fault schedule and
+    the group's scaling journal joins the invariant surface."""
     c = ChaosCluster(seed, data_dir, prefill_chunk=prefill_chunk,
-                     n_model=n_model)
+                     n_model=n_model, autoscale=autoscale)
     try:
         c.run_schedule(steps=steps,
                        chaos=chaos if chaos is not None

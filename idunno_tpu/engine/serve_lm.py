@@ -88,6 +88,11 @@ class Request:
     stop: list[list[int]] | None = None
     seed: int | None = None
     t_admit: float = 0.0       # monotonic stamp set at slot admission
+    # admitted before the server's FIRST decode dispatch: this request's
+    # service time funds the one-time XLA compiles (prefill bucket +
+    # decode program), not steady-state work — flagged so downstream
+    # demand signals (fair share, autoscaler) can exclude it
+    cold: bool = False
     # (trace_id, parent_span_id) from the submitting hop (utils/spans.py);
     # None = untraced. _admit re-points the parent at its prefill span so
     # decode-step spans chain under the prefill in the waterfall.
@@ -117,6 +122,12 @@ class Completion:
     # ("expired": its deadline_ms passed while queued — tokens hold the
     # prompt only); None for every request that reached a slot
     rejected: str | None = None
+    # service_s includes the pool's one-time compile window (the request
+    # was admitted before the first-ever decode dispatch). Fair-share and
+    # autoscaler demand signals skip these samples: a one-time compile is
+    # capacity planning, not per-request cost (VERDICT item 4). A
+    # `warmup()`-ed pool never produces one.
+    cold_start: bool = False
 
 
 def _set_cursors(cache: Any, cursors: jnp.ndarray) -> Any:
@@ -872,6 +883,11 @@ class DecodeServer:
                        "prefill_chunks": 0, "kv_gather_bytes_saved": 0}
         # prefix-cache counters (zero-cost when the cache is off)
         self._pc_lookups = self._pc_hits = self._pc_tokens_saved = 0
+        # flips True at the first decode dispatch and NEVER resets (the
+        # warmup() stats reset must not re-mark a warmed pool cold):
+        # requests admitted while False carry Request.cold → their
+        # completions are cold_start-tagged
+        self._dispatched_ever = False
 
         if self._draft_model is not None:
             self._decode_spec = self._build_spec_round(draft_len,
@@ -1460,7 +1476,8 @@ class DecodeServer:
                 id=req.id, tokens=[int(t) for t in row],
                 prompt_len=len(req.tokens),
                 service_s=time.monotonic() - req.t_admit,
-                cancelled=was_cancelled, logprobs=lps))
+                cancelled=was_cancelled, logprobs=lps,
+                cold_start=req.cold))
             if not was_cancelled:
                 self._stats["completed"] += 1
             self._stats["tokens_generated"] += total - len(req.tokens)
@@ -1480,6 +1497,7 @@ class DecodeServer:
             slot = free.pop(0)
             req = self._queue.popleft()
             req.t_admit = time.monotonic()
+            req.cold = not self._dispatched_ever
             # prefill span opens here (store clock, not monotonic: fake-
             # clock tests need assertable timelines); closed after insert
             t_prefill0 = (self.spans.clock()
@@ -1859,6 +1877,7 @@ class DecodeServer:
                     self._top_ks, self._keys, self._logprobs,
                     self._pres, self._freq, self._counts, *pg)
             self._stats["dispatches"] += 1
+            self._dispatched_ever = True
             if t_step0 is not None:
                 batch = len(self._live)
                 for req in self._live.values():

@@ -52,6 +52,18 @@ def shed_reason(text: str) -> str | None:
     return m.group(1) if m else None
 
 
+def is_prefill_heavy(prompt_len: int, threshold: int) -> bool:
+    """DistServe's split criterion at request-routing granularity (Zhong
+    et al., OSDI 2024): an admission whose prompt is at least
+    ``threshold`` tokens is PREFILL-heavy — its cost is dominated by the
+    compute-bound prompt pass, and interleaving it with latency-bound
+    decode traffic inflates decode queue waits. Replica groups
+    (`serve/lm_manager.py:_route_group_locked`) route these to the
+    group's `prefill_chunk`-tuned replica. ``threshold`` <= 0 disables
+    the split."""
+    return threshold > 0 and int(prompt_len) >= int(threshold)
+
+
 @dataclass(frozen=True)
 class BackpressureConfig:
     """Occupancy-driven shed thresholds.

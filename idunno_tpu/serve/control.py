@@ -19,7 +19,12 @@ remote CLI can run the shell's commands against any node:
                                (engine/serve_lm.py via serve/lm_pool.py)
   lm_qos                     — QoS gateway observability (queue depths,
                                admit/shed counters, queue-wait
-                               percentiles; serve/gateway.py)
+                               percentiles; serve/gateway.py). For a
+                               replica group, includes the group block
+                               (policy, replica roles/states, recent
+                               scaling decisions)
+  lm_autoscale               — replica-group scaling policy get/set
+                               (serve/autoscaler.py; acting master)
   train_start/train_status/train_stop
                              — background cluster training jobs
                                (engine/train_job.py; checkpoints + servable
@@ -282,7 +287,10 @@ class ControlService:
             try:
                 if old is not None:
                     old.stop()
-                model, params = load_lm(node.store, name)
+                # group replicas are named "{group}@r{i}" but load the
+                # group's stored model, carried as p["model"]
+                model, params = load_lm(node.store,
+                                        p.get("model") or name)
                 if p.get("kv_cache_dtype"):
                     # serve-time override: e.g. int8 KV residency for a
                     # model stored with a native cache (weights unchanged)
@@ -429,6 +437,7 @@ class ControlService:
             out = {"completions": [
                 {"id": c.id, "tokens": c.tokens, "prompt_len": c.prompt_len,
                  "service_s": round(c.service_s, 6),
+                 "cold_start": c.cold_start,
                  "cancelled": c.cancelled,
                  **({"rejected": c.rejected}
                     if c.rejected is not None else {}),
@@ -489,7 +498,7 @@ class ControlService:
                                  "expired", "reject_rate")},
                     **{f"{c}_wait_{q}": cls["queue_wait_s"][q]
                        for c, cls in gw["classes"].items()
-                       for q in ("p50", "p99")}})
+                       for q in ("p50", "p95", "p99")}})
             return {"stats": stats}
         if verb == "lm_stop":
             with self._reg_lock:
@@ -594,6 +603,12 @@ class ControlService:
             return {"text": node.metrics.prometheus_text(
                 node.host, extra_counters=retry_counters(),
                 extra_gauges=extra_g)}
+        if verb == "lm_autoscale":
+            # only meaningful for a manager-owned replica group (routed
+            # above); reaching here means the name isn't one
+            raise ValueError(
+                f"no replica group {p.get('name')!r}; lm_serve with "
+                "autoscale={...} (placement=auto) creates one")
         raise ValueError(f"unknown control verb {verb!r}")
 
     def _collect_trace(self, p: dict) -> dict:
@@ -670,7 +685,8 @@ class ControlService:
                     else mgr.train(p))
         name = p.get("name")
         if verb in ("lm_submit", "lm_poll", "lm_stats", "lm_stop",
-                    "lm_cancel", "lm_partial", "lm_qos") \
+                    "lm_cancel", "lm_partial", "lm_qos",
+                    "lm_autoscale") \
                 and mgr.has_pool(name):
             if not self.node.membership.is_acting_master:
                 # a deposed coordinator still holds the managed journal it
@@ -716,7 +732,23 @@ class ControlService:
             if verb == "lm_partial":
                 return mgr.partial(name)
             if verb == "lm_qos":
-                return mgr.qos(name)
+                out = mgr.qos(name)
+                grp = out.get("group")
+                if grp is not None:
+                    # autoscaler observability rides the metrics tracker
+                    # (Prometheus metrics_export + chaos snapshots)
+                    states = [m.get("state") for m
+                              in grp.get("replicas", {}).values()]
+                    self.node.metrics.record_autoscale_gauges(name, {
+                        "replicas": len(states),
+                        "draining": states.count("draining"),
+                        "decisions_total": grp.get("decisions_total", 0)})
+                return out
+            if verb == "lm_autoscale":
+                # policy get/set for a replica group (serve/autoscaler.py)
+                if p.get("policy"):
+                    return mgr.autoscale_set(name, dict(p["policy"]))
+                return mgr.autoscale_get(name)
             return mgr.stop(name)
         if verb in ("train_status", "train_stop") and mgr.has_job(name):
             return (mgr.train_status(name) if verb == "train_status"

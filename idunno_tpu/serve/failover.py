@@ -58,6 +58,11 @@ class FailoverManager:
         # queries the newest full snapshot predates, pruned as snapshots
         # catch up (wal_append / _handle / adopt)
         self._wal: dict[tuple[str, int], dict[str, Any]] = {}
+        # standby-side autoscaler scaling deltas, group → {"decision",
+        # "entry"} (entry = the group's full wire state at decision
+        # time, newest kept); applied on adopt for scaling actions the
+        # newest snapshot predates (wal_scale / _handle / adopt)
+        self._scale_wal: dict[str, dict[str, Any]] = {}
         transport.serve(SERVICE, self._handle)
         # front: the adoption (epoch mint) must land BEFORE reassignment
         # callbacks start re-dispatching, so nothing dispatches under the
@@ -150,6 +155,42 @@ class FailoverManager:
             return False
         return out is not None
 
+    def wal_scale(self, group: str, decision: dict[str, Any],
+                  entry: dict[str, Any]) -> bool:
+        """Synchronous write-ahead for an autoscaler scaling decision
+        (serve/lm_manager.py:_replicate_scale): a spawn/retire/rebalance
+        the acting master just journaled must survive an immediate
+        coordinator death, not just one after the next periodic tick —
+        otherwise the new master would re-derive scaling state from
+        gauges instead of REPLAYING it (the chaos exact-replay
+        invariant). Ships the group's full wire entry (small: routing
+        maps + a bounded decision log — replica request journals ride
+        the pool snapshot as usual). Same skip discipline as
+        wal_append: a dead standby must not stall the control loop, but
+        the skip is counted, never silent."""
+        standby = self.config.standby_coordinator
+        if standby == self.host or not self.membership.is_acting_master:
+            return False
+        if standby not in self.membership.members.alive_hosts():
+            self.wal_skips += 1
+            self.service.metrics.record_counter("wal_skipped_standby_down")
+            log.warning("wal_scale skipped for group %s seq %s: standby "
+                        "%s not alive", group, decision.get("seq"),
+                        standby)
+            return False
+        msg = Message(MessageType.METADATA, self.host,
+                      {"epoch": list(self.membership.epoch.view()),
+                       "scale_wal": {"group": str(group),
+                                     "decision": dict(decision),
+                                     "entry": dict(entry)}})
+        try:
+            out = self.transport.call(standby, SERVICE, msg, timeout=2.0)
+        except TransportError:
+            return False
+        if reply_is_stale(self.membership.epoch, out):
+            return False
+        return out is not None
+
     # -- standby side ------------------------------------------------------
 
     def _handle(self, service: str, msg: Message) -> Message | None:
@@ -166,6 +207,14 @@ class FailoverManager:
                 d = msg.payload["wal"]
                 self._wal[(d["model"], int(d["qnum"]))] = d
                 return Message(MessageType.ACK, self.host)
+            if "scale_wal" in msg.payload:  # autoscaler decision delta
+                d = msg.payload["scale_wal"]
+                cur = self._scale_wal.get(d["group"])
+                if (cur is None
+                        or int(cur["decision"].get("seq", -1))
+                        <= int(d["decision"].get("seq", -1))):
+                    self._scale_wal[d["group"]] = d
+                return Message(MessageType.ACK, self.host)
             seq = int(msg.payload.get("seq", 0))
             if seq > self._received_seq:
                 self._received = msg.payload
@@ -175,6 +224,11 @@ class FailoverManager:
                         for t in msg.payload.get("tasks", [])}
                 self._wal = {k: v for k, v in self._wal.items()
                              if k not in have}
+                groups = (msg.payload.get("lm") or {}).get("groups", {})
+                self._scale_wal = {
+                    g: v for g, v in self._scale_wal.items()
+                    if int((groups.get(g) or {}).get("next_seq", -1))
+                    < int(v["decision"].get("seq", -1)) + 1}
         return Message(MessageType.ACK, self.host)
 
     def _on_member_change(self, host: str, old: MemberStatus | None,
@@ -199,6 +253,7 @@ class FailoverManager:
                 return          # already own the current epoch
             snap = self._received
             wal = dict(self._wal)
+            scale_wal = {g: dict(d) for g, d in self._scale_wal.items()}
         # the snapshot carries the deposed master's epoch: fold it into
         # the high-water mark FIRST so the mint lands strictly above
         # everything that master ever stamped
@@ -249,10 +304,19 @@ class FailoverManager:
                 # master must dedupe, not double-book
                 svc.record_idem(d["idem"], int(q))
         self.resume_in_flight()
-        if self.lm_manager is not None and snap is not None \
-                and "lm" in snap:
-            self.lm_manager.load_wire(snap["lm"])
-            self.lm_manager.on_adopt()
+        if self.lm_manager is not None:
+            loaded = False
+            if snap is not None and "lm" in snap:
+                self.lm_manager.load_wire(snap["lm"])
+                loaded = True
+            if scale_wal:
+                # scaling decisions WAL'd after the newest snapshot:
+                # replay them exactly (group wire entries are
+                # authoritative where their decision log is longer)
+                self.lm_manager.apply_scale_wal(scale_wal)
+                loaded = True
+            if loaded:
+                self.lm_manager.on_adopt()
         if asp is not None:
             svc.spans.finish(
                 asp, resumed=len(svc.scheduler.book.in_flight()))

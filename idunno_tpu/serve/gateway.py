@@ -317,7 +317,11 @@ class AdmissionGateway:
                     "shed": dict(cls.shed),
                     "reject_rate": ((shed_n + cls.expired) / submitted
                                     if submitted else 0.0),
+                    # p95 is the autoscaler's Clockwork-style SLO signal
+                    # (serve/autoscaler.py): scale-out triggers when
+                    # interactive p95 crosses the deadline slack
                     "queue_wait_s": {"p50": _percentile(waits, 50),
+                                     "p95": _percentile(waits, 95),
                                      "p99": _percentile(waits, 99),
                                      "n": len(waits)},
                 }

@@ -396,7 +396,8 @@ class LMServingLoop:
                             id=rid,
                             tokens=c.tokens, prompt_len=c.prompt_len,
                             service_s=c.service_s, cancelled=c.cancelled,
-                            logprobs=c.logprobs))
+                            logprobs=c.logprobs,
+                            cold_start=c.cold_start))
                         self._trace_done(
                             rid,
                             "lm.cancel" if c.cancelled else "lm.finish",

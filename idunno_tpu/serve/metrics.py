@@ -64,6 +64,9 @@ class MetricsTracker:
         # reject rate, queue-wait p50/p99 — serve/gateway.py); the gateway
         # keeps its own windows, these are the flattened readback
         self._gw_gauges: dict[str, dict] = {}
+        # last-seen autoscaler gauges per replica group (replica count,
+        # draining count, decisions_total — serve/autoscaler.py)
+        self._as_gauges: dict[str, dict] = {}
         # named event counters (wal_skipped_standby_down, stale-epoch
         # rejections, …) — node-LOCAL observability, deliberately not
         # replicated in to_wire/load_wire: a counter describes what THIS
@@ -108,6 +111,12 @@ class MetricsTracker:
         contract as `record_lm_gauges`; read back via `gateway_gauges`)."""
         with self._lock:
             self._gw_gauges[pool] = dict(gauges)
+
+    def record_autoscale_gauges(self, group: str, gauges: dict) -> None:
+        """Latest autoscaler gauges for replica ``group`` (same
+        overwrite-per-read contract; read back via `autoscale_gauges`)."""
+        with self._lock:
+            self._as_gauges[group] = dict(gauges)
 
     # -- reading ----------------------------------------------------------
 
@@ -179,6 +188,11 @@ class MetricsTracker:
             g = self._gw_gauges.get(pool)
             return dict(g) if g is not None else None
 
+    def autoscale_gauges(self, group: str) -> dict | None:
+        with self._lock:
+            g = self._as_gauges.get(group)
+            return dict(g) if g is not None else None
+
     def avg_query_time(self, model: str) -> float:
         """Feed for the fair scheduler (`model_average_inference_time`,
         `:504-506`). 0.0 = no history yet."""
@@ -213,6 +227,7 @@ class MetricsTracker:
                             | set(self._finished_queries))
             lm_gauges = {p: dict(g) for p, g in self._lm_gauges.items()}
             gw_gauges = {p: dict(g) for p, g in self._gw_gauges.items()}
+            as_gauges = {p: dict(g) for p, g in self._as_gauges.items()}
         for name, v in sorted({**counters,
                                **(extra_counters or {})}.items()):
             emit("idunno_events_total", "counter", v, name=name)
@@ -237,6 +252,11 @@ class MetricsTracker:
                 if isinstance(v, (int, float)):
                     emit("idunno_gateway_gauge", "gauge", v,
                          pool=pool, name=k)
+        for group, g in sorted(as_gauges.items()):
+            for k, v in sorted(g.items()):
+                if isinstance(v, (int, float)):
+                    emit("idunno_autoscale_gauge", "gauge", v,
+                         group=group, name=k)
         for name, v in sorted((extra_gauges or {}).items()):
             emit("idunno_gauge", "gauge", v, name=name)
         return "\n".join(lines) + "\n"
@@ -254,7 +274,9 @@ class MetricsTracker:
                     "lm_gauges": {m: dict(g) for m, g
                                   in self._lm_gauges.items()},
                     "gw_gauges": {m: dict(g) for m, g
-                                  in self._gw_gauges.items()}}
+                                  in self._gw_gauges.items()},
+                    "as_gauges": {m: dict(g) for m, g
+                                  in self._as_gauges.items()}}
 
     def load_wire(self, d: dict) -> None:
         with self._lock:
@@ -270,3 +292,5 @@ class MetricsTracker:
                                in d.get("lm_gauges", {}).items()}
             self._gw_gauges = {m: dict(g) for m, g
                                in d.get("gw_gauges", {}).items()}
+            self._as_gauges = {m: dict(g) for m, g
+                               in d.get("as_gauges", {}).items()}

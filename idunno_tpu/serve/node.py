@@ -66,6 +66,10 @@ class Node:
         # submit-path write-ahead: an acked query survives an immediate
         # coordinator death (see InferenceService._master_submit)
         self.inference.wal_hook = self.failover.wal_append
+        # scaling-decision write-ahead: an autoscaler action the master
+        # just journaled survives an immediate coordinator death too
+        # (serve/lm_manager.py:_replicate_scale → wal_scale)
+        self.lm_manager.failover = self.failover
         self.grep = LogGrepService(host, config, transport, self.membership,
                                    log_dir or data_dir)
         self.control = ControlService(self)

@@ -1193,3 +1193,99 @@ def run_lm_gateway_bench(platform: str, device_kind: str, n_devices: int,
         out["overload"]["mfu"] = round(
             out["overload"]["tokens_per_s"] * 2.0 * n_params / peak_bf16, 4)
     return out
+
+
+def run_lm_autoscale_bench(platform: str, device_kind: str,
+                           n_devices: int, peak_bf16: float | None, *,
+                           deadline: float, compact: bool = False) -> dict:
+    """BENCH_SUITE=lm_autoscale: what a replica spawn buys under SLO
+    breach (`serve/autoscaler.py` + replica pool groups).
+
+    `tools/autoscale_load.py` offers ramp (0.8x measured capacity) /
+    overload (2x) / underload (0.3x) Poisson regimes to one
+    gateway-fronted replica, then re-runs the overload regime against
+    TWO replicas behind the group's round-robin decode routing — the
+    headline (``overload_scaled``: goodput tokens/sec in the scaled-out
+    configuration, captured into BENCH_LAST_GOOD_lm_autoscale.json by
+    the capture loop's ``autoscale_suite`` step) against the 1-replica
+    breach record. The measured per-regime interactive queue-wait p95s
+    then drive a REAL `Autoscaler` tick-by-tick (manager stubbed), so
+    ``autoscale.decisions`` shows the closed loop spawning at overload
+    and draining/retiring at underload on this exact hardware."""
+    from idunno_tpu.engine.serve_lm import DecodeServer
+    from idunno_tpu.models.transformer import TransformerLM
+    from idunno_tpu.serve.gateway import AdmissionGateway
+    from idunno_tpu.serve.lm_pool import LMServingLoop
+
+    try:
+        from tools.autoscale_load import run_phases, summarize
+    except ImportError:  # bench invoked from outside the repo root
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from tools.autoscale_load import run_phases, summarize
+
+    cfg = lm_bench_config(platform)
+    tpu = platform == "tpu"
+    n_requests = _env_int("BENCH_LM_AS_REQUESTS", 48 if tpu else 24)
+    out: dict = {"config": {k: v for k, v in cfg.items()},
+                 "platform": platform, "device_kind": device_kind,
+                 "n_devices": n_devices, "n_requests": n_requests}
+    dt = jnp.bfloat16
+    model = TransformerLM(vocab=cfg["vocab"], dim=cfg["dim"],
+                          depth=cfg["depth"], num_heads=cfg["heads"],
+                          causal=True, dtype=dt, param_dtype=dt)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    n_params, _ = _count_params(params)
+    out["n_params"] = n_params
+
+    max_new = min(cfg["decode_steps"] + 1,
+                  cfg["max_len"] - cfg["prompt_len"])
+    rng = np.random.default_rng(13)
+
+    def prompt() -> list[int]:
+        return [int(t) for t in
+                rng.integers(1, cfg["vocab"], size=cfg["prompt_len"])]
+
+    # every group replica fronts its own gateway — same tightened slacks
+    # as the gateway suite so bench-sized bursts register as queue wait
+    gw_spec = {"max_queue": 4 * cfg["slots"],
+               "batch_wait_slack": 1.0, "interactive_wait_slack": 3.0}
+
+    def make_loop() -> LMServingLoop:
+        srv = DecodeServer(model, params, slots=cfg["slots"],
+                           prompt_len=cfg["prompt_len"],
+                           max_len=cfg["max_len"],
+                           decode_steps=cfg["decode_steps"])
+        srv.warmup()
+        return LMServingLoop(srv, name="autoscale-bench",
+                             gateway=AdmissionGateway(dict(gw_spec)))
+
+    # -- capacity: closed-loop drain on one replica sizes the offers ------
+    srv = DecodeServer(model, params, slots=cfg["slots"],
+                       prompt_len=cfg["prompt_len"], max_len=cfg["max_len"],
+                       decode_steps=cfg["decode_steps"])
+    srv.warmup()
+    n_cap = 3 * cfg["slots"]
+    t0 = time.perf_counter()
+    for _ in range(n_cap):
+        srv.submit(prompt(), max_new=max_new)
+    srv.run_until_drained()
+    cap_s = time.perf_counter() - t0
+    capacity_rps = n_cap / cap_s
+    out["capacity"] = {"requests": n_cap, "drain_s": round(cap_s, 3),
+                       "requests_per_s": round(capacity_rps, 2)}
+
+    phases = run_phases(make_loop, capacity_rps, n_requests=n_requests,
+                        prompt_fn=prompt, max_new=max_new, seed=13,
+                        deadline=deadline)
+    out.update(phases)
+    out["autoscale"] = summarize(phases)
+    scaled = out.get("overload_scaled")
+    if peak_bf16 and scaled and scaled.get("tokens_per_s"):
+        scaled["mfu"] = round(
+            scaled["tokens_per_s"] * 2.0 * n_params / peak_bf16, 4)
+    return out

@@ -1,0 +1,395 @@
+"""Gauge-driven autoscaler + replica pool groups, unit-level (ISSUE 11).
+
+Everything here runs on an injected fake clock and scripted gauges —
+the same determinism contract the chaos harness uses — so threshold
+crossings, dwell bounds and drain windows are schedule-driven, never
+wall-clock races. The manager talks to a FakeTransport that answers
+like healthy nodes (the `tests/test_lm_manager_resize.py` idiom).
+"""
+from types import SimpleNamespace
+
+import pytest
+
+from idunno_tpu.comm.message import Message
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.epoch import EpochFence
+from idunno_tpu.scheduler.fair import FairScheduler
+from idunno_tpu.serve.admission import is_prefill_heavy
+from idunno_tpu.serve.autoscaler import AutoscalePolicy
+from idunno_tpu.serve.lm_manager import LMPoolManager
+from idunno_tpu.utils.types import MessageType
+
+HOSTS = ("n0", "n1", "n2")
+
+
+class FakeTransport:
+    """Records every control RPC; answers like a healthy node."""
+
+    def __init__(self):
+        self.calls = []          # (node, payload) in order
+        self._next_sub = 0
+
+    def call(self, node, component, msg, timeout=30.0):
+        p = dict(msg.payload)
+        self.calls.append((node, p))
+        verb = p.get("verb")
+        if verb == "lm_serve":
+            return Message(MessageType.ACK, node, {"slots": p.get("slots")})
+        if verb == "lm_submit":
+            self._next_sub += 1
+            return Message(MessageType.ACK, node, {"id": self._next_sub})
+        if verb == "lm_stats":
+            return Message(MessageType.ACK, node, {"stats": {}})
+        if verb == "lm_qos":
+            return Message(MessageType.ACK, node, {"qos": {"classes": {
+                "interactive": {"queue_wait_s": {"p95": 0.2, "n": 6}}}}})
+        return Message(MessageType.ACK, node, {"completions": []})
+
+    def serves(self):
+        return [(n, p) for n, p in self.calls
+                if p.get("verb") == "lm_serve"]
+
+
+class FakeMembership:
+    def __init__(self, hosts=HOSTS):
+        self.is_acting_master = True
+        self.members = SimpleNamespace(alive_hosts=lambda: list(hosts))
+        self.epoch = EpochFence()
+        self._hosts = hosts
+
+    def on_change(self, cb):
+        pass
+
+    def acting_master(self):
+        return self._hosts[0]
+
+
+def make_mgr(autoscale=None, clock_start=0.0):
+    cfg = ClusterConfig(hosts=HOSTS, coordinator="n0",
+                        standby_coordinator="n1", introducer="n0")
+    service = SimpleNamespace(scheduler=FairScheduler(cfg))
+    transport = FakeTransport()
+    m = LMPoolManager("n0", cfg, transport, FakeMembership(),
+                      inference_service=service)
+    clk = [clock_start]
+    m.autoscaler.clock = lambda: clk[0]
+    if autoscale is not None:
+        m.serve({"name": "grp", "slots": 4, "prompt_len": 8,
+                 "max_len": 32, "autoscale": autoscale})
+    return m, transport, clk
+
+
+def scripted(mgr, p95, n=8, backlog=0):
+    """Install a gauges_fn reporting one flat pressure number for every
+    active replica (the chaos harness's shape)."""
+    def fn(name):
+        with mgr._lock:
+            g = mgr._groups[name]
+            return {r: {"interactive_p95": p95, "n": n, "backlog": backlog}
+                    for r, meta in g["replicas"].items()
+                    if meta["state"] == "active"}
+    mgr.autoscaler.gauges_fn = fn
+
+
+# -- policy ---------------------------------------------------------------
+
+def test_policy_defaults_come_from_config():
+    cfg = ClusterConfig(hosts=HOSTS, coordinator="n0",
+                        standby_coordinator="n1", introducer="n0")
+    p = AutoscalePolicy.from_config(cfg)
+    assert p.deadline_slack_s == cfg.autoscale_deadline_slack_s
+    assert p.max_replicas == cfg.autoscale_max_replicas
+    assert p.dwell_s == cfg.autoscale_dwell_s
+
+
+def test_policy_validation_and_wire_roundtrip():
+    with pytest.raises(ValueError, match="deadline_slack_s"):
+        AutoscalePolicy(deadline_slack_s=0.0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="unknown policy keys"):
+        AutoscalePolicy().merged({"nope": 1})
+    p = AutoscalePolicy(dwell_s=3.0, prefill_len_threshold=12)
+    assert AutoscalePolicy.from_wire(p.to_wire()) == p
+    # from_wire drops foreign keys (older/newer snapshots interop)
+    assert AutoscalePolicy.from_wire({**p.to_wire(), "future": 1}) == p
+
+
+def test_policy_verb_roundtrip_journals_without_dwell():
+    m, _, clk = make_mgr({"dwell_s": 5.0})
+    g = m._groups["grp"]
+    anchor = g["t_last_decision"]
+    out = m.autoscale_set("grp", {"max_replicas": 2})
+    assert out["policy"]["max_replicas"] == 2
+    assert m.autoscale_get("grp")["policy"]["max_replicas"] == 2
+    # a policy update is journaled but does NOT burn the dwell window
+    assert g["decisions"][-1]["action"] == "policy"
+    assert g["t_last_decision"] == anchor
+    with pytest.raises(ValueError, match="no replica group"):
+        m.autoscale_get("nope")
+
+
+# -- scale-out ------------------------------------------------------------
+
+def test_slo_breach_scales_out_deterministically():
+    m, transport, clk = make_mgr(
+        {"deadline_slack_s": 1.0, "dwell_s": 5.0, "max_replicas": 3})
+    scripted(m, p95=4.0, backlog=6)
+    clk[0] = 100.0
+    out = m.autoscaler.tick()
+    assert [d["action"] for d in out] == ["spawn"]
+    assert out[0]["replica"] == "grp@r1"
+    assert out[0]["p95"] == 4.0
+    # the spawn placed a REAL pool through the ordinary serve path
+    assert any(p.get("name") == "grp@r1" for _, p in transport.serves())
+    # identical state + clock → identical decision stream (determinism)
+    m2, _, clk2 = make_mgr(
+        {"deadline_slack_s": 1.0, "dwell_s": 5.0, "max_replicas": 3})
+    scripted(m2, p95=4.0, backlog=6)
+    clk2[0] = 100.0
+    out2 = m2.autoscaler.tick()
+    assert [(d["action"], d["replica"]) for d in out2] \
+        == [(d["action"], d["replica"]) for d in out]
+
+
+def test_scale_out_capped_at_max_replicas():
+    m, _, clk = make_mgr({"deadline_slack_s": 1.0, "dwell_s": 1.0,
+                          "max_replicas": 2})
+    scripted(m, p95=9.0, backlog=9)
+    for t in (10.0, 20.0, 30.0):
+        clk[0] = t
+        m.autoscaler.tick()
+    g = m._groups["grp"]
+    active = [r for r, meta in g["replicas"].items()
+              if meta["state"] == "active"]
+    assert len(active) == 2      # never past the cap, however hot
+
+
+def test_dwell_bounds_one_decision_per_window():
+    m, _, clk = make_mgr({"deadline_slack_s": 1.0, "dwell_s": 10.0,
+                          "max_replicas": 4})
+    scripted(m, p95=5.0, backlog=5)
+    clk[0] = 50.0
+    assert len(m.autoscaler.tick()) == 1
+    clk[0] = 55.0                # inside the window: nothing
+    assert m.autoscaler.tick() == []
+    clk[0] = 61.0                # outside: next decision lands
+    assert len(m.autoscaler.tick()) == 1
+
+
+def test_prefill_heavy_traffic_spawns_prefill_replica():
+    m, transport, clk = make_mgr(
+        {"deadline_slack_s": 1.0, "dwell_s": 1.0, "max_replicas": 3,
+         "prefill_len_threshold": 10, "prefill_chunk": 4,
+         "prefill_share": 0.5})
+    # route admissions: 2 long prompts, 1 short → prefill share 2/3
+    for prompt in ([0] * 12, [0] * 16, [1, 2]):
+        m.submit("grp", prompt, max_new=2)
+    scripted(m, p95=3.0, backlog=3)
+    clk[0] = 100.0
+    out = m.autoscaler.tick()
+    assert out[0]["action"] == "spawn" and out[0]["role"] == "prefill"
+    g = m._groups["grp"]
+    pre = [r for r, meta in g["replicas"].items()
+           if meta["role"] == "prefill"][0]
+    # the prefill replica's pool was served with chunked prefill tuned on
+    spec = [p for _, p in transport.serves() if p.get("name") == pre][0]
+    assert spec["prefill_chunk"] == 4
+    # long prompts now route to it; short ones stay on decode
+    grid = m.submit("grp", [0] * 20, max_new=2)
+    assert g["rid_map"][grid][0] == pre
+    grid2 = m.submit("grp", [1], max_new=2)
+    assert g["rid_map"][grid2][0] != pre
+    assert is_prefill_heavy(20, 10) and not is_prefill_heavy(1, 10)
+
+
+# -- scale-in -------------------------------------------------------------
+
+def test_underload_drains_then_retires_with_zero_loss():
+    m, transport, clk = make_mgr(
+        {"deadline_slack_s": 1.0, "scale_in_frac": 0.25, "dwell_s": 1.0,
+         "drain_window_s": 5.0, "max_replicas": 3})
+    scripted(m, p95=5.0, backlog=5)
+    clk[0] = 10.0
+    m.autoscaler.tick()          # scale out to 2
+    g = m._groups["grp"]
+    # an admitted request lands on the new replica and is NOT delivered
+    grid = m.submit("grp", [1, 2, 3], max_new=2, tenant="acme")
+    rname, rid, _ = g["rid_map"][grid]
+    scripted(m, p95=0.0, backlog=0)
+    clk[0] = 20.0
+    out = m.autoscaler.tick()
+    assert [d["action"] for d in out] == ["retire_start"]
+    victim = out[0]["replica"]
+    assert g["replicas"][victim]["state"] == "draining"
+    # draining ≠ gone: the journal still owes the client this request
+    clk[0] = 40.0                # far past the drain window
+    if rname == victim:
+        assert m.autoscaler.tick() == []   # undelivered entry blocks it
+        m._pools[victim]["requests"][rid]["delivered"] = True
+    out = m.autoscaler.tick()
+    assert [d["action"] for d in out] == ["retire"]
+    assert victim not in g["replicas"]
+    # the replica's node got an lm_stop (no leaked decode loop)
+    stops = [p.get("name") for _, p in transport.calls
+             if p.get("verb") == "lm_stop"]
+    assert victim in stops
+
+
+def test_never_drains_the_last_replica():
+    m, _, clk = make_mgr({"deadline_slack_s": 1.0, "dwell_s": 1.0,
+                          "min_replicas": 1})
+    scripted(m, p95=0.0, backlog=0)
+    clk[0] = 100.0
+    assert m.autoscaler.tick() == []
+    assert m.group_retire_start("grp") is None
+    assert list(m._groups["grp"]["replicas"]) == ["grp@r0"]
+
+
+# -- rebalance ------------------------------------------------------------
+
+def test_wfq_debt_math_and_rebalance_moves_heaviest_tenant():
+    m, _, clk = make_mgr(
+        {"deadline_slack_s": 1.0, "dwell_s": 1.0, "max_replicas": 3,
+         "rebalance_debt": 1.5})
+    g = m._groups["grp"]
+    # a second decode replica, both active
+    m.group_spawn("grp")
+    r0, r1 = sorted(g["replicas"])
+    # weights from the group spec's gateway block: acme carries weight 4
+    g["spec"]["gateway"] = {"tenants": {"acme": {"weight": 4.0}},
+                            "default": {"weight": 1.0}}
+    # pin both tenants to r0 BEFORE submitting — routing is tenant-
+    # sticky, so all the journaled work piles up on one replica
+    with m._lock:
+        g["tenants"] = {"acme": r0, "slow": r0}
+    for _ in range(2):
+        m.submit("grp", [1], max_new=2, tenant="acme")
+    for _ in range(3):
+        m.submit("grp", [2], max_new=2, tenant="slow")
+    assert all(ent[0] == r0 for ent in g["rid_map"].values())
+    with m._lock:
+        debts = m._group_debts_locked(g, [r0, r1])
+    # debt = Σ 1/weight over pending+inflight: acme 2·(1/4), slow 3·1
+    assert debts[r0] == pytest.approx(2 / 4.0 + 3.0)
+    assert debts[r1] == 0.0
+    d = m.group_rebalance("grp")
+    assert d is not None and d["action"] == "rebalance"
+    # the HEAVIEST debt tenant moved (slow: 3.0 > acme: 0.5)
+    assert d["tenant"] == "slow" and d["src"] == r0 and d["dst"] == r1
+    assert d["debt_gap"] == pytest.approx(3.5)
+    assert g["tenants"]["slow"] == r1
+    # slow's NEW submissions follow the pin; outstanding work stayed put
+    grid = m.submit("grp", [3], max_new=2, tenant="slow")
+    assert g["rid_map"][grid][0] == r1
+
+
+def test_rebalance_requires_debt_gap():
+    m, _, _ = make_mgr({"rebalance_debt": 100.0})
+    m.group_spawn("grp")
+    assert m.group_rebalance("grp") is None   # gap can't exceed 100
+
+
+# -- failover surfaces ----------------------------------------------------
+
+def test_group_wire_roundtrip_and_scale_wal_replay():
+    m, transport, clk = make_mgr({"max_replicas": 3, "dwell_s": 1.0})
+    scripted(m, p95=5.0, backlog=5)
+    clk[0] = 10.0
+    m.autoscaler.tick()
+    grid = m.submit("grp", [1, 2, 3], max_new=2, idem_key="k1")
+    g = m._groups["grp"]
+
+    cfg = m.config
+    m2 = LMPoolManager("n1", cfg, transport, FakeMembership(),
+                       inference_service=SimpleNamespace(
+                           scheduler=FairScheduler(cfg)))
+    m2.load_wire(m.to_wire())
+    g2 = m2._groups["grp"]
+    assert g2["next_seq"] == g["next_seq"]
+    assert set(g2["replicas"]) == set(g["replicas"])
+    assert g2["idem"] == {"k1": grid}
+    assert all(isinstance(k, int) for k in g2["rid_map"])
+    # a replayed idempotent submit answers the SAME group id
+    assert m2.submit("grp", [1, 2, 3], max_new=2, idem_key="k1") == grid
+
+    # scale-WAL delta newer than the snapshot replaces the group entry
+    with m._lock:
+        entry = m._group_wire_locked(g)
+    entry = dict(entry, next_seq=entry["next_seq"] + 3)
+    m2.apply_scale_wal({"grp": {"group": "grp",
+                                "decision": {"seq": entry["next_seq"] - 1},
+                                "entry": entry}})
+    assert m2._groups["grp"]["next_seq"] == g["next_seq"] + 3
+    # an OLDER delta never regresses the journal
+    with m._lock:
+        stale = m._group_wire_locked(g)
+    m2.apply_scale_wal({"grp": {"group": "grp", "decision": {"seq": 0},
+                                "entry": stale}})
+    assert m2._groups["grp"]["next_seq"] == g["next_seq"] + 3
+
+
+def test_ensure_group_replicas_repairs_adopted_state():
+    m, transport, clk = make_mgr({"max_replicas": 3, "dwell_s": 1.0})
+    scripted(m, p95=5.0, backlog=5)
+    clk[0] = 10.0
+    m.autoscaler.tick()
+    g = m._groups["grp"]
+    assert len(g["replicas"]) == 2
+    # simulate adoption from a snapshot that predates the pools: the
+    # journal knows the replicas, the pool table doesn't
+    with m._lock:
+        m._pools.pop("grp@r1")
+        g["replicas"]["grp@r1"]["state"] = "active"
+    n_serves = len(transport.serves())
+    m._ensure_group_replicas()
+    assert "grp@r1" in m._pools           # re-served from the spec
+    assert len(transport.serves()) == n_serves + 1
+    # a DRAINING replica with no pool has nothing left to drain: retired
+    with m._lock:
+        m._pools.pop("grp@r1")
+        g["replicas"]["grp@r1"]["state"] = "draining"
+    m._ensure_group_replicas()
+    assert "grp@r1" not in g["replicas"]
+    assert g["decisions"][-1]["action"] == "retire"
+
+
+def test_group_decisions_are_epoch_stamped():
+    m, _, clk = make_mgr({"max_replicas": 3, "dwell_s": 1.0})
+    scripted(m, p95=5.0, backlog=5)
+    clk[0] = 10.0
+    m.autoscaler.tick()
+    g = m._groups["grp"]
+    for d in g["decisions"]:
+        assert d["epoch"] == [0, None]    # the bootstrap fence view
+        assert d["seq"] >= 0 and "t" in d
+
+
+# -- group client surface -------------------------------------------------
+
+def test_group_submit_poll_cancel_roundtrip():
+    m, transport, clk = make_mgr({"max_replicas": 2})
+    grid = m.submit("grp", [5, 6, 7], max_new=2, idem_key="c1")
+    g = m._groups["grp"]
+    rname, rid, _ = g["rid_map"][grid]
+    # a completion surfacing on the replica comes back under the GRID
+    with m._lock:
+        pool = m._pools[rname]
+        req = pool["requests"][rid]
+        req.update(status="done", tokens=[5, 6, 7, 9, 9],
+                   prompt_len=3, node_id=rid)
+    out = m.poll("grp")
+    assert [c["id"] for c in out["completions"]] == [grid]
+    # unmapped / pruned ids answer cancelled=False, not an error
+    assert m.cancel("grp", 10 ** 6) == {"cancelled": False}
+    # stats and qos carry the group shape
+    st = m.stats("grp")
+    assert st["group"] and rname in st["replicas"]
+    q = m.qos("grp")
+    assert "policy" in q["group"] and rname in q["replicas"]
+    # stop tears down every replica and forgets the group
+    s = m.stop("grp")
+    assert s["stopped"] and not m.has_pool("grp")
+    stops = [p.get("name") for _, p in transport.calls
+             if p.get("verb") == "lm_stop"]
+    assert rname in stops

@@ -90,6 +90,14 @@ STEPS = [
      {"BENCH_SUITE": "lm_gateway", "BENCH_TIME_BUDGET_S": "600"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_lm_gateway.json"),
+    # ISSUE 11: what a replica spawn buys under SLO breach — overload at
+    # 2x capacity against one vs two gateway-fronted replicas behind the
+    # group's decode routing, measured p95s driven through the real
+    # autoscaler so the record carries the spawn/retire decisions
+    ("autoscale_suite",
+     {"BENCH_SUITE": "lm_autoscale", "BENCH_TIME_BUDGET_S": "600"},
+     [sys.executable, "bench.py"],
+     "BENCH_LAST_GOOD_lm_autoscale.json"),
     # ISSUE 6: one traced request through a real pool on chip — the
     # admit→queue_wait→prefill→decode_step waterfall with TPU latencies
     # (tools/trace_export.py --capture; cheap: tiny model, one request)

@@ -35,6 +35,10 @@ def main() -> int:
     # pool carries n_model in its journaled lm_serve spec, so failover
     # replays a TP pool under the same fault surface
     ap.add_argument("--n-model", type=int, default=2)
+    # replica-group autoscaler for schedule 1 (0 disables): scripted
+    # overload→underload pressure makes the loop spawn AND retire under
+    # the fault surface; the scaling journal joins the invariant checks
+    ap.add_argument("--autoscale", type=int, default=1)
     args = ap.parse_args()
     logging.disable(logging.WARNING)   # wal-skip warnings are expected
 
@@ -56,7 +60,11 @@ def main() -> int:
                     # (ISSUEs 7/9): deferred completions + replayed
                     # n_model under the same fault surface
                     prefill_chunk=args.prefill_chunk if i == 0 else 0,
-                    n_model=args.n_model if i == 0 else 1)
+                    n_model=args.n_model if i == 0 else 1,
+                    # second schedule runs the autoscaled replica group
+                    # (ISSUE 11) — separate from schedule 0 so each
+                    # feature's faults replay in isolation by seed
+                    autoscale=bool(args.autoscale) and i == 1)
         except Exception as e:  # noqa: BLE001 - invariant trip is data
             rec = {"seed": seed, "error":
                    f"{type(e).__name__}: {e}"[:300]}

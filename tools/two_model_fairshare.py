@@ -124,7 +124,10 @@ def main() -> int:
         # inflated per-query times must NOT feed the fair-share signal
         # (the reference's 7/3 worked example is a steady-state split, and
         # a compile-polluted avg buries it). Reset every node's timing
-        # window so the arbitration view below sees only steady queries.
+        # window so the arbitration view below sees only steady queries —
+        # the CNN-side analogue of the LM tier's structural exclusion
+        # (Completion.cold_start, serve/lm_manager.py:_drain skips those
+        # samples), so both demand signals measure steady state.
         for n in nodes.values():
             n.inference.metrics.reset_processing()
             n.inference.scheduler.avg_query_time = {}
@@ -188,6 +191,13 @@ def main() -> int:
         out["asymmetric_split"] = bool(
             ja.get(f"cnn:{HEAVY}", {}).get("share", 0)
             != ja.get(f"cnn:{LIGHT}", {}).get("share", 0))
+        # steady-state check (VERDICT item 4): with compile-window samples
+        # excluded, the COSTLIER-per-query model must hold the LARGER
+        # share in the captured both-live view — the ratio formula's
+        # signature, provable only on a clean steady-state signal
+        out["share_ordering_matches_cost"] = bool(
+            ja.get(f"cnn:{HEAVY}", {}).get("share", 0)
+            >= ja.get(f"cnn:{LIGHT}", {}).get("share", 0))
 
         # -- the arbitration inputs (c1 allocation view) -------------------
         out["avg_query_s"] = {
