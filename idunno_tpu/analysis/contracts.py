@@ -1,0 +1,200 @@
+"""Declared protocol contracts the checkers enforce.
+
+These registries are the machine-readable half of CLAUDE.md's "when adding
+a coordinator verb" rules. They are *declarations*, not detection: a new
+mutating verb must be added to ``IDEM_VERBS`` (and its anchors must then
+resolve), a new lock-guarded field to ``GUARDED``, a new retry site to
+``RETRY_SAFE`` — the checkers fail loudly when an anchor no longer
+resolves, so a refactor cannot silently shed a contract.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from idunno_tpu.analysis.core import Finding
+
+
+@dataclasses.dataclass(frozen=True)
+class Allow:
+    """One reviewed suppression. ``symbol``/``tag`` may be ``"*"``; the
+    justification is mandatory and must be a real sentence."""
+    checker: str
+    file: str
+    symbol: str
+    tag: str
+    justification: str
+
+    def __post_init__(self) -> None:
+        if len(self.justification.strip()) < 20:
+            raise ValueError(
+                f"allowlist entry {self.checker}:{self.file}:{self.symbol}"
+                f":{self.tag} needs a real justification sentence, got "
+                f"{self.justification!r}")
+
+    def matches(self, f: Finding) -> bool:
+        return (self.checker == f.checker and self.file == f.file
+                and self.symbol in ("*", f.symbol)
+                and self.tag in ("*", f.tag))
+
+
+@dataclasses.dataclass(frozen=True)
+class IdemVerb:
+    """A mutating verb and where its exactly-once story is anchored.
+
+    kind="keyed": the client threads an idempotency key and the server
+    dedupes it (anchor = the structure name that must appear in the
+    anchored function). kind="natural": the verb is idempotent by
+    construction (named resource / journaled deterministic counter); the
+    anchor is the construct that makes it so."""
+    verb: str
+    kind: str                                  # "keyed" | "natural"
+    anchors: tuple[tuple[str, str, str], ...]  # (file, qualname, marker)
+    why: str = ""
+
+    def __post_init__(self) -> None:
+        if self.kind not in ("keyed", "natural"):
+            raise ValueError(f"{self.verb}: kind {self.kind!r}")
+        if self.kind == "natural" and len(self.why.strip()) < 20:
+            raise ValueError(f"{self.verb}: a 'natural' idempotency claim "
+                             "needs a justification sentence")
+
+
+@dataclasses.dataclass(frozen=True)
+class Guard:
+    """Fields of ``cls`` in ``file`` that must only be touched under
+    ``with self.<lock>``. Methods named ``*_locked`` assert the caller
+    holds it (the repo's documented convention) and are exempt, as is
+    ``__init__`` (no concurrency before construction completes)."""
+    file: str
+    cls: str
+    lock: str
+    fields: tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class RetrySite:
+    """A ``call_with_retry`` call site and why retrying there is safe."""
+    file: str
+    symbol: str      # qualname of the enclosing function
+    verbs: tuple[str, ...]   # idem-registry verbs it may carry
+    why: str
+
+    def __post_init__(self) -> None:
+        if len(self.why.strip()) < 20:
+            raise ValueError(f"retry site {self.file}:{self.symbol} needs "
+                             "a justification sentence")
+
+
+@dataclasses.dataclass
+class Contracts:
+    fence_targets: tuple[str, ...]
+    stamp_targets: tuple[str, ...]
+    determinism_targets: tuple[str, ...]
+    idem_verbs: tuple[IdemVerb, ...]
+    guarded: tuple[Guard, ...]
+    retry_safe: tuple[RetrySite, ...]
+    allowlist: tuple[Allow, ...]
+
+
+# -- the shipped registries -------------------------------------------------
+
+# modules whose ``transport.serve`` handlers must fence (everything — the
+# checker itself exempts mutation-free handlers and membership gossip)
+FENCE_TARGETS = ("idunno_tpu/",)
+
+# modules whose transport send sites are coordinator-plane: every site
+# must stamp an epoch, observe replies fence-aware, or be allowlisted
+STAMP_TARGETS = ("idunno_tpu/serve/", "idunno_tpu/membership/",
+                 "idunno_tpu/store/")
+
+# chaos-reachable modules: no wall-clock/rng draws outside injection
+DETERMINISM_TARGETS = ("idunno_tpu/serve/", "idunno_tpu/membership/",
+                       "idunno_tpu/comm/", "idunno_tpu/store/",
+                       "idunno_tpu/chaos.py")
+
+IDEM_VERBS = (
+    IdemVerb("submit", "keyed", anchors=(
+        ("idunno_tpu/serve/inference_service.py",
+         "InferenceService.submit_query", "idem"),
+        ("idunno_tpu/serve/inference_service.py",
+         "InferenceService._master_submit", "_idem"),
+        # the key replicates with the failover snapshot, so a retry
+        # against the ADOPTED master still dedupes
+        ("idunno_tpu/serve/failover.py",
+         "FailoverManager._snapshot_locked", "idem"),
+    )),
+    IdemVerb("lm_submit", "keyed", anchors=(
+        # node-local dedupe of a manager's re-forward after a lost ACK
+        ("idunno_tpu/serve/control.py",
+         "ControlService._dispatch", "_lm_idem"),
+        # manager-side: journaled key → rid map, replayed by recovery
+        ("idunno_tpu/serve/lm_manager.py", "LMPoolManager.submit", "idem"),
+    )),
+    IdemVerb("put", "keyed", anchors=(
+        ("idunno_tpu/store/sdfs.py", "FileStoreService.put_bytes", "idem"),
+        ("idunno_tpu/store/sdfs.py", "FileStoreService._master_put",
+         "_put_idem"),
+    )),
+    IdemVerb("train_start", "natural", anchors=(
+        ("idunno_tpu/serve/control.py",
+         "ControlService._dispatch", "already"),
+        ("idunno_tpu/serve/lm_manager.py", "LMPoolManager.train",
+         "already"),),
+        why="train jobs are a named resource: a retried start finds the "
+            "live job and is rejected/absorbed, never double-started"),
+    IdemVerb("lm_serve", "natural", anchors=(
+        ("idunno_tpu/serve/control.py",
+         "ControlService._dispatch", "already"),),
+        why="pools are a named resource: a duplicate serve returns "
+            "already=True instead of building a second loop"),
+    IdemVerb("group_scale", "natural", anchors=(
+        # deterministic replica names off a journaled counter: a replayed
+        # spawn decision resolves to the same "{group}@r{i}" and dedupes
+        ("idunno_tpu/serve/lm_manager.py", "LMPoolManager.group_spawn",
+         "next_replica"),),
+        why="replica names derive from a journaled counter, so a replayed "
+            "spawn decision recreates the same name instead of a twin"),
+)
+
+GUARDED = (
+    Guard("idunno_tpu/serve/control.py", "ControlService", "_reg_lock",
+          ("_lm_loops", "_train_jobs", "_lm_idem")),
+    Guard("idunno_tpu/serve/failover.py", "FailoverManager", "_lock",
+          ("_seq", "_received", "_received_seq", "_wal", "_scale_wal")),
+    Guard("idunno_tpu/serve/inference_service.py", "InferenceService",
+          "_results_lock", ("_results", "_qnum", "_idem")),
+    Guard("idunno_tpu/serve/inference_service.py", "InferenceService",
+          "_jobs_lock", ("_jobs", "_pending_results")),
+    Guard("idunno_tpu/serve/lm_manager.py", "LMPoolManager", "_lock",
+          ("_pools", "_jobs", "_groups")),
+    Guard("idunno_tpu/store/sdfs.py", "FileStoreService", "_meta_lock",
+          ("_put_idem", "_versions")),
+)
+
+RETRY_SAFE = (
+    RetrySite("idunno_tpu/serve/inference_service.py",
+              "InferenceService._master_call", verbs=("submit",),
+              why="every mutating payload routed here carries the submit "
+                  "idempotency key; reads are naturally idempotent"),
+    RetrySite("idunno_tpu/store/sdfs.py", "FileStoreService._master_call",
+              verbs=("put",),
+              why="put carries the keyed idem; get/ls/stat are reads and "
+                  "delete is a tombstone overwrite, idempotent by shape"),
+    RetrySite("idunno_tpu/chaos.py", "ChaosCluster._client_control",
+              verbs=("lm_submit", "train_start", "lm_serve"),
+              why="harness client path mirrors real clients: mutating "
+                  "verbs carry idem keys threaded by the workload"),
+)
+
+
+def default() -> Contracts:
+    from idunno_tpu.analysis.allowlist import ALLOWLIST
+    return Contracts(
+        fence_targets=FENCE_TARGETS,
+        stamp_targets=STAMP_TARGETS,
+        determinism_targets=DETERMINISM_TARGETS,
+        idem_verbs=IDEM_VERBS,
+        guarded=GUARDED,
+        retry_safe=RETRY_SAFE,
+        allowlist=tuple(ALLOWLIST),
+    )

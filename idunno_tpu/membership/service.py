@@ -141,7 +141,8 @@ class MembershipService:
         self._left = True
         self.members.set(self.host, MemberStatus.LEAVE, now)
         msg = Message(MessageType.LEAVE, self.host,
-                      {"members": self.members.to_wire()})
+                      {"members": self.members.to_wire(),
+                       "epoch": list(self.epoch.view())})
         for h in self.config.hosts:
             if h != self.host:
                 self.transport.datagram(h, SERVICE, msg)

@@ -16,7 +16,6 @@ collective/compute overlap — no hand-written RDMA.
 from __future__ import annotations
 
 import functools
-from typing import Any
 
 import jax
 import jax.numpy as jnp

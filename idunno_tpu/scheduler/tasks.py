@@ -10,7 +10,7 @@ serializes to/from wire form for standby-coordinator state replication
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any
 
 WORKING = "w"        # reference's 'w' / 'f' task states (`:529-533, 645-652`)

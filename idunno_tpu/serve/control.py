@@ -556,12 +556,14 @@ class ControlService:
                 _time.sleep(seconds)
             return {"log_dir": log_dir, "seconds": seconds}
         if verb == "train_status":
-            job = self._train_jobs.get(p["name"])
+            with self._reg_lock:
+                job = self._train_jobs.get(p["name"])
             if job is None:
                 raise ValueError(f"no training job {p['name']!r}")
             return job.status()
         if verb == "train_stop":
-            job = self._train_jobs.get(p["name"])
+            with self._reg_lock:
+                job = self._train_jobs.get(p["name"])
             if job is None:
                 return {"stopped": False}
             job.stop()
@@ -627,7 +629,8 @@ class ControlService:
                     and not p.get("local"):
                 tid = mgr.trace_of(name, rid)
             else:
-                loop = self._lm_loops.get(name)
+                with self._reg_lock:
+                    loop = self._lm_loops.get(name)
                 if loop is not None and not isinstance(loop, _Starting):
                     tid = loop.trace_of(rid)
         if tid is None and p.get("model") is not None \

@@ -25,7 +25,7 @@ import threading
 import time
 import uuid
 from collections.abc import Callable
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Protocol
 
 from idunno_tpu.comm.message import Message
@@ -267,6 +267,13 @@ class InferenceService:
     # ------------------------------------------------------------------ #
 
     def _handle_inference(self, service: str, msg: Message) -> Message | None:
+        # fence first, before either branch can touch scheduler state: a
+        # verb stamped below our epoch high-water comes from a deposed
+        # coordinator — reject (typed), never act; the reply deposes the
+        # sender. Unstamped client submissions pass untouched.
+        stale = check_payload(self.membership.epoch, msg.payload, self.host)
+        if stale is not None:
+            return stale
         if msg.type is MessageType.INFERENCE:      # client submission
             if not self.membership.is_acting_master:
                 return Message(MessageType.ERROR, self.host,
@@ -279,12 +286,6 @@ class InferenceService:
                                        trace=trace_from_payload(p))
         if msg.type is MessageType.JOB:            # dispatched task
             p = msg.payload
-            # fence: a JOB stamped below our epoch high-water comes from a
-            # deposed coordinator — reject (typed), never enqueue; the
-            # reply deposes the sender
-            stale = check_payload(self.membership.epoch, p, self.host)
-            if stale is not None:
-                return stale
             with self._jobs_lock:
                 self._jobs.append(Job(model=p["model"], qnum=int(p["qnum"]),
                                       assigned=float(p.get("assigned", 0.0)),

@@ -115,6 +115,11 @@ class LMPoolManager:
         # rebuild is a full recompile + in-flight requeue, so a rate
         # hovering on a share boundary must not thrash the pool
         self.resize_dwell_s = float(config.lm_resize_dwell_s)
+        # wall-clock source for request bookkeeping (t_submitted/
+        # t_forwarded, fair-share windows, resize dwell, drain stamps) —
+        # injectable so seeded harnesses can pin it; the autoscaler keeps
+        # its own separately-injected clock
+        self.wall = time.time
         # per-node span recorder (utils/spans.py), wired by serve/node.py;
         # None = tracing off. Journaled requests carry their trace ctx in
         # to_wire, so a trace survives failover adoption
@@ -388,7 +393,7 @@ class LMPoolManager:
                    "status": _PENDING, "node_id": None,
                    "tokens": None, "prompt_len": None, "delivered": False,
                    "t_forwarded": None, "attempts": 0,
-                   "t_submitted": time.time()}
+                   "t_submitted": self.wall()}
             pool["requests"][rid] = req
             if idem_key is not None:
                 pool["idem"][idem_key] = rid
@@ -489,7 +494,7 @@ class LMPoolManager:
                     req2 = pool["requests"][rid]
                     req2["status"] = _INFLIGHT
                     req2["node_id"] = int(out["id"])
-                    req2["t_forwarded"] = time.time()
+                    req2["t_forwarded"] = self.wall()
                     req2["attempts"] += 1
                     req2["admitted"] = True
                 elif status == _CANCELLED:
@@ -1569,7 +1574,7 @@ class LMPoolManager:
         (the standby's copy stays passive until adoption)."""
         if not self.membership.is_acting_master:
             return
-        now = time.time()
+        now = self.wall()
         with self._lock:
             for pool in self._pools.values():
                 self._requeue_stale_locked(pool, now)
@@ -1696,7 +1701,7 @@ class LMPoolManager:
         view = self.allocation_view()
         jobs = view["jobs"]
         total_share = sum(j["share"] for j in jobs.values()) or 1
-        now = time.time()
+        now = self.wall()
         resize = []
         with self._lock:
             for name, pool in self._pools.items():
@@ -1772,7 +1777,7 @@ class LMPoolManager:
                 if not stale:
                     entry["spec"]["slots"] = target
                     entry["slots_now"] = target
-                    entry["t_last_resize"] = time.time()
+                    entry["t_last_resize"] = self.wall()
                     # the replaced loop dropped its in-flight requests;
                     # requeue for token-exact replay. attempts reset: a
                     # pool-level rebuild (and its recompile) must not
@@ -1860,7 +1865,7 @@ class LMPoolManager:
             by_node_id = {r["node_id"]: r
                           for r in pool["requests"].values()
                           if r["status"] == _INFLIGHT}
-            now = time.time()
+            now = self.wall()
             for c in out.get("completions", ()):
                 req = by_node_id.get(int(c["id"]))
                 if req is not None:

@@ -22,7 +22,6 @@ as ``rejected="expired"`` without ever decoding them.
 from __future__ import annotations
 
 import threading
-from typing import Any
 
 from idunno_tpu.engine.serve_lm import Completion, DecodeServer
 from idunno_tpu.serve.admission import PRIORITIES, AdmissionShed

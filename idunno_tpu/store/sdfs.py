@@ -326,6 +326,13 @@ class FileStoreService:
     # ------------------------------------------------------------------ #
 
     def _handle(self, service: str, msg: Message) -> Message | None:
+        # fence BOTH planes before dispatch: an internal push/delete from
+        # a deposed master and a stale-stamped client verb are rejected
+        # here, so a healed partition cannot overwrite replicas or
+        # metadata with the old master's writes
+        stale = check_payload(self.membership.epoch, msg.payload, self.host)
+        if stale is not None:
+            return stale
         if msg.payload.get("internal", False):
             return self._handle_internal(msg)
         return self._handle_as_master(msg)
@@ -334,12 +341,8 @@ class FileStoreService:
         return Message(MessageType.ERROR, self.host, {"error": text})
 
     def _handle_internal(self, msg: Message) -> Message | None:
-        # internal verbs are master-originated and epoch-stamped: a push
-        # or delete from a deposed master is rejected here, so a healed
-        # partition cannot overwrite replicas with the old master's writes
-        stale = check_payload(self.membership.epoch, msg.payload, self.host)
-        if stale is not None:
-            return stale
+        # internal verbs are master-originated and epoch-stamped; the
+        # fence already ran in _handle before dispatch reached here
         if msg.type is MessageType.STORE:      # inventory query (rebuild)
             return Message(MessageType.ACK, self.host,
                            {"files": self.local.files(),
@@ -420,10 +423,8 @@ class FileStoreService:
                 "sdfs.replicate", trace=trace[0], parent=trace[1],
                 attrs={"name": name, "version": version,
                        "replicas": len(replicas)})
-        push = Message(MessageType.PUT, self.host,
-                       {"name": name, "version": version, "internal": True,
-                        "epoch": list(self.membership.epoch.view())},
-                       blob=blob)
+        base = {"name": name, "version": version, "internal": True,
+                "epoch": list(self.membership.epoch.view())}
         stored: set[str] = set()
         for h in replicas:                        # network I/O — no lock held
             if h == self.host:
@@ -431,12 +432,17 @@ class FileStoreService:
                 stored.add(h)
                 continue
             psp = None
+            pl = dict(base)
             if rsp is not None:
                 # one child span per replica push: the fan-out is visible
-                # host-by-host, a dead replica shows as an error span
+                # host-by-host, a dead replica shows as an error span —
+                # and the child's ctx rides the payload beside the epoch
+                # stamp so the replica can continue the trace
                 psp = self.spans.start("sdfs.push", trace=rsp.trace_id,
                                        parent=rsp.span_id,
                                        attrs={"name": name, "to": h})
+                stamp_trace(pl, (rsp.trace_id, psp.span_id))
+            push = Message(MessageType.PUT, self.host, pl, blob=blob)
             try:
                 out = self.transport.call(h, SERVICE, push, timeout=30.0)
             except TransportError:

@@ -39,8 +39,25 @@ def main() -> int:
     # overload→underload pressure makes the loop spawn AND retire under
     # the fault surface; the scaling journal joins the invariant checks
     ap.add_argument("--autoscale", type=int, default=1)
+    # lint preflight on by default: a wall-clock/rng draw in a chaos-
+    # reachable module makes every printed seed unreplayable, so soaking
+    # such a tree produces failure records nobody can debug
+    ap.add_argument("--no-preflight", action="store_true",
+                    help="skip the determinism-lint preflight")
     args = ap.parse_args()
     logging.disable(logging.WARNING)   # wal-skip warnings are expected
+
+    if not args.no_preflight:
+        from idunno_tpu.analysis import run_analysis
+        pre = run_analysis(".", checkers=["determinism"])
+        if pre["findings"]:
+            # refuse to soak: seeds would not replay. Same ONE-JSON-line
+            # contract — the refusal IS the soak result.
+            print(json.dumps({
+                "suite": "chaos_soak", "schedules": 0, "passed": 0,
+                "preflight": "determinism_lint_failed",
+                "violations": [f.to_wire() for f in pre["findings"][:20]]}))
+            return 1
 
     passed, failures = 0, []
     worst_convergence = 0.0

@@ -1,0 +1,55 @@
+"""Protocol-contract lint driver: run the analyzer, ONE JSON line out.
+
+Same contract as bench.py / chaos_soak.py: exactly one JSON object on
+stdout regardless of outcome, exit 0 only when the tree is clean (zero
+findings after the justified allowlist — including zero *stale* allowlist
+entries). The findings list is capped for the ledger; counts are not.
+
+    python tools/protocol_lint.py                # all checkers
+    python tools/protocol_lint.py --checker determinism --checker fence
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from idunno_tpu.analysis import run_analysis  # noqa: E402
+from idunno_tpu.analysis.core import CHECKERS  # noqa: E402
+
+MAX_LISTED = 50
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default=".")
+    ap.add_argument("--checker", action="append", default=None,
+                    help="run only these checkers (repeatable); default "
+                         "all registered")
+    args = ap.parse_args()
+    t0 = time.monotonic()
+    try:
+        out = run_analysis(args.root, checkers=args.checker)
+    except Exception as e:  # noqa: BLE001 - ONE JSON line even on a crash
+        print(json.dumps({"suite": "protocol_lint", "error":
+                          f"{type(e).__name__}: {e}"[:300]}))
+        return 2
+    findings = out["findings"]
+    print(json.dumps({
+        "suite": "protocol_lint",
+        "checkers": sorted(args.checker or CHECKERS),
+        "files_scanned": out["files_scanned"],
+        "findings_total": len(findings),
+        "findings_by_checker": out["by_checker"],
+        "findings": [f.to_wire() for f in findings[:MAX_LISTED]],
+        "allowlist_size": out["allowlist_size"],
+        "allowlisted": out["allowlisted"],
+        "elapsed_s": round(time.monotonic() - t0, 3)}))
+    return 0 if not findings else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
