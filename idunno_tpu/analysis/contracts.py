@@ -154,13 +154,24 @@ IDEM_VERBS = (
          "next_replica"),),
         why="replica names derive from a journaled counter, so a replayed "
             "spawn decision recreates the same name instead of a twin"),
+    IdemVerb("pool_wal", "natural", anchors=(
+        # standby side keeps only the strictly newest per-pool entry
+        ("idunno_tpu/serve/failover.py", "FailoverManager._handle",
+         "pool_wal"),
+        # adoption-time replay compares the per-pool monotone wal_seq
+        ("idunno_tpu/serve/lm_manager.py", "LMPoolManager.apply_pool_wal",
+         "wal_seq"),),
+        why="per-pool WAL entries carry a monotone per-pool wal_seq; a "
+            "duplicated or replayed delta collapses because receivers "
+            "keep only strictly newer entries per pool scope"),
 )
 
 GUARDED = (
     Guard("idunno_tpu/serve/control.py", "ControlService", "_reg_lock",
           ("_lm_loops", "_train_jobs", "_lm_idem")),
     Guard("idunno_tpu/serve/failover.py", "FailoverManager", "_lock",
-          ("_seq", "_received", "_received_seq", "_wal", "_scale_wal")),
+          ("_seq", "_received", "_received_seq", "_wal", "_scale_wal",
+           "_pool_wal")),
     Guard("idunno_tpu/serve/inference_service.py", "InferenceService",
           "_results_lock", ("_results", "_qnum", "_idem")),
     Guard("idunno_tpu/serve/inference_service.py", "InferenceService",

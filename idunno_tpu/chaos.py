@@ -13,14 +13,22 @@ invariants at all.
 Invariants (``ChaosCluster.check_invariants`` after ``converge``):
 - at most one acting master per epoch, ever (fence owners are recorded at
   every step; two owners for one epoch number = split brain);
+- at most one owner per POOL-SCOPE epoch (ISSUE 14: per-pool fences are
+  sampled from every host's scope registry exactly like the cluster
+  fence — two owners for one (scope, epoch) = per-pool split brain);
 - zero stale-epoch messages ACCEPTED anywhere (a transport-level probe
   snapshots each receiver's fence before the handler runs: a stamped
-  payload below that high-water mark must produce an ERROR, never an ACK);
+  payload below that high-water mark must produce an ERROR, never an
+  ACK) — and the same for scoped stamps below a scope's high-water;
 - every CNN query acked by the surviving master lineage completes exactly
   once — result set exact, no duplicate records;
 - every LM request admitted into the surviving journal reaches exactly one
-  terminal state, and no completion is delivered twice;
-- every SDFS put acked by the surviving lineage reads back exactly;
+  terminal state, no completion is delivered twice, and every completion
+  surfaces from the POOL it was submitted to (cross-pool isolation: a
+  deposed pool-A owner must never leak or lose pool-B work);
+- every SDFS put acked by the surviving lineage reads back exactly, and
+  each surviving version keeps >= min(replication_factor, holders-at-ack)
+  alive holders (ring re-replication restored what a death took);
 - membership views converge after heal.
 
 The LM node tier is a deterministic stand-in (`ChaosControl`): tokens are
@@ -40,7 +48,7 @@ from idunno_tpu.comm.message import Message
 from idunno_tpu.comm.retry import call_with_retry
 from idunno_tpu.comm.transport import TransportError
 from idunno_tpu.config import ClusterConfig
-from idunno_tpu.membership.epoch import check_payload
+from idunno_tpu.membership.epoch import check_payload, check_scoped
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.scheduler.fair import FairScheduler
 from idunno_tpu.serve.failover import FailoverManager
@@ -114,6 +122,11 @@ class ChaosControl:
 
     def handle(self, service: str, msg: Message) -> Message:
         stale = check_payload(self.membership.epoch, msg.payload, self.host)
+        if stale is not None:
+            return stale
+        # per-pool fence mirror (serve/control.py): a verb stamped by a
+        # deposed POOL owner is rejected for that scope only
+        stale = check_scoped(self.membership.scopes, msg.payload, self.host)
         if stale is not None:
             return stale
         try:
@@ -231,17 +244,21 @@ class ChaosCluster:
     fault/workload schedule, and invariant recording."""
 
     LM_POOL = "chaos-lm"
+    LM_POOL_B = "chaos-lmB"
     LM_GROUP = "chaos-grp"
 
     def __init__(self, seed: int, data_dir: str, n_hosts: int = 5,
                  prefill_chunk: int = 0, n_model: int = 1,
-                 autoscale: bool = False) -> None:
+                 autoscale: bool = False, multi_pool: bool = False) -> None:
         self.seed = seed
         self.prefill_chunk = prefill_chunk
         self.n_model = n_model
         # gate ALL group workload behind the flag: the group ops draw
         # extra rng, which would shift every existing seed's schedule
         self.autoscale = autoscale
+        # ISSUE 14: a second concurrent managed pool, flag-gated for the
+        # same reason — its submissions draw extra rng in step()
+        self.multi_pool = multi_pool
         # synthetic interactive-p95 the injected gauges_fn reports for
         # group replicas; schedules script overload/underload through it
         self.group_pressure = 0.0
@@ -318,19 +335,27 @@ class ChaosCluster:
         self.violations: list[str] = []
         self.epoch_owners: dict[int, set[str]] = {}
         self.acting_by_epoch: dict[int, set[str]] = {}
+        # (scope, epoch) -> owners seen: >1 owner = per-pool split brain
+        self.scope_owners: dict[tuple[str, int], set[str]] = {}
         self._wrap_probes()
         # workload ledgers
         self._serial = 0
         self.cnn_acked: list[tuple[str, int, int, int]] = []  # model,q,lo,hi
         self.lm_acked: list[dict] = []       # {serial, prompt, seed, max_new}
+        self.lmb_acked: list[dict] = []      # second-pool submissions
         # every ATTEMPTED lm submit, acked or not: a submit whose ACK was
         # lost may still have been journaled (the classic "maybe" outcome)
         # and legitimately completes — but tokens from a request nobody
         # ever attempted would mean cross-wired journals
         self.lm_attempted: list[dict] = []
         self.grp_acked: list[dict] = []      # group-routed lm submissions
-        self.sdfs_acked: list[tuple[str, int, bytes]] = []
+        # (name, version, blob, holders-at-ack): the holder set feeds the
+        # ring-RF invariant — a death must not shrink it below min(RF, |set|)
+        self.sdfs_acked: list[tuple[str, int, bytes, frozenset]] = []
         self.lm_delivered: dict[tuple, int] = {}   # token tuple -> count
+        # token tuple -> pool name that delivered it (cross-pool isolation:
+        # must equal the pool the tokens were submitted to)
+        self.lm_delivered_pool: dict[tuple, str] = {}
         for h in self.cfg.hosts:
             self.members[h].join()
             self.clock.advance(0.01)
@@ -344,6 +369,15 @@ class ChaosCluster:
             **({"n_model": self.n_model}
                if self.n_model > 1 else {})})
         assert out.get("node") or out.get("already"), out
+        if multi_pool:
+            # a SECOND independent managed pool: its journal, fence scope,
+            # and WAL segment must ride failover without ever coupling to
+            # the first pool's (cross-pool isolation invariant)
+            outb = self._client_control("n3", {
+                "verb": "lm_serve", "placement": "auto",
+                "name": self.LM_POOL_B, "prompt_len": 8, "max_len": 64,
+                "slots": 4})
+            assert outb.get("node") or outb.get("already"), outb
         if autoscale:
             # a replica group under a tight policy: windows sized to the
             # 0.3 s pump waves so one schedule crosses both thresholds
@@ -363,27 +397,39 @@ class ChaosCluster:
         for h in self.cfg.hosts:
             t = self.net._nodes[h]
             fence = self.members[h].epoch
+            scopes = self.members[h].scopes
             for svc in PROBED_SERVICES:
                 handler = t._handlers.get(svc)
                 if handler is None:
                     continue
-                t._handlers[svc] = self._probe(h, svc, fence, handler)
+                t._handlers[svc] = self._probe(h, svc, fence, scopes,
+                                               handler)
 
-    def _probe(self, host, svc, fence, handler):
+    def _probe(self, host, svc, fence, scopes, handler):
         def wrapped(service, msg):
             pre = fence.current()     # BEFORE the handler can observe
+            sp = (msg.payload or {}).get("scope_epoch")
+            pre_scope = (scopes.fence(str(sp[0])).current()
+                         if sp else None)
             out = handler(service, msg)
             ep = (msg.payload or {}).get("epoch")
             if (ep and int(ep[0]) < pre and out is not None
                     and out.type is not MessageType.ERROR):
                 self.violations.append(
                     f"{host}/{svc} ACKed stale epoch {ep[0]} < {pre}")
+            if (sp and int(sp[1]) < pre_scope and out is not None
+                    and out.type is not MessageType.ERROR):
+                self.violations.append(
+                    f"{host}/{svc} ACKed stale scope {sp[0]} "
+                    f"epoch {sp[1]} < {pre_scope}")
             return out
         return wrapped
 
     def record_fences(self) -> None:
         """Sample every node's fence view: two owners for one epoch — or
-        two nodes acting as master under one epoch — is split brain."""
+        two nodes acting as master under one epoch — is split brain.
+        Scope fences are sampled the same way: two owners for one
+        (scope, epoch) is per-pool split brain."""
         for h in self.cfg.hosts:
             e, owner = self.members[h].epoch.view()
             if owner is not None:
@@ -391,6 +437,11 @@ class ChaosCluster:
             if self.members[h].is_acting_master:
                 self.acting_by_epoch.setdefault(
                     self.members[h].epoch.current(), set()).add(h)
+            for scope, view in self.members[h].scopes.view_all().items():
+                se, sowner = int(view[0]), view[1]
+                if sowner is not None:
+                    self.scope_owners.setdefault(
+                        (scope, se), set()).add(sowner)
 
     # -- client helpers (route like real clients: chain + retry) ----------
 
@@ -445,7 +496,8 @@ class ChaosCluster:
         s = self._serial
         prompt = [s % 251, (s * 7) % 251, (s * 13) % 251]
         self.lm_attempted.append({"serial": s, "prompt": prompt,
-                                  "seed": s, "max_new": 4})
+                                  "seed": s, "max_new": 4,
+                                  "pool": self.LM_POOL})
         try:
             out = self._client_control(
                 client, {"verb": "lm_submit", "name": self.LM_POOL,
@@ -456,6 +508,27 @@ class ChaosCluster:
         self.lm_acked.append({"serial": s, "rid": int(out["id"]),
                               "prompt": prompt, "seed": s, "max_new": 4})
 
+    def op_lm_b(self, client: str) -> None:
+        """A submission to the SECOND managed pool (ISSUE 14). Prompts are
+        serial-unique, so token keys stay globally unique and the global
+        exactly-once ledger decomposes per pool; the delivered-pool
+        attribution check is what makes cross-pool isolation explicit."""
+        self._serial += 1
+        s = self._serial
+        prompt = [s % 251, (s * 7) % 251, (s * 13) % 251]
+        self.lm_attempted.append({"serial": s, "prompt": prompt,
+                                  "seed": s, "max_new": 4,
+                                  "pool": self.LM_POOL_B})
+        try:
+            out = self._client_control(
+                client, {"verb": "lm_submit", "name": self.LM_POOL_B,
+                         "prompt": prompt, "max_new": 4, "seed": s},
+                idem=f"{client}:{s}:b")
+        except (TransportError, RuntimeError):
+            return
+        self.lmb_acked.append({"serial": s, "rid": int(out["id"]),
+                               "prompt": prompt, "seed": s, "max_new": 4})
+
     def op_lm_group(self, client: str) -> None:
         """A group submission: routes like op_lm but lands on whichever
         replica the group picks; the seed is pinned by the client, so
@@ -465,7 +538,8 @@ class ChaosCluster:
         s = self._serial
         prompt = [s % 251, (s * 7) % 251, (s * 13) % 251]
         self.lm_attempted.append({"serial": s, "prompt": prompt,
-                                  "seed": s, "max_new": 4})
+                                  "seed": s, "max_new": 4,
+                                  "pool": self.LM_GROUP})
         try:
             out = self._client_control(
                 client, {"verb": "lm_submit", "name": self.LM_GROUP,
@@ -507,7 +581,15 @@ class ChaosCluster:
             v = self.stores[client].put_bytes(name, blob)
         except (StoreError, TransportError):
             return
-        self.sdfs_acked.append((name, v, blob))
+        # holders-at-ack for the ring-RF invariant, read straight off the
+        # acting master's metadata (in-process, NO extra network traffic —
+        # an ls RPC here would consume the net's chaos rng and shift every
+        # existing seed's schedule)
+        master = self.members[client].acting_master()
+        store = self.stores[master]
+        with store._meta_lock:
+            holders = frozenset(store._locations.get(name, set()))
+        self.sdfs_acked.append((name, v, blob, holders))
 
     # -- fault ops --------------------------------------------------------
 
@@ -561,10 +643,12 @@ class ChaosCluster:
         if r < 0.22:
             self.op_cnn(client)
         elif r < 0.44:
-            # the extra draw is flag-gated: existing seeds' schedules
-            # must not shift when the group workload is off
+            # every extra draw is flag-gated: existing seeds' schedules
+            # must not shift when the group/second-pool workload is off
             if self.autoscale and self.rng.random() < 0.5:
                 self.op_lm_group(client)
+            elif self.multi_pool and self.rng.random() < 0.5:
+                self.op_lm_b(client)
             else:
                 self.op_lm(client)
         elif r < 0.58:
@@ -649,13 +733,18 @@ class ChaosCluster:
                 out.append(f"cnn {model} q{q}")
         mgr = self.managers[self.final_master()]
         with mgr._lock:
-            pool = mgr._pools.get(self.LM_POOL)
-            if pool is not None:
+            pools = [("lm", self.LM_POOL)]
+            if self.multi_pool:
+                pools.append(("lmB", self.LM_POOL_B))
+            for tag, pname in pools:
+                pool = mgr._pools.get(pname)
+                if pool is None:
+                    continue
                 if pool["node"] is None:
-                    out.append("lm pool unplaced")
+                    out.append(f"{tag} pool unplaced")
                 for rid, r in pool["requests"].items():
                     if r["status"] in ("pending", "inflight"):
-                        out.append(f"lm rid {rid} {r['status']}")
+                        out.append(f"{tag} rid {rid} {r['status']}")
             g = mgr._groups.get(self.LM_GROUP)
             if g is not None:
                 replicas = list(g["replicas"])
@@ -693,8 +782,9 @@ class ChaosCluster:
         per-completion delivery counts (token tuple = logical request
         identity, since every prompt is serial-unique)."""
         got = []
-        names = [self.LM_POOL] + ([self.LM_GROUP] if self.autoscale
-                                  else [])
+        names = ([self.LM_POOL]
+                 + ([self.LM_POOL_B] if self.multi_pool else [])
+                 + ([self.LM_GROUP] if self.autoscale else []))
         for _ in range(3):
             for name in list(names):
                 try:
@@ -712,6 +802,7 @@ class ChaosCluster:
                     key = tuple(c["tokens"])
                     self.lm_delivered[key] = (
                         self.lm_delivered.get(key, 0) + 1)
+                    self.lm_delivered_pool[key] = name
                     got.append(c)
             if not names:
                 break
@@ -744,6 +835,10 @@ class ChaosCluster:
         for e, hosts in self.acting_by_epoch.items():
             assert len(hosts) <= 1, \
                 f"epoch {e} acted by {sorted(hosts)} (split brain)"
+        for (scope, e), owners in self.scope_owners.items():
+            assert len(owners) <= 1, \
+                f"scope {scope} epoch {e} owned by {sorted(owners)} " \
+                f"(per-pool split brain)"
         # membership converged: every alive host agrees on the alive set
         views = {h: tuple(self.members[h].members.alive_hosts())
                  for h in self.cfg.hosts}
@@ -770,16 +865,35 @@ class ChaosCluster:
         for key, n in self.lm_delivered.items():
             assert n == 1, f"completion delivered {n}x: {key}"
             assert key in by_tokens, f"tokens never submitted: {key}"
-        # SDFS: surviving puts read back exactly
+            # cross-pool isolation: the completion surfaced from the pool
+            # its tokens were submitted to — a deposed pool-A owner whose
+            # outbox leaked into pool B would trip here
+            want_pool = by_tokens[key].get("pool", self.LM_POOL)
+            got_pool = self.lm_delivered_pool.get(key, want_pool)
+            assert got_pool == want_pool, \
+                f"completion crossed pools: submitted to {want_pool}, " \
+                f"delivered by {got_pool}: {key}"
+        # SDFS: surviving puts read back exactly, and ring re-replication
+        # kept every surviving version at full strength — alive holders
+        # >= min(replication_factor, holders-at-ack)
         store = self.stores[self.final_master()]
+        alive_now = set(self.members[self.final_master()]
+                        .members.alive_hosts())
         sdfs_survived = 0
-        for name, version, blob in self.sdfs_acked:
+        for name, version, blob, holders in self.sdfs_acked:
             try:
                 got, v = store.get_bytes(name, version=version)
             except StoreError:
                 continue        # doomed-lineage ack (lost, never wrong)
             assert got == blob, f"{name} v{version} corrupt"
             sdfs_survived += 1
+            have = {h for h in self.cfg.hosts
+                    if version in self.stores[h].local.files().get(name, [])}
+            want = min(self.cfg.replication_factor,
+                       len(holders) if holders else 1, len(alive_now))
+            assert len(have & alive_now) >= max(want, 1), \
+                f"{name} v{version}: alive holders " \
+                f"{sorted(have & alive_now)} < {want} (RF not restored)"
         # replica group: the scaling journal itself is an invariant
         # surface — exactly-once decisions, fenced epochs, no replica
         # double-spawned by a replayed decision (ISSUE 11)
@@ -816,13 +930,19 @@ class ChaosCluster:
             grp_summary = {"grp_acked": len(self.grp_acked),
                            "grp_replicas": len(gview["replicas"]),
                            "grp_decisions": gview["next_seq"]}
+        pool_epochs: dict[str, int] = {}
+        for scope, e in self.scope_owners:
+            pool_epochs[scope] = max(pool_epochs.get(scope, 0), e)
         return {"cnn_acked": len(self.cnn_acked),
                 "cnn_survived": len(survived),
                 "lm_acked": len(self.lm_acked),
+                "lmb_acked": len(self.lmb_acked),
                 "lm_delivered": len(self.lm_delivered),
                 "sdfs_acked": len(self.sdfs_acked),
                 "sdfs_survived": sdfs_survived,
                 "epochs": max(self.epoch_owners, default=0),
+                "pool_epochs": pool_epochs,
+                "hosts": len(self.cfg.hosts),
                 "final_master": self.final_master(),
                 **grp_summary}
 
@@ -831,7 +951,9 @@ def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
                         chaos: dict | None = None,
                         prefill_chunk: int = 0,
                         n_model: int = 1,
-                        autoscale: bool = False) -> dict:
+                        autoscale: bool = False,
+                        multi_pool: bool = False,
+                        n_hosts: int = 5) -> dict:
     """One full seeded chaos run: schedule -> converge -> invariants.
     Returns the invariant summary plus convergence time.
     ``prefill_chunk`` rides the managed pool's lm_serve spec (ISSUE 7):
@@ -840,9 +962,15 @@ def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
     with in-flight chunked admissions. ``autoscale`` adds a replica
     group with scripted overload→underload pressure (ISSUE 11): the
     autoscaler's spawn/retire decisions ride the same fault schedule and
-    the group's scaling journal joins the invariant surface."""
-    c = ChaosCluster(seed, data_dir, prefill_chunk=prefill_chunk,
-                     n_model=n_model, autoscale=autoscale)
+    the group's scaling journal joins the invariant surface.
+    ``multi_pool`` serves a SECOND concurrent managed pool and
+    ``n_hosts`` scales the cluster (ISSUE 14): per-pool fence scopes,
+    scoped adoption, and cross-pool isolation join the invariant surface,
+    certified at 50-100 hosts by the soak driver."""
+    c = ChaosCluster(seed, data_dir, n_hosts=n_hosts,
+                     prefill_chunk=prefill_chunk,
+                     n_model=n_model, autoscale=autoscale,
+                     multi_pool=multi_pool)
     try:
         c.run_schedule(steps=steps,
                        chaos=chaos if chaos is not None

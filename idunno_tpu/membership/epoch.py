@@ -40,6 +40,21 @@ class StaleEpoch(TransportError):
         self.owner = owner
 
 
+class StaleScope(TransportError):
+    """A peer rejected our verb because it has seen a higher epoch for ONE
+    fence scope (a managed pool/group) — only that scope's journal is
+    fenced; the cluster-wide fence and every other scope are untouched.
+    Never retryable for the same reason as StaleEpoch, but the caller
+    steps down for the named scope only."""
+
+    def __init__(self, message: str, scope: str, epoch: int = 0,
+                 owner: str | None = None) -> None:
+        super().__init__(message, reason="stale_scope")
+        self.scope = scope
+        self.epoch = epoch
+        self.owner = owner
+
+
 class EpochFence:
     """Thread-safe (epoch, owner) high-water mark.
 
@@ -80,6 +95,57 @@ class EpochFence:
             self._epoch += 1
             self._owner = owner
             return self._epoch
+
+
+class FenceRegistry:
+    """Keyed fence map: one ``EpochFence`` per scope (``pool:<name>`` for
+    managed LM pools/replica groups), created on demand. Each scope's
+    epoch advances independently, so adopting one pool's fence deposes the
+    old owner for THAT pool only — the cluster-wide ``EpochFence`` remains
+    the authority for membership + SDFS-master duties. Scope views ride
+    the membership gossip (``"scopes"`` payload key) exactly like the
+    cluster fence view rides ``"epoch"``."""
+
+    def __init__(self) -> None:
+        self._fences: dict[str, EpochFence] = {}
+        self._lock = threading.Lock()
+
+    def fence(self, scope: str) -> EpochFence:
+        with self._lock:
+            f = self._fences.get(scope)
+            if f is None:
+                f = self._fences[scope] = EpochFence()
+            return f
+
+    def scopes(self) -> list[str]:
+        with self._lock:
+            return sorted(self._fences)
+
+    def view_all(self) -> dict[str, list]:
+        """Gossip wire form: only scopes that ever moved off bootstrap
+        (a never-minted scope carries no fencing information)."""
+        with self._lock:
+            fences = dict(self._fences)
+        out: dict[str, list] = {}
+        for scope, f in fences.items():
+            e, owner = f.view()
+            if e > 0 or owner is not None:
+                out[scope] = [e, owner]
+        return out
+
+    def observe_all(self, views) -> None:
+        if not isinstance(views, dict):
+            return
+        for scope, ep in views.items():
+            if ep:
+                self.fence(str(scope)).observe(int(ep[0]), ep[1])
+
+
+def pool_scope(name: str) -> str:
+    """Fence scope for a managed pool name. Replica-group members
+    (``{group}@r{i}``) share their group's scope: the group journal +
+    scale WAL are one ownership unit, so its replicas fence together."""
+    return f"pool:{name.rsplit('@r', 1)[0]}"
 
 
 # -- wire helpers (shared by every stamped service) ------------------------
@@ -130,3 +196,65 @@ def reply_is_stale(fence: EpochFence, reply: Message | None) -> bool:
         return False
     observe_payload(fence, p)
     return True
+
+
+# -- scoped wire helpers (per-pool fences) ---------------------------------
+#
+# Scoped stamps ride BESIDE the cluster stamp under their own payload key
+# ("scope_epoch": [scope, e, owner]) and scoped rejections use "stale_scope"
+# — never "stale_epoch" — so a pool-level deposal can NOT demote the sender
+# cluster-wide through reply_is_stale. Unstamped payloads pass everywhere,
+# exactly like the cluster fence.
+
+def stamp_scoped(registry: FenceRegistry, scope: str,
+                 payload: dict) -> dict:
+    """Stamp a pool-directed payload with the sender's scope-fence view
+    (in place; returns the payload for chaining)."""
+    e, owner = registry.fence(scope).view()
+    payload["scope_epoch"] = [scope, e, owner]
+    return payload
+
+
+def observe_scoped(registry: FenceRegistry, payload) -> None:
+    """Advance the local scope fence from a stamped payload without
+    rejecting (gossip / replies)."""
+    ep = payload.get("scope_epoch") if isinstance(payload, dict) else None
+    if ep:
+        registry.fence(str(ep[0])).observe(int(ep[1]), ep[2])
+
+
+def check_scoped(registry: FenceRegistry, payload,
+                 host: str) -> Message | None:
+    """Receiver-side scope-fence check: a stamp below the local high-water
+    mark for its scope gets a typed stale-scope ERROR reply (the rejection
+    names the scope and carries the rejecting view); else the stamp is
+    observed and None returned. Unstamped payloads always pass."""
+    ep = payload.get("scope_epoch") if isinstance(payload, dict) else None
+    if not ep:
+        return None
+    scope, e = str(ep[0]), int(ep[1])
+    fence = registry.fence(scope)
+    cur, owner = fence.view()
+    if e < cur:
+        return Message(MessageType.ERROR, host,
+                       {"error": f"stale scope epoch {e} < {cur} for "
+                                 f"{scope} (owner {owner}): the managed "
+                                 "journal for this scope is fenced",
+                        "stale_scope": scope,
+                        "scope_epoch": [scope, cur, owner]})
+    fence.observe(e, ep[2])
+    return None
+
+
+def reply_stale_scope(registry: FenceRegistry,
+                      reply: Message | None) -> str | None:
+    """Sender-side: the fenced scope name if the reply is a stale-scope
+    rejection (observing the rejecting peer's view), else None."""
+    if reply is None or reply.type is not MessageType.ERROR:
+        return None
+    p = reply.payload if isinstance(reply.payload, dict) else {}
+    scope = p.get("stale_scope")
+    if not scope:
+        return None
+    observe_scoped(registry, p)
+    return str(scope)

@@ -28,7 +28,8 @@ from collections.abc import Callable
 from idunno_tpu.comm.message import Message
 from idunno_tpu.comm.transport import Transport, TransportError
 from idunno_tpu.config import ClusterConfig
-from idunno_tpu.membership.epoch import EpochFence, observe_payload
+from idunno_tpu.membership.epoch import (EpochFence, FenceRegistry,
+                                         observe_payload)
 from idunno_tpu.membership.list import MembershipList
 from idunno_tpu.utils.types import MemberStatus, MessageType
 
@@ -50,6 +51,10 @@ class MembershipService:
         # (stamped on coordinator verbs, advanced by gossip; epoch 0 /
         # no owner = bootstrap, the configured chain acts unfenced)
         self.epoch = EpochFence()
+        # per-scope fences (one per managed LM pool/group, "pool:<name>");
+        # scoped adoption mints here, scope views gossip beside the
+        # cluster view — membership only ever OBSERVES scope stamps
+        self.scopes = FenceRegistry()
         self._callbacks: list[ChangeCallback] = []
         self._left = False           # voluntary leave: never auto-refute
         transport.serve(SERVICE, self._handle)
@@ -116,7 +121,8 @@ class MembershipService:
             return
         msg = Message(MessageType.JOIN, self.host,
                       {"members": self.members.to_wire(),
-                       "epoch": list(self.epoch.view())})
+                       "epoch": list(self.epoch.view()),
+                       "scopes": self.scopes.view_all()})
         for seed in (self.config.introducer, self.config.coordinator,
                      self.config.standby_coordinator):
             if seed == self.host:
@@ -127,9 +133,11 @@ class MembershipService:
                 continue
             if out is not None:
                 # the ACK carries the cluster's fence view: a rejoiner that
-                # lost its fence state re-learns the current epoch before
-                # it could ever act on a stale one
+                # lost its fence state re-learns the current epoch (and
+                # every pool scope's) before it could ever act on a stale
+                # one
                 observe_payload(self.epoch, out.payload)
+                self.scopes.observe_all(out.payload.get("scopes"))
                 self._fire(self.members.merge(out.payload["members"]))
                 return
         # nobody reachable — we are first up; keep our solo list.
@@ -142,7 +150,8 @@ class MembershipService:
         self.members.set(self.host, MemberStatus.LEAVE, now)
         msg = Message(MessageType.LEAVE, self.host,
                       {"members": self.members.to_wire(),
-                       "epoch": list(self.epoch.view())})
+                       "epoch": list(self.epoch.view()),
+                       "scopes": self.scopes.view_all()})
         for h in self.config.hosts:
             if h != self.host:
                 self.transport.datagram(h, SERVICE, msg)
@@ -156,7 +165,8 @@ class MembershipService:
             return
         msg = Message(MessageType.PING, self.host,
                       {"members": self.members.to_wire(),
-                       "epoch": list(self.epoch.view())})
+                       "epoch": list(self.epoch.view()),
+                       "scopes": self.scopes.view_all()})
         for h in self.config.hosts:
             if h != self.host:
                 self.transport.datagram(h, SERVICE, msg)
@@ -229,14 +239,19 @@ class MembershipService:
         now = self.clock()
         # fence gossip: every membership message carries the sender's
         # (epoch, owner) view; observing it here is what deposes a stale
-        # coordinator within one ping wave of a heal
+        # coordinator within one ping wave of a heal. Scope views ride
+        # beside it — membership observes scope fences, never rejects
+        # (a deposed pool owner must still learn it was deposed)
         observe_payload(self.epoch, msg.payload)
+        self.scopes.observe_all(msg.payload.get("scopes")
+                                if isinstance(msg.payload, dict) else None)
         if msg.type is MessageType.JOIN:
             self._fire(self.members.merge(msg.payload["members"]))
             self.members.touch(msg.sender, now)
             return Message(MessageType.ACK, self.host,
                            {"members": self.members.to_wire(),
-                            "epoch": list(self.epoch.view())})
+                            "epoch": list(self.epoch.view()),
+                            "scopes": self.scopes.view_all()})
         if msg.type in (MessageType.PING, MessageType.PONG,
                         MessageType.LEAVE):
             self._fire(self.members.merge(msg.payload["members"]))
@@ -246,6 +261,7 @@ class MembershipService:
                     msg.sender, SERVICE,
                     Message(MessageType.PONG, self.host,
                             {"members": self.members.to_wire(),
-                             "epoch": list(self.epoch.view())}))
+                             "epoch": list(self.epoch.view()),
+                             "scopes": self.scopes.view_all()}))
             return None
         return None

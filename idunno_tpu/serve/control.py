@@ -39,7 +39,7 @@ import os
 from typing import TYPE_CHECKING
 
 from idunno_tpu.comm.message import Message
-from idunno_tpu.membership.epoch import check_payload
+from idunno_tpu.membership.epoch import check_payload, check_scoped
 from idunno_tpu.utils.spans import trace_from_payload
 from idunno_tpu.utils.types import MessageType
 
@@ -98,6 +98,14 @@ class ControlService:
         if stale is not None:
             # ISSUE 6 satellite: PR 5 logged these, now they count
             self.node.metrics.record_counter("stale_epoch_rejected")
+            return stale
+        # per-pool fence (ISSUE 14): a verb stamped by a deposed POOL
+        # owner is rejected for that scope only — the cluster fence above
+        # is untouched, so the sender steps down per pool, not globally
+        stale = check_scoped(self.node.membership.scopes, msg.payload,
+                             self.node.host)
+        if stale is not None:
+            self.node.metrics.record_counter("stale_scope_rejected")
             return stale
         try:
             out = self._dispatch(msg.payload.get("verb", ""), msg.payload)
@@ -602,6 +610,14 @@ class ControlService:
             if spans is not None:
                 extra_g["span_buffer_depth"] = spans.depth()
                 extra_g["spans_recorded_total"] = spans.recorded_total()
+            fo = getattr(node, "failover", None)
+            if fo is not None:
+                # ISSUE 14 satellite: the PR-5 durability-gap counter
+                # (acked work whose write-ahead was skipped because the
+                # standby was down) joins the scrape; the per-pool
+                # adoption/replay counters ride the tracker's
+                # record_counter events automatically
+                extra_g["wal_skips"] = fo.wal_skips
             return {"text": node.metrics.prometheus_text(
                 node.host, extra_counters=retry_counters(),
                 extra_gauges=extra_g)}

@@ -63,6 +63,12 @@ class FailoverManager:
         # time, newest kept); applied on adopt for scaling actions the
         # newest snapshot predates (wal_scale / _handle / adopt)
         self._scale_wal: dict[str, dict[str, Any]] = {}
+        # standby-side per-pool journal deltas, pool → {"entry"} (the
+        # pool's full wire state at its per-pool wal_seq, newest kept):
+        # each managed pool's journal segment replicates independently,
+        # so adopting one pool's scope replays only that pool's WAL
+        # (wal_pool / _handle / adopt)
+        self._pool_wal: dict[str, dict[str, Any]] = {}
         transport.serve(SERVICE, self._handle)
         # front: the adoption (epoch mint) must land BEFORE reassignment
         # callbacks start re-dispatching, so nothing dispatches under the
@@ -191,6 +197,37 @@ class FailoverManager:
             return False
         return out is not None
 
+    def wal_pool(self, name: str, entry: dict[str, Any]) -> bool:
+        """Synchronous write-ahead for ONE managed pool's journal segment
+        (serve/lm_manager.py:_replicate_pool): ships the pool's full wire
+        entry at its per-pool monotone ``wal_seq`` so an admission or
+        terminal transition the acting master just journaled survives an
+        immediate death without waiting for the periodic full snapshot —
+        and so scoped adoption can replay exactly this pool's segment
+        while other pools' state is untouched. Same skip discipline as
+        wal_append/wal_scale: a dead standby never stalls the serving
+        path, but every skip is counted, never silent."""
+        standby = self.config.standby_coordinator
+        if standby == self.host or not self.membership.is_acting_master:
+            return False
+        if standby not in self.membership.members.alive_hosts():
+            self.wal_skips += 1
+            self.service.metrics.record_counter("wal_skipped_standby_down")
+            log.warning("wal_pool skipped for pool %s seq %s: standby %s "
+                        "not alive", name, entry.get("wal_seq"), standby)
+            return False
+        msg = Message(MessageType.METADATA, self.host,
+                      {"epoch": list(self.membership.epoch.view()),
+                       "pool_wal": {"name": str(name),
+                                    "entry": dict(entry)}})
+        try:
+            out = self.transport.call(standby, SERVICE, msg, timeout=2.0)
+        except TransportError:
+            return False
+        if reply_is_stale(self.membership.epoch, out):
+            return False
+        return out is not None
+
     # -- standby side ------------------------------------------------------
 
     def _handle(self, service: str, msg: Message) -> Message | None:
@@ -215,6 +252,14 @@ class FailoverManager:
                         <= int(d["decision"].get("seq", -1))):
                     self._scale_wal[d["group"]] = d
                 return Message(MessageType.ACK, self.host)
+            if "pool_wal" in msg.payload:   # per-pool journal delta
+                d = msg.payload["pool_wal"]
+                cur = self._pool_wal.get(d["name"])
+                if (cur is None
+                        or int(cur["entry"].get("wal_seq", -1))
+                        <= int(d["entry"].get("wal_seq", -1))):
+                    self._pool_wal[d["name"]] = d
+                return Message(MessageType.ACK, self.host)
             seq = int(msg.payload.get("seq", 0))
             if seq > self._received_seq:
                 self._received = msg.payload
@@ -229,6 +274,11 @@ class FailoverManager:
                     g: v for g, v in self._scale_wal.items()
                     if int((groups.get(g) or {}).get("next_seq", -1))
                     < int(v["decision"].get("seq", -1)) + 1}
+                pools = (msg.payload.get("lm") or {}).get("pools", {})
+                self._pool_wal = {
+                    n: v for n, v in self._pool_wal.items()
+                    if int((pools.get(n) or {}).get("wal_seq", -1))
+                    < int(v["entry"].get("wal_seq", -1))}
         return Message(MessageType.ACK, self.host)
 
     def _on_member_change(self, host: str, old: MemberStatus | None,
@@ -254,6 +304,7 @@ class FailoverManager:
             snap = self._received
             wal = dict(self._wal)
             scale_wal = {g: dict(d) for g, d in self._scale_wal.items()}
+            pool_wal = {n: dict(d) for n, d in self._pool_wal.items()}
         # the snapshot carries the deposed master's epoch: fold it into
         # the high-water mark FIRST so the mint lands strictly above
         # everything that master ever stamped
@@ -315,7 +366,23 @@ class FailoverManager:
                 # authoritative where their decision log is longer)
                 self.lm_manager.apply_scale_wal(scale_wal)
                 loaded = True
+            if pool_wal:
+                # per-pool journal segments WAL'd after the newest
+                # snapshot: replay per scope — a pool whose wal_seq moved
+                # past the snapshot gets exactly its own newer journal
+                replayed = self.lm_manager.apply_pool_wal(pool_wal)
+                if replayed:
+                    svc.metrics.record_counter("pool_wal_replayed",
+                                               replayed)
+                loaded = True
             if loaded:
+                # per-scope fences: mint a strictly-higher epoch for every
+                # adopted pool/group scope, so the deposed master's
+                # pool-directed stamps are rejected per pool — unrelated
+                # scopes (none here, but in general) keep their owner
+                for scope in self.lm_manager.scope_names():
+                    self.membership.scopes.fence(scope).mint(self.host)
+                    svc.metrics.record_counter("pool_scope_adopted")
                 self.lm_manager.on_adopt()
         if asp is not None:
             svc.spans.finish(

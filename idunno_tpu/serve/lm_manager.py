@@ -46,7 +46,9 @@ from typing import Any
 from idunno_tpu.comm.message import Message
 from idunno_tpu.comm.transport import Transport, TransportError
 from idunno_tpu.config import ClusterConfig
-from idunno_tpu.membership.epoch import StaleEpoch, reply_is_stale
+from idunno_tpu.membership.epoch import (StaleEpoch, StaleScope, pool_scope,
+                                         reply_is_stale, reply_stale_scope,
+                                         stamp_scoped)
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.serve.admission import PRIORITIES, shed_reason
 from idunno_tpu.serve.autoscaler import Autoscaler, AutoscalePolicy
@@ -195,16 +197,28 @@ class LMPoolManager:
         return min(alive, key=key)
 
     def _call(self, node: str, payload: dict[str, Any],
-              timeout: float = 30.0) -> dict[str, Any]:
+              timeout: float = 30.0,
+              scope: str | None = None) -> dict[str, Any]:
         """Control RPC to a node's LOCAL lm tier (``local``=True keeps the
         receiving dispatcher from routing back into its own manager).
         Stamped with this manager's epoch view: a node that has seen a
         higher epoch fences us with StaleEpoch (a TransportError subclass,
         so every catch-site treats it as transient — requests stay
         pending/journal-safe — while the observe demotes this node and the
-        pump stops on its next is_acting_master gate)."""
+        pump stops on its next is_acting_master gate).
+
+        ``scope`` (pool-directed mutating verbs) adds the per-pool fence
+        stamp beside the cluster stamp: a node that has seen a higher
+        epoch FOR THAT POOL rejects with a stale-scope reply — this
+        manager then steps down for the named scope only (dropping the
+        fenced pool/group registry entries) while every other pool keeps
+        serving; the StaleScope raise reaches catch-sites as an ordinary
+        transient, but the drop has already happened, so nothing
+        retries into the fence."""
         payload = dict(payload, local=True,
                        epoch=list(self.membership.epoch.view()))
+        if scope is not None:
+            stamp_scoped(self.membership.scopes, scope, payload)
         reply = self.transport.call(
             node, CONTROL, Message(MessageType.INFERENCE, self.host,
                                    payload), timeout=timeout)
@@ -214,9 +228,37 @@ class LMPoolManager:
             e, owner = self.membership.epoch.view()
             raise StaleEpoch(f"{node} fenced this manager: epoch {e} "
                              f"owned by {owner}", e, owner)
+        fenced = reply_stale_scope(self.membership.scopes, reply)
+        if fenced is not None:
+            # fence BEFORE raising: StaleScope subclasses TransportError,
+            # and most catch-sites swallow those as transient — the drop
+            # here is what guarantees no retry loop into the fence
+            self._fence_scope(fenced)
+            e, owner = self.membership.scopes.fence(fenced).view()
+            raise StaleScope(f"{node} fenced scope {fenced}: epoch {e} "
+                             f"owned by {owner}", fenced, e, owner)
         if reply.type is MessageType.ERROR:
             raise ValueError(f"{node}: {reply.payload.get('error')}")
         return reply.payload
+
+    def _fence_scope(self, scope: str) -> None:
+        """Step down for ONE fenced pool scope: drop its pools — and its
+        group, whose _ensure_group_replicas would otherwise re-serve the
+        replicas this manager no longer owns — from the local registry.
+        The scope's new owner adopted an at-least-as-new journal (per-pool
+        WAL), so keeping a fenced copy here would double-serve the pool.
+        Everything else — other pools/groups, train jobs, the CNN book,
+        cluster-wide mastership — is untouched: that isolation is the
+        point of the per-pool fence."""
+        with self._lock:
+            dropped = [n for n in self._pools if pool_scope(n) == scope]
+            for n in dropped:
+                del self._pools[n]
+            for n in [g for g in self._groups if pool_scope(g) == scope]:
+                del self._groups[n]
+                dropped.append(n)
+        if dropped and self.service is not None:
+            self.service.metrics.record_counter("pool_scope_fenced")
 
     # -- pools: client surface (acting master) -----------------------------
 
@@ -252,6 +294,10 @@ class LMPoolManager:
                      # back instead of double-journaling (replicated with
                      # the journal so the dedupe survives failover)
                      "idem": {},
+                     # per-pool WAL high-water: bumped on every
+                     # replicate-worthy journal mutation; the standby and
+                     # apply_pool_wal keep only strictly newer entries
+                     "wal_seq": 0,
                      "done_total": 0, "failed_total": 0,
                      "cancelled_total": 0,
                      "shed_total": 0, "expired_total": 0,
@@ -268,7 +314,8 @@ class LMPoolManager:
         try:
             node = self._place()
             out = self._call(node, dict(spec, verb="lm_serve"),
-                             timeout=self.build_rpc_timeout_s)
+                             timeout=self.build_rpc_timeout_s,
+                             scope=pool_scope(name))
         except BaseException:
             with self._lock:
                 # identity, not name: lm_stop + a re-serve may have
@@ -302,7 +349,7 @@ class LMPoolManager:
         into a dead outbox and hold device HBM indefinitely."""
         try:
             self._call(node, {"verb": "lm_stop", "name": name},
-                       timeout=10.0)
+                       timeout=10.0, scope=pool_scope(name))
         except (TransportError, ValueError, OSError):
             pass
 
@@ -400,6 +447,10 @@ class LMPoolManager:
             node = pool["node"]
         if node is not None:
             self._forward(name, node, rid, req)
+        # write-ahead the booking (and the forward's inflight/admitted
+        # commit) to the standby's per-pool WAL segment: an adoption right
+        # after this ack replays exactly this journal, per scope
+        self._replicate_pool(name)
         return rid
 
     def _forward(self, name: str, node: str, rid: int,
@@ -436,7 +487,7 @@ class LMPoolManager:
                        "attempt": int(req.get("attempts", 0))})
             stamp_trace(payload, fsp.ctx)
         try:
-            out = self._call(node, payload)
+            out = self._call(node, payload, scope=pool_scope(name))
         except (TransportError, OSError) as e:
             if fsp is not None:
                 self.spans.finish(fsp, error=type(e).__name__)
@@ -506,7 +557,8 @@ class LMPoolManager:
         if cancel_on_node:
             try:
                 self._call(node, {"verb": "lm_cancel", "name": name,
-                                  "id": int(out["id"])}, timeout=10.0)
+                                  "id": int(out["id"])}, timeout=10.0,
+                           scope=pool_scope(name))
             except (TransportError, ValueError, OSError):
                 pass              # best-effort: the row burns out on its own
 
@@ -604,10 +656,14 @@ class LMPoolManager:
             req["status"] = _CANCELLED
             req["node_id"] = None
             pool["cancelled_total"] += 1
+        # journal-terminal transition: write it ahead per pool so an
+        # adoption never replays a request the client was told is out
+        self._replicate_pool(name)
         if was_inflight and node is not None and node_id is not None:
             try:
                 self._call(node, {"verb": "lm_cancel", "name": name,
-                                  "id": int(node_id)}, timeout=10.0)
+                                  "id": int(node_id)}, timeout=10.0,
+                           scope=pool_scope(name))
             except (TransportError, ValueError, OSError):
                 pass          # best-effort: the row burns out on its own
         return {"cancelled": True}
@@ -737,7 +793,8 @@ class LMPoolManager:
             return {"stopped": False}
         if pool["node"] is not None:
             try:
-                self._call(pool["node"], {"verb": "lm_stop", "name": name})
+                self._call(pool["node"], {"verb": "lm_stop", "name": name},
+                           scope=pool_scope(name))
             except (TransportError, ValueError, OSError):
                 pass                    # node may already be dead
         return {"stopped": True}
@@ -1752,7 +1809,8 @@ class LMPoolManager:
             try:
                 out = self._call(node, dict(spec, verb="lm_serve",
                                             reload=True),
-                                 timeout=self.build_rpc_timeout_s)
+                                 timeout=self.build_rpc_timeout_s,
+                                 scope=pool_scope(name))
             except (TransportError, ValueError, OSError):
                 with self._lock:
                     if (self._pools.get(name) is entry
@@ -1845,9 +1903,12 @@ class LMPoolManager:
                 req["node_id"] = None
 
     def _drain(self, name: str, node: str) -> None:
+        # scoped: draining CONSUMES the node outbox (ownership transfers
+        # to the poller), so a deposed pool owner must be fenced here or
+        # it would steal completions the scope's new owner journals
         try:
             out = self._call(node, {"verb": "lm_poll", "name": name},
-                             timeout=10.0)
+                             timeout=10.0, scope=pool_scope(name))
         except (TransportError, ValueError, OSError):
             return
         if not (out.get("completions") or out.get("errors")):
@@ -1915,6 +1976,10 @@ class LMPoolManager:
                     if not c.get("cold_start"):
                         pool["svc_samples"].append((svc, max(new_toks, 1)))
                         del pool["svc_samples"][:-32]    # rolling window
+        # drained completions are unrecoverable from the node — write the
+        # terminal transitions ahead so an adoption between here and the
+        # next snapshot re-delivers instead of re-decoding
+        self._replicate_pool(name)
 
     # -- recovery ----------------------------------------------------------
 
@@ -1988,7 +2053,8 @@ class LMPoolManager:
             try:
                 node = self._place()
                 self._call(node, dict(spec, verb="lm_serve", reload=True),
-                           timeout=self.build_rpc_timeout_s)
+                           timeout=self.build_rpc_timeout_s,
+                           scope=pool_scope(name))
             except (TransportError, ValueError, OSError):
                 return                  # pump retries next period
             with self._lock:
@@ -2053,23 +2119,109 @@ class LMPoolManager:
 
     # -- failover replication ---------------------------------------------
 
+    @staticmethod
+    def _pool_wire(p: dict[str, Any]) -> dict[str, Any]:
+        """Wire form of one pool's registry entry + journal — the unit
+        the periodic snapshot AND the per-pool WAL replicate."""
+        return {"spec": dict(p["spec"]), "node": p["node"],
+                "next_rid": p["next_rid"],
+                "wal_seq": int(p.get("wal_seq", 0)),
+                "done_total": p["done_total"],
+                "failed_total": p["failed_total"],
+                "cancelled_total": p["cancelled_total"],
+                "shed_total": p["shed_total"],
+                "expired_total": p["expired_total"],
+                "svc_samples": [list(s) for s in p["svc_samples"]],
+                "slots_now": p["slots_now"],
+                "slots_cap": p["slots_cap"],
+                "idem": dict(p.get("idem", {})),
+                "requests": {str(rid): dict(r) for rid, r
+                             in p["requests"].items()}}
+
+    @staticmethod
+    def _pool_from_wire(p: dict[str, Any]) -> dict[str, Any]:
+        return {"spec": dict(p["spec"]), "node": p["node"],
+                "next_rid": int(p["next_rid"]),
+                "wal_seq": int(p.get("wal_seq", 0)),
+                "done_total": int(p.get("done_total", 0)),
+                "failed_total": int(p.get("failed_total", 0)),
+                "cancelled_total": int(p.get("cancelled_total", 0)),
+                "shed_total": int(p.get("shed_total", 0)),
+                "expired_total": int(p.get("expired_total", 0)),
+                "node_errors": [],
+                "svc_samples": [tuple(s) for s
+                                in p.get("svc_samples", ())],
+                "slots_now": int(p.get(
+                    "slots_now",
+                    p["spec"].get("slots", _default_slots()))),
+                "slots_cap": int(p.get(
+                    "slots_cap",
+                    p["spec"].get("slots", _default_slots()))),
+                "slots_target_prev": None,
+                "t_last_resize": 0.0,
+                "idem": {k: int(v) for k, v
+                         in p.get("idem", {}).items()},
+                # defaults first: a snapshot from an older master may
+                # predate the watchdog/measurement fields
+                "requests": {int(rid): {"t_forwarded": None,
+                                        "attempts": 0, "top_p": 1.0,
+                                        "top_k": 0,
+                                        "t_submitted": 0.0,
+                                        "tenant": "default",
+                                        "priority": "interactive",
+                                        "deadline_ms": None,
+                                        "admitted": False,
+                                        "trace": None, **dict(r)}
+                             for rid, r in p["requests"].items()}}
+
+    def _replicate_pool(self, name: str) -> None:
+        """Push the pool's full journal entry to the standby's per-pool
+        WAL segment (FailoverManager.wal_pool — the journal twin of the
+        scale WAL) between snapshots. ``wal_seq`` is the per-pool
+        monotone the standby's keep-newest and ``apply_pool_wal`` dedupe
+        on, so a replayed/duplicated delta collapses per scope."""
+        fo = self.failover
+        if fo is None:
+            return
+        with self._lock:
+            p = self._pools.get(name)
+            if p is None:
+                return
+            p["wal_seq"] = int(p.get("wal_seq", 0)) + 1
+            entry = self._pool_wire(p)
+        fo.wal_pool(name, entry)
+
+    def apply_pool_wal(self, deltas: dict[str, Any]) -> int:
+        """Adoption-time replay of per-pool WAL deltas (failover.py).
+        Each delta carries the pool's full wire entry at mutation time;
+        apply exactly those strictly newer (by wal_seq) than the adopted
+        snapshot's copy — one pool's fresher journal never disturbs
+        another's. Returns the number of pools replayed."""
+        n = 0
+        with self._lock:
+            for name, d in sorted(deltas.items()):
+                entry = d.get("entry")
+                if not entry:
+                    continue
+                cur = self._pools.get(name)
+                if (cur is None or int(cur.get("wal_seq", 0))
+                        < int(entry.get("wal_seq", 0))):
+                    self._pools[name] = self._pool_from_wire(entry)
+                    n += 1
+        return n
+
+    def scope_names(self) -> list[str]:
+        """Every pool fence scope this manager holds state for (replica
+        pools collapse into their group's scope) — the set a scoped
+        adoption mints strictly-higher epochs for."""
+        with self._lock:
+            return sorted({pool_scope(n) for n in self._pools}
+                          | {pool_scope(g) for g in self._groups})
+
     def to_wire(self) -> dict[str, Any]:
         with self._lock:
             return {
-                "pools": {n: {"spec": dict(p["spec"]), "node": p["node"],
-                              "next_rid": p["next_rid"],
-                              "done_total": p["done_total"],
-                              "failed_total": p["failed_total"],
-                              "cancelled_total": p["cancelled_total"],
-                              "shed_total": p["shed_total"],
-                              "expired_total": p["expired_total"],
-                              "svc_samples": [list(s) for s
-                                              in p["svc_samples"]],
-                              "slots_now": p["slots_now"],
-                              "slots_cap": p["slots_cap"],
-                              "idem": dict(p.get("idem", {})),
-                              "requests": {str(rid): dict(r) for rid, r
-                                           in p["requests"].items()}}
+                "pools": {n: self._pool_wire(p)
                           for n, p in self._pools.items()},
                 "jobs": {n: {"spec": dict(j["spec"]), "node": j["node"],
                              "stop_requested": bool(
@@ -2083,40 +2235,8 @@ class LMPoolManager:
 
     def load_wire(self, snap: dict[str, Any]) -> None:
         with self._lock:
-            self._pools = {
-                n: {"spec": dict(p["spec"]), "node": p["node"],
-                    "next_rid": int(p["next_rid"]),
-                    "done_total": int(p.get("done_total", 0)),
-                    "failed_total": int(p.get("failed_total", 0)),
-                    "cancelled_total": int(p.get("cancelled_total", 0)),
-                    "shed_total": int(p.get("shed_total", 0)),
-                    "expired_total": int(p.get("expired_total", 0)),
-                    "node_errors": [],
-                    "svc_samples": [tuple(s) for s
-                                    in p.get("svc_samples", ())],
-                    "slots_now": int(p.get(
-                        "slots_now",
-                        p["spec"].get("slots", _default_slots()))),
-                    "slots_cap": int(p.get(
-                        "slots_cap",
-                        p["spec"].get("slots", _default_slots()))),
-                    "slots_target_prev": None,
-                    "t_last_resize": 0.0,
-                    "idem": {k: int(v) for k, v
-                             in p.get("idem", {}).items()},
-                    # defaults first: a snapshot from an older master may
-                    # predate the watchdog/measurement fields
-                    "requests": {int(rid): {"t_forwarded": None,
-                                            "attempts": 0, "top_p": 1.0,
-                                            "top_k": 0,
-                                            "t_submitted": 0.0,
-                                            "tenant": "default",
-                                            "priority": "interactive",
-                                            "deadline_ms": None,
-                                            "admitted": False,
-                                            "trace": None, **dict(r)}
-                                 for rid, r in p["requests"].items()}}
-                for n, p in snap.get("pools", {}).items()}
+            self._pools = {n: self._pool_from_wire(p)
+                           for n, p in snap.get("pools", {}).items()}
             self._jobs = {
                 n: {"spec": dict(j["spec"]), "node": j["node"],
                     "stop_requested": bool(j.get("stop_requested")),
@@ -2127,23 +2247,26 @@ class LMPoolManager:
 
     def on_adopt(self) -> None:
         """Called by the failover manager when this standby becomes the
-        coordinator. Completions the old master drained from a pool but
-        had not yet replicated are unrecoverable from the node (its outbox
-        hands ownership to the poller), so conservatively requeue EVERY
-        unfinished request — pinned seeds make the replay token-exact and
-        the journal keeps exactly one record per request. Pools/jobs on
-        dead nodes are re-placed; both paths also retry from the pump."""
+        coordinator — per scope. A pool whose node is still ALIVE keeps
+        its inflight node-id mappings and keeps serving uninterrupted:
+        the per-pool WAL replicated its journal through the last terminal
+        transition, the node-side idempotency key
+        (``{name}:{rid}:{attempts}``) dedupes any re-forward, and the
+        watchdog (``_requeue_stale_locked``) token-exactly replays the
+        rare row whose drained completion the old master never
+        replicated. So adopting one pool's fence costs the OTHER pools
+        zero resubmission (the chaos cross-pool-isolation invariant).
+        Pools/jobs on dead nodes are orphaned — inflight requeued with
+        pinned seeds, exactly-once via the journal — and re-placed;
+        both paths also retry from the pump."""
         alive = set(self.membership.members.alive_hosts())
         with self._lock:
-            pool_names = list(self._pools)
-            for name in pool_names:
-                pool = self._pools[name]
-                if pool["node"] is not None and pool["node"] not in alive:
-                    pool["node"] = None
-                for req in pool["requests"].values():
-                    if req["status"] == _INFLIGHT:
-                        req["status"] = _PENDING
-                        req["node_id"] = None
+            pool_names = []
+            for name, pool in self._pools.items():
+                if pool["node"] is not None and pool["node"] in alive:
+                    continue            # scope keeps serving as-is
+                self._orphan_pool_locked(name)
+                pool_names.append(name)
             job_names = []
             for name, job in self._jobs.items():
                 if (job["node"] is not None and job["node"] not in alive

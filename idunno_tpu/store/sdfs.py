@@ -13,10 +13,17 @@ Re-architected:
 - Placement = first ``replication_factor`` *alive* hosts in ring order from
   the stable hash slot (`utils.py:48-55` semantics, minus the dead-host
   blind spot), plus the acting master's own copy (`:355-357`).
-- Master metadata is rebuilt from per-host inventories on failover instead
-  of trusting a lossy 1 Hz string broadcast (`:971-1011`). Deletes leave
-  versioned tombstones so a partitioned holder cannot resurrect a deleted
-  file at rebuild time; version numbers stay monotone across delete/re-put.
+- Recovery is ring-native: on a holder's death EVERY node scans its own
+  replicas and the surviving ring members push each affected key's versions
+  to the ring successors that joined the post-death set — no master
+  metadata drives the copy pass (the reference's `monitor_program`
+  re-replication, `:852-874`, walks master state instead). A new acting
+  master does NOT rebuild metadata from cluster-wide inventories on
+  failover; it resolves each key lazily on first touch by probing that
+  key's ring hosts (`_resolve`). Deletes leave versioned tombstones so a
+  partitioned holder cannot resurrect a deleted file at resolve time;
+  version numbers stay monotone across delete/re-put and across master
+  failover (the put path resolves before reserving).
 - Metadata locks are actually held (the reference's ``sdfs_lock`` never is —
   SURVEY.md §5), and network I/O happens *outside* them so one slow replica
   cannot serialize the master.
@@ -166,6 +173,11 @@ class FileStoreService:
         # repairs themselves run OFF the membership monitor loop
         self._repair_serial = threading.Lock()
         self._repair_threads: list[threading.Thread] = []
+        # full inventory sweeps performed (diagnostic surface only —
+        # failover no longer triggers one; ring repair + lazy per-key
+        # resolution replaced it, and tests pin this at 0 across a
+        # master takeover)
+        self.rebuilds = 0
         # SpanStore wired by serve/node.py; None = tracing off
         self.spans = None
         transport.serve(SERVICE, self._handle)
@@ -348,7 +360,19 @@ class FileStoreService:
                            {"files": self.local.files(),
                             "tombstones": self.local.tombstones()})
         name = msg.payload["name"]
+        if msg.type is MessageType.STAT:       # per-key inventory probe
+            return Message(MessageType.ACK, self.host,
+                           {"versions": self.local.files().get(name, []),
+                            "tombstone":
+                                self.local.tombstones().get(name, 0)})
         if msg.type is MessageType.PUT:        # replica push
+            if int(msg.payload["version"]) <= \
+                    self.local.tombstones().get(name, 0):
+                # a ring-repair push racing a delete must not resurrect a
+                # tombstoned version on this host; ACK so the pusher
+                # doesn't retry — the write is correctly a no-op
+                return Message(MessageType.ACK, self.host,
+                               {"tombstoned": True})
             self.local.write(name, int(msg.payload["version"]), msg.blob)
             return Message(MessageType.ACK, self.host)
         if msg.type is MessageType.GET:        # replica fetch
@@ -381,11 +405,12 @@ class FileStoreService:
         if msg.type is MessageType.DELETE:
             return self._master_delete(name)
         if msg.type is MessageType.LS:
+            self._snapshot_or_resolve(name)      # lazy-resolve on a miss
             with self._meta_lock:
                 hosts = sorted(self._locations.get(name, set()))
             return Message(MessageType.ACK, self.host, {"hosts": hosts})
         if msg.type is MessageType.STAT:
-            snap = self._snapshot(name)
+            snap = self._snapshot_or_resolve(name)
             if snap is None:
                 return self._err("file not found")
             version, holders = snap
@@ -412,6 +437,14 @@ class FileStoreService:
                 return Message(MessageType.ACK, self.host,
                                {"version": version, "hosts": hosts,
                                 "duplicate": True})
+            known = name in self._versions
+        if not known:
+            # fresh-master monotonicity: learn the key's surviving latest
+            # version (and newest tombstone) from its ring hosts BEFORE
+            # reserving, or a put routed to a just-adopted master would
+            # re-issue version numbers the old master already assigned
+            self._resolve(name)                  # network probes, no lock
+        with self._meta_lock:
             # monotone across delete/re-put so tombstones stay meaningful
             version = max(self._versions.get(name, 0),
                           self.local.tombstones().get(name, 0)) + 1
@@ -498,9 +531,66 @@ class FileStoreService:
                 return None
             return self._versions[name], set(self._locations.get(name, set()))
 
+    def _snapshot_or_resolve(self, name: str) -> tuple[int, set[str]] | None:
+        """Master-side metadata lookup with lazy per-key resolution on a
+        miss — the failover-time replacement for the full inventory
+        rebuild (a fresh master's first touch of each key probes only that
+        key's ring hosts)."""
+        snap = self._snapshot(name)
+        if snap is not None:
+            return snap
+        self._resolve(name)                      # network probes, no lock
+        return self._snapshot(name)
+
+    def _resolve(self, name: str) -> None:
+        """Lazy per-key metadata resolution: probe THIS key's ring hosts
+        (plus the coordinator chain, which holds the legacy master bonus
+        replica) for their local versions and newest tombstone, then
+        max-merge into master metadata. A key whose newest surviving
+        version is at or below the newest tombstone stays dead — delete
+        semantics survive failover without any cluster-wide sweep — and
+        the tombstone is adopted locally so a later re-put reserves past
+        it."""
+        alive = set(self.membership.members.alive_hosts())
+        targets = [h for h in ring_order(name, self.config.hosts)
+                   if h in alive][:self.config.replication_factor + 2]
+        for h in (self.config.coordinator, self.config.standby_coordinator,
+                  self.host):
+            if (h in alive or h == self.host) and h not in targets:
+                targets.append(h)
+        req = Message(MessageType.STAT, self.host,
+                      {"name": name, "internal": True,
+                       "epoch": list(self.membership.epoch.view())})
+        latest, tomb = 0, self.local.tombstones().get(name, 0)
+        holders: set[str] = set()
+        for h in targets:
+            if h == self.host:
+                vs = self.local.files().get(name, [])
+            else:
+                try:
+                    out = self.transport.call(h, SERVICE, req, timeout=10.0)
+                except TransportError:
+                    continue
+                if out is None or out.type is not MessageType.ACK:
+                    continue
+                vs = out.payload.get("versions", [])
+                tomb = max(tomb, int(out.payload.get("tombstone", 0)))
+            if vs:
+                latest = max(latest, max(int(v) for v in vs))
+                holders.add(h)
+        if latest <= tomb:
+            if tomb > self.local.tombstones().get(name, 0):
+                # adopt the newest tombstone so version numbers stay
+                # monotone when this master re-puts the deleted name
+                self.local.delete(name, tomb)
+            return
+        with self._meta_lock:
+            self._versions[name] = max(self._versions.get(name, 0), latest)
+            self._locations.setdefault(name, set()).update(holders)
+
     def _master_get(self, name: str, want: int | None = None,
                     trace: tuple | None = None) -> Message:
-        snap = self._snapshot(name)
+        snap = self._snapshot_or_resolve(name)
         if snap is None:
             return self._err("file not found")   # FILE_NOT_EXIST (`:443-448`)
         version, holders = snap
@@ -515,6 +605,14 @@ class FileStoreService:
                 attrs={"name": name, "version": version,
                        "holders": len(holders)})
         blob = self._fetch_version(name, version, holders)
+        if blob is None:
+            # the holder view may predate a ring repair (repair drivers
+            # don't report to the master) — re-probe this key's ring
+            # hosts once and retry the fetch against the fresh set
+            self._resolve(name)
+            snap = self._snapshot(name)
+            if snap is not None:
+                blob = self._fetch_version(name, version, snap[1])
         if fsp is not None:
             self.spans.finish(fsp, found=blob is not None)
         if blob is None:
@@ -523,7 +621,7 @@ class FileStoreService:
                        blob=blob)
 
     def _master_get_versions(self, name: str, k: int) -> Message:
-        snap = self._snapshot(name)
+        snap = self._snapshot_or_resolve(name)
         if snap is None:
             return self._err("file not found")
         latest, holders = snap
@@ -538,7 +636,7 @@ class FileStoreService:
                        blob=b"".join(parts))
 
     def _master_delete(self, name: str) -> Message:
-        snap = self._snapshot(name)
+        snap = self._snapshot_or_resolve(name)
         if snap is None:
             return self._err("file not found")
         version, _ = snap
@@ -561,35 +659,32 @@ class FileStoreService:
         return Message(MessageType.ACK, self.host)
 
     # ------------------------------------------------------------------ #
-    # failure handling: re-replication + metadata rebuild
+    # failure handling: ring-native re-replication
     # ------------------------------------------------------------------ #
 
     def _on_member_change(self, host: str, old: MemberStatus | None,
                           new: MemberStatus) -> None:
         if new is not MemberStatus.LEAVE:
             return
-        if not self.membership.is_acting_master:
-            return
+        # master metadata catch-up is synchronous and cheap (no I/O):
+        # just forget the dead holder — a fresh master resolves each
+        # key lazily instead of rebuilding, so failover never blocks
+        # on a cluster-wide inventory sweep
+        if self.membership.is_acting_master:
+            with self._meta_lock:
+                for hs in self._locations.values():
+                    hs.discard(host)
 
-        # fresh_master is decided HERE, synchronously: a client put that
-        # lands on the new master before the repair thread runs would
-        # populate _versions and suppress the rebuild — permanently losing
-        # every pre-failover file's metadata (and its re-replication)
-        with self._meta_lock:
-            fresh_master = not self._versions
-
-        # repair OFF the monitor loop: the metadata rebuild RPCs every
-        # alive host (10 s timeouts) and re-replication streams whole
-        # files (30 s timeouts per copy) — failure detection for other
-        # hosts must not stall behind either (same discipline as
-        # lm_manager/inference_service member-change handling). Repairs
-        # for successive deaths serialize on _repair_serial.
+        # repair OFF the monitor loop: re-replication streams whole files
+        # (30 s timeouts per copy) — failure detection for other hosts
+        # must not stall behind it (same discipline as lm_manager/
+        # inference_service member-change handling). Repairs for
+        # successive deaths serialize on _repair_serial. Unlike the
+        # master-driven reference (`mp4_machinelearning.py:852-874`),
+        # the ring repair runs on EVERY node over its own replicas.
         def _repair() -> None:
             with self._repair_serial:
-                if fresh_master:
-                    # just became master with empty metadata — rebuild
-                    self.rebuild_metadata()
-                self._rereplicate_after_loss(host)
+                self._ring_repair(host)
 
         th = threading.Thread(target=_repair, daemon=True,
                               name=f"{self.host}-sdfs-repair")
@@ -598,6 +693,65 @@ class FileStoreService:
         with self._meta_lock:
             self._repair_threads = [t for t in self._repair_threads
                                     if t.is_alive()] + [th]
+
+    def _ring_repair(self, dead: str) -> None:
+        """Successor-driven re-replication, per key, over THIS host's own
+        replicas. For each live local key whose ring replica set (first
+        ``replication_factor`` in ring order over the pre-death view)
+        contained the dead host, push every locally-held version to the
+        ring successors that joined the post-death set. No master
+        metadata is read and none is rebuilt — repair completes even
+        through a simultaneous coordinator failover, and the master
+        learns the new holders lazily via ``_resolve``. Every surviving
+        holder drives its own copy of the key (pushes are epoch-stamped
+        internal PUTs of immutable versions, so concurrent drivers
+        converge on identical bytes instead of conflicting)."""
+        alive_set = {h for h in self.membership.members.alive_hosts()
+                     if h != dead}
+        if not alive_set:
+            return
+        rf = self.config.replication_factor
+        tombs = self.local.tombstones()
+        for name, versions in sorted(self.local.files().items()):
+            if not versions or max(versions) <= tombs.get(name, 0):
+                continue                          # tombstoned — stay dead
+            ordered = ring_order(name, self.config.hosts)
+            old_set = [h for h in ordered
+                       if h in alive_set or h == dead][:rf]
+            if dead not in old_set:
+                continue                # this key lost no ring replica
+            new_set = [h for h in ordered if h in alive_set][:rf]
+            targets = [h for h in new_set
+                       if h not in old_set and h != self.host]
+            pushed = [t for t in targets
+                      if self._push_versions(name, versions, t)]
+            if pushed and self.membership.is_acting_master:
+                with self._meta_lock:
+                    if name in self._locations:
+                        self._locations[name].update(pushed)
+
+    def _push_versions(self, name: str, versions: list[int],
+                       target: str) -> bool:
+        """Stream this host's local versions of ``name`` to ``target``
+        (ring-repair data path); True if at least one version landed."""
+        pushed = False
+        for v in versions:
+            blob = self.local.read(name, v)
+            if blob is None:
+                continue
+            push = Message(MessageType.PUT, self.host,
+                           {"name": name, "version": int(v),
+                            "internal": True,
+                            "epoch": list(self.membership.epoch.view())},
+                           blob=blob)
+            try:
+                out = self.transport.call(target, SERVICE, push,
+                                          timeout=30.0)
+            except TransportError:
+                return pushed
+            if out is not None and out.type is MessageType.ACK:
+                pushed = True
+        return pushed
 
     def join_repair(self, timeout: float = 10.0) -> None:
         """Wait for in-flight death-event repairs (they run on background
@@ -611,10 +765,13 @@ class FileStoreService:
             th.join(timeout=max(0.0, deadline - _time.monotonic()))
 
     def rebuild_metadata(self) -> None:
-        """New acting master: reconstruct versions/locations by querying
-        every alive host's inventory + tombstones (replaces the reference's
-        lossy 1 Hz metadata broadcast for file state). A file is live iff
-        some replica's max version exceeds the newest tombstone."""
+        """Full inventory sweep: reconstruct versions/locations by querying
+        every alive host's inventory + tombstones. A file is live iff some
+        replica's max version exceeds the newest tombstone. NO LONGER runs
+        on failover (ring repair + lazy ``_resolve`` replaced it — tests
+        pin ``rebuilds`` at 0 across a master takeover); kept as a
+        diagnostic/administrative surface."""
+        self.rebuilds += 1
         req = Message(MessageType.STORE, self.host,
                       {"internal": True,
                        "epoch": list(self.membership.epoch.view())})
@@ -649,52 +806,3 @@ class FileStoreService:
                 self._versions[n] = max(self._versions.get(n, 0), v)
                 self._locations.setdefault(n, set()).update(locations[n])
 
-    def _rereplicate_after_loss(self, dead: str) -> None:
-        """Reference `monitor_program` re-replication (`:852-874`): for every
-        file the dead host held, stream a surviving copy to the next alive
-        ring host not already holding it."""
-        with self._meta_lock:
-            affected = []
-            for name, hs in self._locations.items():
-                if dead not in hs:
-                    continue
-                hs.discard(dead)
-                affected.append((name, set(hs)))
-        for name, holders in affected:            # I/O outside the lock
-            alive_holders = {h for h in holders
-                             if self.membership.members.is_alive(h)
-                             or h == self.host}
-            need = self.config.replication_factor - len(alive_holders)
-            if need <= 0:
-                continue
-            candidates = [h for h in ring_order(name, self.config.hosts)
-                          if h not in alive_holders
-                          and self.membership.members.is_alive(h)]
-            for target in candidates[:need]:
-                self._copy_all_versions(name, target, alive_holders)
-
-    def _copy_all_versions(self, name: str, target: str,
-                           holders: set[str]) -> None:
-        with self._meta_lock:
-            latest = self._versions.get(name, 0)
-        copied = False
-        for v in range(1, latest + 1):
-            blob = self._fetch_version(name, v, holders)
-            if blob is None:
-                continue
-            push = Message(MessageType.PUT, self.host,
-                           {"name": name, "version": v, "internal": True,
-                            "epoch": list(self.membership.epoch.view())},
-                           blob=blob)
-            try:
-                if target == self.host:
-                    self.local.write(name, v, blob)
-                    copied = True
-                elif self.transport.call(target, SERVICE, push,
-                                         timeout=30.0) is not None:
-                    copied = True
-            except TransportError:
-                return
-        if copied:
-            with self._meta_lock:
-                self._locations.setdefault(name, set()).add(target)

@@ -208,6 +208,71 @@ def test_autoscale_partition_mid_scale_out(tmp_path):
     assert summary["grp_acked"] >= 2
 
 
+def test_multi_pool_seeded_schedule_invariants(tmp_path):
+    """Two concurrent managed pools under the full seeded fault surface:
+    per-pool fence scopes, cross-pool delivery attribution, and the
+    ring-RF invariant all hold (ISSUE 14)."""
+    out = run_seeded_schedule(11, str(tmp_path), steps=40,
+                              multi_pool=True)
+    assert out["lm_acked"] + out["lmb_acked"] >= 2
+    assert out["hosts"] == 5
+
+
+def test_pool_fence_cross_pool_isolation(tmp_path):
+    """ISSUE 14 directed schedule: partition deposes pool A's fence owner
+    mid-stream while pool B keeps serving — pool B completes with ZERO
+    resubmission, and pool A replays exactly-once after the scoped
+    adoption (the per-pool journal replay covers only pool A's scope)."""
+    c = ChaosCluster(616, str(tmp_path), multi_pool=True)
+    c.pump_work()        # replication cycle: standby snapshot + pool WALs
+    # in-flight work on BOTH pools before the fault
+    for client in ("n2", "n3"):
+        c.op_lm(client)
+        c.op_lm_b(client)
+        c.pump_membership(waves=1)
+        c.pump_work()
+        c.record_fences()
+    # pool B's requests complete under the ORIGINAL master; snapshot its
+    # node-side submit count so post-adoption resubmission would show
+    mgrs0 = c.managers["n0"]
+    with mgrs0._lock:
+        b_node = mgrs0._pools[c.LM_POOL_B]["node"]
+        b_reqs0 = dict(mgrs0._pools[c.LM_POOL_B]["requests"])
+    b_next0 = c.controls[b_node]._loops[c.LM_POOL_B]["next"]
+    assert all(r["status"] == "done" for r in b_reqs0.values()), b_reqs0
+    # depose the master: the standby's scoped adoption mints BOTH pool
+    # fences (its manager journals both scopes) and replays each pool's
+    # journal independently
+    c.op_isolate("n0")
+    for _ in range(10):
+        c.pump_membership(waves=1)
+        c.pump_work()
+        c.record_fences()
+    assert c.members["n1"].is_acting_master
+    scopes1 = dict(c.members["n1"].scopes.view_all())
+    assert scopes1.get(f"pool:{c.LM_POOL}", [0])[0] >= 1
+    assert scopes1.get(f"pool:{c.LM_POOL_B}", [0])[0] >= 1
+    # new-lineage work on both pools, then converge + full invariants
+    for client in ("n2", "n4"):
+        c.op_lm(client)
+        c.op_lm_b(client)
+        c.pump_membership(waves=1)
+        c.pump_work()
+        c.record_fences()
+    c.converge()
+    summary = c.check_invariants()
+    assert summary["final_master"] == "n1"
+    assert not c.violations
+    # zero resubmission into pool B's node tier: every pre-fault pool-B
+    # request was already done, so the adopted journal re-forwards
+    # nothing — the node-side rid counter moved only for NEW submissions
+    b_next1 = c.controls[b_node]._loops[c.LM_POOL_B]["next"]
+    assert b_next1 - b_next0 == summary["lmb_acked"] - len(b_reqs0)
+    # both pool scopes minted exactly once, by the adopter
+    assert summary["pool_epochs"][f"pool:{c.LM_POOL}"] >= 1
+    assert summary["pool_epochs"][f"pool:{c.LM_POOL_B}"] >= 1
+
+
 def test_invariant_trip_snapshots_span_dump(tmp_path):
     """Chaos-causal dumps: when any invariant trips, `check_invariants`
     snapshots every host's span window BEFORE re-raising, so the failing

@@ -19,7 +19,7 @@ import pytest
 
 from idunno_tpu.comm.message import Message
 from idunno_tpu.config import ClusterConfig
-from idunno_tpu.membership.epoch import EpochFence
+from idunno_tpu.membership.epoch import EpochFence, FenceRegistry
 from idunno_tpu.engine.generate import generate
 from idunno_tpu.engine.serve_lm import DecodeServer
 from idunno_tpu.models.transformer import TransformerLM
@@ -148,6 +148,7 @@ class FakeMembership:
         self.is_acting_master = True
         self.members = SimpleNamespace(alive_hosts=lambda: list(hosts))
         self.epoch = EpochFence()
+        self.scopes = FenceRegistry()
         self._hosts = hosts
 
     def on_change(self, cb):
@@ -286,7 +287,7 @@ def test_cancel_and_partial_verbs_over_rpc(lm, tmp_path):
 
     node = type("NodeStub", (), {})()
     # minimal fence surface for ControlService._handle's epoch check
-    node.membership = SimpleNamespace(epoch=EpochFence())
+    node.membership = SimpleNamespace(epoch=EpochFence(), scopes=FenceRegistry())
     node.host, node.store, node.transport = "n0", store, transport
     ctl = ControlService(node)
 

@@ -20,7 +20,7 @@ from idunno_tpu.engine.generate import generate
 from idunno_tpu.engine.train import flat_tx
 from idunno_tpu.engine.train_lm import (
     create_lm_train_state, make_lm_train_step)
-from idunno_tpu.membership.epoch import EpochFence
+from idunno_tpu.membership.epoch import EpochFence, FenceRegistry
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.models.transformer import TransformerLM
 from idunno_tpu.store.sdfs import FileStoreService
@@ -75,7 +75,7 @@ def test_lm_served_through_cluster_control(stores, tmp_path):
     # serve over the control RPC from a node wired to n2's store
     node = type("NodeStub", (), {})()
     # minimal fence surface for ControlService._handle's epoch check
-    node.membership = SimpleNamespace(epoch=EpochFence())
+    node.membership = SimpleNamespace(epoch=EpochFence(), scopes=FenceRegistry())
     node.host, node.store = "n2", stores["n2"]
     node.transport = stores["n2"].transport
     ctl = ControlService(node)
@@ -224,7 +224,7 @@ def test_continuous_batching_served_over_control_rpc(stores):
 
     node = type("NodeStub", (), {})()
     # minimal fence surface for ControlService._handle's epoch check
-    node.membership = SimpleNamespace(epoch=EpochFence())
+    node.membership = SimpleNamespace(epoch=EpochFence(), scopes=FenceRegistry())
     node.host, node.store = "n2", stores["n2"]
     node.transport = stores["n2"].transport
     ctl = ControlService(node)
@@ -316,7 +316,7 @@ def test_speculative_pool_over_rpc(stores):
 
     node = type("NodeStub", (), {})()
     # minimal fence surface for ControlService._handle's epoch check
-    node.membership = SimpleNamespace(epoch=EpochFence())
+    node.membership = SimpleNamespace(epoch=EpochFence(), scopes=FenceRegistry())
     node.host, node.store = "n1", stores["n1"]
     node.transport = stores["n1"].transport
     ctl = ControlService(node)
@@ -376,7 +376,7 @@ def test_train_job_over_rpc_then_serve(stores):
 
     node = type("NodeStub", (), {})()
     # minimal fence surface for ControlService._handle's epoch check
-    node.membership = SimpleNamespace(epoch=EpochFence())
+    node.membership = SimpleNamespace(epoch=EpochFence(), scopes=FenceRegistry())
     node.host, node.store = "n1", stores["n1"]
     node.transport = stores["n1"].transport
     ctl = ControlService(node)
@@ -631,7 +631,7 @@ def test_int8_kv_cache_pool_over_rpc(stores):
 
     node = type("NodeStub", (), {})()
     # minimal fence surface for ControlService._handle's epoch check
-    node.membership = SimpleNamespace(epoch=EpochFence())
+    node.membership = SimpleNamespace(epoch=EpochFence(), scopes=FenceRegistry())
     node.host, node.store = "n1", stores["n1"]
     node.transport = stores["n1"].transport
     ctl = ControlService(node)
@@ -685,7 +685,7 @@ def test_bad_kv_cache_dtype_does_not_kill_live_pool(stores):
 
     node = type("NodeStub", (), {})()
     # minimal fence surface for ControlService._handle's epoch check
-    node.membership = SimpleNamespace(epoch=EpochFence())
+    node.membership = SimpleNamespace(epoch=EpochFence(), scopes=FenceRegistry())
     node.host, node.store = "n1", stores["n1"]
     node.transport = stores["n1"].transport
     ctl = ControlService(node)

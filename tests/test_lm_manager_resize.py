@@ -14,7 +14,7 @@ import pytest
 
 from idunno_tpu.comm.message import Message
 from idunno_tpu.config import ClusterConfig
-from idunno_tpu.membership.epoch import EpochFence
+from idunno_tpu.membership.epoch import EpochFence, FenceRegistry
 from idunno_tpu.scheduler.fair import FairScheduler
 from idunno_tpu.serve.lm_manager import LMPoolManager
 from idunno_tpu.utils.types import MessageType
@@ -49,6 +49,7 @@ class FakeMembership:
         self.is_acting_master = True
         self.members = SimpleNamespace(alive_hosts=lambda: list(hosts))
         self.epoch = EpochFence()
+        self.scopes = FenceRegistry()
         self._hosts = hosts
 
     def on_change(self, cb):

@@ -1,0 +1,162 @@
+"""Per-pool fence epochs + journals (ISSUE 14): scoped stamps and typed
+scoped rejection, scope-view gossip on the membership plane, and the
+manager-side scope fencing + per-pool WAL that make adopting one pool's
+journal invisible to every other pool. The end-to-end deposal schedule
+lives in tests/test_chaos.py (test_pool_fence_cross_pool_isolation)."""
+from __future__ import annotations
+
+import pytest
+
+from idunno_tpu.chaos import ChaosCluster
+from idunno_tpu.comm.inproc import InProcNetwork
+from idunno_tpu.config import ClusterConfig
+from idunno_tpu.membership.epoch import (EpochFence, FenceRegistry,
+                                         StaleScope, check_scoped,
+                                         pool_scope, reply_is_stale,
+                                         reply_stale_scope, stamp_scoped)
+from idunno_tpu.membership.service import MembershipService
+from idunno_tpu.utils.types import MessageType
+
+from tests.test_membership import FakeClock, pump
+
+
+def test_pool_scope_groups_replicas():
+    assert pool_scope("chat") == "pool:chat"
+    # replica-group members share their group's scope: the group journal
+    # and scale WAL fence as one ownership unit
+    assert pool_scope("grp@r0") == "pool:grp"
+    assert pool_scope("grp@r17") == "pool:grp"
+    # only the LAST @r suffix is the replica marker
+    assert pool_scope("a@r1@r2") == "pool:a@r1"
+
+
+def test_registry_scopes_are_independent():
+    reg = FenceRegistry()
+    assert reg.fence("pool:a").mint("n1") == 1
+    assert reg.fence("pool:a").view() == (1, "n1")
+    assert reg.fence("pool:b").view() == (0, None)   # untouched
+    assert reg.scopes() == ["pool:a", "pool:b"]
+    # bootstrap scopes carry no fencing information and don't gossip
+    assert reg.view_all() == {"pool:a": [1, "n1"]}
+    other = FenceRegistry()
+    other.observe_all(reg.view_all())
+    assert other.fence("pool:a").view() == (1, "n1")
+    other.fence("pool:a").observe(0, "stale")        # lower: ignored
+    assert other.fence("pool:a").view() == (1, "n1")
+    other.observe_all(None)                          # unstamped gossip ok
+
+
+def test_scoped_stamp_check_roundtrip():
+    sender, receiver = FenceRegistry(), FenceRegistry()
+    payload = stamp_scoped(sender, "pool:a", {"verb": "lm_submit"})
+    assert payload["scope_epoch"] == ["pool:a", 0, None]
+    assert check_scoped(receiver, payload, "n2") is None  # bootstrap passes
+    # receiver saw a higher epoch for the scope: the stale stamp is
+    # rejected with a typed stale_scope ERROR naming the scope
+    receiver.fence("pool:a").mint("n1")
+    out = check_scoped(receiver, payload, "n2")
+    assert out is not None and out.type is MessageType.ERROR
+    assert out.payload["stale_scope"] == "pool:a"
+    assert out.payload["scope_epoch"] == ["pool:a", 1, "n1"]
+    # ...and it is NOT a cluster-wide stale_epoch: a pool-level deposal
+    # must never demote the sender's cluster fence through reply_is_stale
+    assert "stale_epoch" not in out.payload
+    cluster = EpochFence()
+    assert not reply_is_stale(cluster, out)
+    assert cluster.view() == (0, None)
+    # sender-side: reply_stale_scope names the scope AND observes the
+    # rejecting peer's higher view so the caller steps down per pool
+    assert reply_stale_scope(sender, out) == "pool:a"
+    assert sender.fence("pool:a").view() == (1, "n1")
+    # unrelated scopes keep passing
+    pb = stamp_scoped(sender, "pool:b", {"verb": "lm_submit"})
+    assert check_scoped(receiver, pb, "n2") is None
+
+
+def test_unstamped_payloads_always_pass():
+    reg = FenceRegistry()
+    reg.fence("pool:a").mint("n1")
+    assert check_scoped(reg, {"verb": "lm_poll"}, "n2") is None
+    assert check_scoped(reg, None, "n2") is None
+    assert reply_stale_scope(reg, None) is None
+
+
+def test_scope_views_ride_membership_gossip():
+    cfg = ClusterConfig(hosts=("n0", "n1", "n2"), coordinator="n0",
+                        standby_coordinator="n1", introducer="n0")
+    net = InProcNetwork()
+    clock = FakeClock()
+    members = {h: MembershipService(h, cfg, net.transport(h), clock=clock)
+               for h in cfg.hosts}
+    for h in cfg.hosts:
+        members[h].join()
+        clock.advance(0.01)
+    pump(members, clock)
+    # a non-master mints a pool scope (as an adopting standby would);
+    # one PONG carries it to the master, the next ping wave spreads it
+    members["n1"].scopes.fence("pool:chat").mint("n1")
+    pump(members, clock, waves=2)
+    for h in cfg.hosts:
+        assert members[h].scopes.view_all() == \
+            {"pool:chat": [1, "n1"]}, h
+    # a rejoiner that lost its fence state re-learns every scope from
+    # the JOIN ack before it could ever act on a stale view
+    members["n2"].scopes = FenceRegistry()
+    members["n2"].join()
+    assert members["n2"].scopes.view_all() == {"pool:chat": [1, "n1"]}
+
+
+def test_manager_fences_one_scope_only(tmp_path):
+    """A stale-scope rejection drops the named scope's pools/groups from
+    the deposed manager — other pools keep serving untouched, and the
+    cluster fence never moves."""
+    c = ChaosCluster(42, str(tmp_path), multi_pool=True)
+    mgr = c.managers["n0"]
+    scope_a = f"pool:{c.LM_POOL}"
+    assert mgr.scope_names() == sorted([scope_a, f"pool:{c.LM_POOL_B}"])
+    # a peer that saw a higher epoch for pool A's scope rejects the
+    # manager's next scoped call; the manager fences pool A only
+    target = next(h for h in c.cfg.hosts if h != "n0")
+    c.members[target].scopes.fence(scope_a).mint("n1")
+    with pytest.raises(StaleScope) as ei:
+        mgr._call(target, {"verb": "lm_qos", "name": c.LM_POOL,
+                           "local": True}, scope=scope_a)
+    assert ei.value.scope == scope_a
+    assert ei.value.epoch == 1 and ei.value.owner == "n1"
+    with mgr._lock:
+        assert c.LM_POOL not in mgr._pools          # fenced scope dropped
+        assert c.LM_POOL_B in mgr._pools            # other pool untouched
+    assert mgr.scope_names() == [f"pool:{c.LM_POOL_B}"]
+    # the deposed manager observed the scope's higher view...
+    assert c.members["n0"].scopes.fence(scope_a).view() == (1, "n1")
+    # ...but its CLUSTER fence is untouched: pool deposal is not deposal
+    assert c.members["n0"].epoch.view() == (0, None)
+    assert c.members["n0"].is_acting_master
+
+
+def test_pool_wal_mirrors_and_applies_by_seq(tmp_path):
+    """The per-pool WAL write-ahead lands on the standby with the pool's
+    wal_seq high-water; apply keeps the newest entry and ignores stale
+    replays (adoption replays each pool's journal independently)."""
+    c = ChaosCluster(43, str(tmp_path))
+    # a submit write-aheads the pool journal to the standby
+    c._client_control("n2", {"verb": "lm_submit", "name": c.LM_POOL,
+                             "prompt": [1, 2, 3], "max_new": 4,
+                             "seed": 1}, idem="n2:w1")
+    fo1 = c.failovers["n1"]
+    assert c.LM_POOL in fo1._pool_wal
+    entry = fo1._pool_wal[c.LM_POOL]["entry"]
+    assert entry["wal_seq"] >= 1
+    assert entry["requests"]            # the journaled request rode along
+    # newest-wins apply on a fresh manager
+    dst = c.managers["n2"]
+    newer = dict(entry, wal_seq=int(entry["wal_seq"]) + 5)
+    assert dst.apply_pool_wal({c.LM_POOL: {"entry": newer}}) == 1
+    with dst._lock:
+        assert dst._pools[c.LM_POOL]["wal_seq"] == \
+            int(entry["wal_seq"]) + 5
+    stale = dict(entry, wal_seq=0)
+    assert dst.apply_pool_wal({c.LM_POOL: {"entry": stale}}) == 0
+    with dst._lock:
+        assert dst._pools[c.LM_POOL]["wal_seq"] == \
+            int(entry["wal_seq"]) + 5

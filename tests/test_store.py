@@ -118,6 +118,38 @@ def test_rereplication_after_holder_death(cluster):
     assert blob == b"keep me" and v == 1
 
 
+def test_ring_repair_restores_rf_per_version_without_rebuild(cluster):
+    """ISSUE 14 regression: a dead replica's keys are re-replicated by the
+    surviving ring holders per key (successor-driven), restoring
+    replication_factor for EVERY stored version — with NO master metadata
+    rebuild involved."""
+    cfg, net, clock, members, stores = cluster
+    stores["n2"].put_bytes("multi.bin", b"v1")
+    stores["n3"].put_bytes("multi.bin", b"v2")
+    stores["n2"].put_bytes("other.bin", b"solo")
+    holders = set(stores["n2"].ls("multi.bin"))
+    victim = next(h for h in holders if h not in ("n0", "n1"))
+    net.kill(victim)
+    pump(members, clock, waves=8, dt=0.3)
+    members["n0"].monitor_once()   # master marks the victim LEAVE...
+    pump(members, clock, waves=2)  # ...gossip fires every survivor's repair
+    alive = {h for h in cfg.hosts if h != victim}
+    for h in alive:
+        stores[h].join_repair()    # repairs run on background threads
+    # every version of every key is back at full replication on the ring
+    for name, want_versions in (("multi.bin", (1, 2)), ("other.bin", (1,))):
+        for v in want_versions:
+            have = {h for h in alive
+                    if v in stores[h].local_files().get(name, [])}
+            assert len(have) >= cfg.replication_factor, (name, v, have)
+    # successor-driven repair never touched the metadata-rebuild path
+    assert all(stores[h].rebuilds == 0 for h in alive)
+    blob, v = stores["n4"].get_bytes("multi.bin")
+    assert blob == b"v2" and v == 2
+    out = stores["n4"].ls("multi.bin")
+    assert victim not in out and len(out) >= cfg.replication_factor
+
+
 def test_master_failover_preserves_files(cluster):
     cfg, net, clock, members, stores = cluster
     stores["n2"].put_bytes("survivor.txt", b"before failover")
@@ -125,11 +157,12 @@ def test_master_failover_preserves_files(cluster):
     pump(members, clock, waves=8, dt=0.3)
     members["n1"].monitor_once()        # standby notices, takes over
     assert members["n1"].is_acting_master
-    stores["n1"].join_repair()          # rebuild runs on a background thread
+    stores["n1"].join_repair()          # repair runs on a background thread
     pump(members, clock, waves=2)
-    # new master rebuilt metadata from inventories; reads still work
+    # new master resolves lazily per key — no metadata rebuild on failover
     blob, v = stores["n3"].get_bytes("survivor.txt")
     assert blob == b"before failover" and v == 1
+    assert stores["n1"].rebuilds == 0
     # and writes go to the new master
     v2 = stores["n4"].put_bytes("survivor.txt", b"after failover")
     assert v2 == 2

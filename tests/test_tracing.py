@@ -302,6 +302,15 @@ def test_trace_survives_failover_adoption(tmp_path):
     assert c.managers["n0"].trace_of(c.LM_POOL, rid) == root.trace_id
     c.pump_membership(waves=1)
     c.pump_work()                       # journal reaches the standby
+    # a second submit lands AFTER the snapshot replication above: its
+    # synchronous write-ahead makes pool A's WAL strictly newer than the
+    # replicated snapshot, so adoption must REPLAY the pool journal
+    # segment (counter asserted below), not just load the snapshot
+    c.lm_attempted.append({"serial": 2, "prompt": [9, 9, 9],
+                           "seed": 9, "max_new": 4})
+    c._client_control("n3", {"verb": "lm_submit", "name": c.LM_POOL,
+                             "prompt": [9, 9, 9], "max_new": 4,
+                             "seed": 9}, idem="n3:tr3")
     c.op_isolate("n0")
     for _ in range(10):                 # push past the suspicion timeout
         c.pump_membership(waves=1)
@@ -327,6 +336,13 @@ def test_trace_survives_failover_adoption(tmp_path):
     booked = [s for s in c.spans["n1"].dump(trace_id=root2.trace_id)
               if s["name"] == "lm.submit"]
     assert booked and booked[0]["node"] == "n1"
+    # ISSUE 14: the per-pool adoption/replay counters land on the new
+    # owner's metrics plane and ride the same Prometheus exposition
+    text = c.services["n1"].metrics.prometheus_text("n1")
+    assert 'idunno_events_total{node="n1",name="pool_scope_adopted"}' \
+        in text
+    assert 'idunno_events_total{node="n1",name="pool_wal_replayed"}' \
+        in text
     c.converge()
     c.check_invariants()
 
@@ -459,6 +475,9 @@ def test_two_node_cluster_collects_lm_trace(tmp_path):
         assert 'node="n0"' in text and "span_buffer_depth" in text
         assert 'name="n_model"' in text
         assert 'name="tp_collective_bytes"' in text
+        # PR-5 durability-gap counter joins the scrape (ISSUE 14): acked
+        # work whose write-ahead was skipped because the standby was down
+        assert 'idunno_gauge{node="n0",name="wal_skips"}' in text
         remote = _call(nodes["n0"], {"verb": "metrics_export",
                                      "host": "n1"})["text"]
         assert 'node="n1"' in remote

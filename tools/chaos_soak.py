@@ -2,11 +2,14 @@
 
 Same contract as bench.py: exactly one JSON object on stdout regardless of
 outcome, so a cron/CI wrapper can append it to a ledger. Each schedule is
-an independent `idunno_tpu.chaos.run_seeded_schedule` (full 5-host cluster,
-seeded drop/dup/delay + partitions/isolations, convergence + invariant
-check); a schedule that trips an invariant is recorded, not raised.
+an independent `idunno_tpu.chaos.run_seeded_schedule` (full in-process
+cluster — 5 hosts by default, 50-100 via `--hosts` for the sharded
+control-plane certification — seeded drop/dup/delay + partitions/
+isolations, convergence + invariant check); a schedule that trips an
+invariant is recorded, not raised.
 
     python tools/chaos_soak.py --schedules 25 --steps 40 --seed0 1
+    python tools/chaos_soak.py --schedules 20 --hosts 50   # large cluster
 """
 from __future__ import annotations
 
@@ -39,6 +42,14 @@ def main() -> int:
     # overload→underload pressure makes the loop spawn AND retire under
     # the fault surface; the scaling journal joins the invariant checks
     ap.add_argument("--autoscale", type=int, default=1)
+    # cluster size per schedule (ISSUE 14): the sharded control plane is
+    # certified at 50-100 hosts with `--hosts 50`; default stays 5 so
+    # the fast soak keeps its historical runtime
+    ap.add_argument("--hosts", type=int, default=5)
+    # second concurrent managed pool from schedule 2 on (0 disables):
+    # per-pool fence scopes + cross-pool isolation under the fault
+    # surface (schedules 0/1 keep their single-feature seeds replayable)
+    ap.add_argument("--multi-pool", type=int, default=1)
     # lint preflight on by default: a wall-clock/rng draw in a chaos-
     # reachable module makes every printed seed unreplayable, so soaking
     # such a tree produces failure records nobody can debug
@@ -62,8 +73,9 @@ def main() -> int:
     passed, failures = 0, []
     worst_convergence = 0.0
     epochs_total = 0
-    work = {"cnn_acked": 0, "lm_acked": 0, "sdfs_acked": 0,
-            "spans_recorded": 0}
+    pool_epochs: dict[str, int] = {}
+    work = {"cnn_acked": 0, "lm_acked": 0, "lmb_acked": 0,
+            "sdfs_acked": 0, "spans_recorded": 0}
     for i in range(args.schedules):
         seed = args.seed0 + i
         try:
@@ -81,7 +93,11 @@ def main() -> int:
                     # second schedule runs the autoscaled replica group
                     # (ISSUE 11) — separate from schedule 0 so each
                     # feature's faults replay in isolation by seed
-                    autoscale=bool(args.autoscale) and i == 1)
+                    autoscale=bool(args.autoscale) and i == 1,
+                    # schedules 2+ run TWO concurrent managed pools
+                    # (ISSUE 14): per-pool fences + cross-pool isolation
+                    multi_pool=bool(args.multi_pool) and i >= 2,
+                    n_hosts=args.hosts)
         except Exception as e:  # noqa: BLE001 - invariant trip is data
             rec = {"seed": seed, "error":
                    f"{type(e).__name__}: {e}"[:300]}
@@ -99,13 +115,16 @@ def main() -> int:
         passed += 1
         worst_convergence = max(worst_convergence, out["convergence_s"])
         epochs_total += out["epochs"]
+        for scope, e in out.get("pool_epochs", {}).items():
+            pool_epochs[scope] = max(pool_epochs.get(scope, 0), int(e))
         for k in work:
-            work[k] += out[k]
+            work[k] += out.get(k, 0)
     print(json.dumps({
         "suite": "chaos_soak", "schedules": args.schedules,
-        "steps": args.steps, "passed": passed,
+        "steps": args.steps, "hosts": args.hosts, "passed": passed,
         "violations": failures,
         "epochs_minted_total": epochs_total,
+        "pool_epochs": pool_epochs,
         "worst_convergence_s": round(worst_convergence, 3),
         **work}))
     return 0 if not failures else 1
