@@ -158,12 +158,28 @@ IDEM_VERBS = (
         # standby side keeps only the strictly newest per-pool entry
         ("idunno_tpu/serve/failover.py", "FailoverManager._handle",
          "pool_wal"),
+        # delta frames merge only onto the exact acked base_seq; any gap
+        # NACKs need_full and the sender re-ships the full entry
+        ("idunno_tpu/serve/failover.py",
+         "FailoverManager._merge_pool_delta_locked", "base_seq"),
         # adoption-time replay compares the per-pool monotone wal_seq
         ("idunno_tpu/serve/lm_manager.py", "LMPoolManager.apply_pool_wal",
          "wal_seq"),),
         why="per-pool WAL entries carry a monotone per-pool wal_seq; a "
             "duplicated or replayed delta collapses because receivers "
-            "keep only strictly newer entries per pool scope"),
+            "keep only strictly newer entries per pool scope, and a "
+            "delta frame applies only on its exact acked base"),
+    IdemVerb("pool_assign", "natural", anchors=(
+        # the acting master hands a pool spec to its placed scope owner
+        # by re-sending lm_serve with placement="assign"; the owner's
+        # manager absorbs duplicates as a named resource
+        ("idunno_tpu/serve/control.py",
+         "ControlService._route_cluster", "assign"),
+        ("idunno_tpu/serve/lm_manager.py", "LMPoolManager.serve",
+         "already"),),
+        why="pools are a named resource on the owner too: a replayed "
+            "assign finds the live pool (or its _Starting reservation) "
+            "and returns already=True instead of a second build"),
 )
 
 GUARDED = (
@@ -171,13 +187,15 @@ GUARDED = (
           ("_lm_loops", "_train_jobs", "_lm_idem")),
     Guard("idunno_tpu/serve/failover.py", "FailoverManager", "_lock",
           ("_seq", "_received", "_received_seq", "_wal", "_scale_wal",
-           "_pool_wal")),
+           "_pool_wal", "_pool_wal_bytes")),
+    Guard("idunno_tpu/membership/epoch.py", "ScopeOwners", "_lock",
+          ("_map",)),
     Guard("idunno_tpu/serve/inference_service.py", "InferenceService",
           "_results_lock", ("_results", "_qnum", "_idem")),
     Guard("idunno_tpu/serve/inference_service.py", "InferenceService",
           "_jobs_lock", ("_jobs", "_pending_results")),
     Guard("idunno_tpu/serve/lm_manager.py", "LMPoolManager", "_lock",
-          ("_pools", "_jobs", "_groups")),
+          ("_pools", "_jobs", "_groups", "_wal_shipped")),
     Guard("idunno_tpu/store/sdfs.py", "FileStoreService", "_meta_lock",
           ("_put_idem", "_versions")),
 )

@@ -16,6 +16,10 @@ Invariants (``ChaosCluster.check_invariants`` after ``converge``):
 - at most one owner per POOL-SCOPE epoch (ISSUE 14: per-pool fences are
   sampled from every host's scope registry exactly like the cluster
   fence — two owners for one (scope, epoch) = per-pool split brain);
+- at most one ownership CLAIMANT per (scope, claim seq) across every
+  host's gossiped map, the first claim on each scope lands exactly on
+  its rendezvous placement, and with a second pool the owners spread
+  over more than one host (ISSUE 15: multi-owner control plane);
 - zero stale-epoch messages ACCEPTED anywhere (a transport-level probe
   snapshots each receiver's fence before the handler runs: a stamped
   payload below that high-water mark must produce an ERROR, never an
@@ -48,7 +52,9 @@ from idunno_tpu.comm.message import Message
 from idunno_tpu.comm.retry import call_with_retry
 from idunno_tpu.comm.transport import TransportError
 from idunno_tpu.config import ClusterConfig
-from idunno_tpu.membership.epoch import check_payload, check_scoped
+from idunno_tpu.membership.epoch import (check_payload, check_scoped,
+                                         observe_payload, place_scope,
+                                         pool_scope)
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.scheduler.fair import FairScheduler
 from idunno_tpu.serve.failover import FailoverManager
@@ -106,11 +112,25 @@ def lm_tokens(prompt: list[int], seed: int, max_new: int) -> list[int]:
                            for i in range(max_new)]
 
 
+class _RelayedError(Exception):
+    """An ERROR reply from a forwarded owner hop, relayed verbatim: the
+    payload keeps its typed markers (``stale_epoch``, ``scope_owner``)
+    so the CLIENT's retry logic still sees them through the proxy."""
+
+    def __init__(self, payload: dict) -> None:
+        super().__init__(payload.get("error", "relayed error"))
+        self.payload = dict(payload)
+
+
 class ChaosControl:
     """Per-host control-verb handler: cluster routing + a fake node-local
     LM tier, mirroring `serve/control.py` (epoch fence at the top,
-    deposed-master refusal on managed verbs, per-name lm_submit
+    owner-aware routing of pool verbs with a one-hop forward, the
+    deposed-holder ``scope_owner`` redirect, per-name lm_submit
     idempotency purged on rebuild/stop)."""
+
+    _POOL_VERBS = ("lm_submit", "lm_poll", "lm_stats", "lm_qos",
+                   "lm_autoscale")
 
     def __init__(self, host: str, membership: MembershipService,
                  lm_manager: LMPoolManager) -> None:
@@ -132,24 +152,82 @@ class ChaosControl:
         try:
             out = self._dispatch(msg.payload.get("verb", ""), msg.payload)
             return Message(MessageType.ACK, self.host, out)
+        except _RelayedError as e:
+            return Message(MessageType.ERROR, self.host, e.payload)
         except Exception as e:  # noqa: BLE001 - RPC boundary
             return Message(MessageType.ERROR, self.host,
                            {"error": f"{type(e).__name__}: {e}"})
 
+    def _forward_owner(self, p: dict, name: str) -> dict | None:
+        """Owner-aware one-hop forward, mirroring
+        serve/control.py:_forward_scope_owner — the claimed owner is
+        trusted ahead of the local liveness view, ``_owner_hop`` guards
+        against loops, and ERROR replies relay verbatim. None = no
+        forwardable owner, fall through to the node-local tier."""
+        if p.get("_owner_hop"):
+            return None
+        scope = pool_scope(name)
+        owner = self.membership.owners.owner(scope)
+        if owner is None or owner == self.host:
+            alive = set(self.membership.members.alive_hosts())
+            owner = place_scope(scope, self.membership.config.hosts, alive)
+        if owner is None or owner == self.host:
+            return None
+        self.mgr.service.metrics.record_counter("scope_owner_redirects")
+        fwd = dict(p, _owner_hop=True,
+                   epoch=list(self.membership.epoch.view()))
+        try:
+            out = self.mgr.transport.call(
+                owner, "control",
+                Message(MessageType.INFERENCE, self.host, fwd),
+                timeout=0.5)
+        except TransportError as e:
+            raise ValueError(f"scope owner {owner} for {name!r} "
+                             f"unreachable: {e}") from e
+        if out is None:
+            raise ValueError(
+                f"scope owner {owner} for {name!r} gave no reply")
+        observe_payload(self.membership.epoch, out.payload)
+        if out.type is MessageType.ERROR:
+            raise _RelayedError(dict(out.payload or {}))
+        return dict(out.payload or {})
+
     def _dispatch(self, verb: str, p: dict) -> dict:
         mgr = self.mgr
         if mgr is not None and not p.get("local"):
+            if verb == "lm_serve" and p.get("placement") == "assign":
+                # owner landing of a master handoff (pool_assign): serve
+                # here, no re-forward — a replay absorbs as already=True
+                return mgr.serve(p, assigned=True)
             if p.get("placement") == "auto" and verb == "lm_serve":
                 if not self.membership.is_acting_master:
                     raise ValueError("placement=auto must go to the "
                                      "acting master")
                 return mgr.serve(p)
             name = p.get("name")
-            if verb in ("lm_submit", "lm_poll", "lm_stats", "lm_qos",
-                        "lm_autoscale") and mgr.has_pool(name):
-                if not self.membership.is_acting_master:
-                    raise ValueError(f"{self.host} is not the acting "
-                                     f"master; journal fenced")
+            if verb in self._POOL_VERBS and not mgr.has_pool(name):
+                out = self._forward_owner(p, name)
+                if out is not None:
+                    return out
+            if verb in self._POOL_VERBS and mgr.has_pool(name):
+                claimed = self.membership.owners.owner(pool_scope(name))
+                if claimed is None:
+                    # unclaimed scope: the pre-ownership rule — only the
+                    # acting master serves a managed journal
+                    if not self.membership.is_acting_master:
+                        raise ValueError(f"{self.host} is not the acting "
+                                         f"master; journal fenced")
+                elif claimed != self.host:
+                    # deposed holder: adopter out-claimed this scope —
+                    # step down for it only, typed redirect to the owner
+                    mgr.step_down_scope(pool_scope(name))
+                    self.mgr.service.metrics.record_counter(
+                        "scope_owner_redirects")
+                    raise _RelayedError({
+                        "error": f"scope {pool_scope(name)} is owned by "
+                                 f"{claimed}; redirect",
+                        "scope": pool_scope(name),
+                        "scope_owner": claimed})
                 if verb == "lm_submit":
                     rid = mgr.submit(
                         name, [int(t) for t in p["prompt"]],
@@ -337,6 +415,13 @@ class ChaosCluster:
         self.acting_by_epoch: dict[int, set[str]] = {}
         # (scope, epoch) -> owners seen: >1 owner = per-pool split brain
         self.scope_owners: dict[tuple[str, int], set[str]] = {}
+        # (scope, claim seq) -> claimants seen across every host's
+        # gossiped ownership map: >1 = conflicting placement claims
+        self.claim_owners: dict[tuple[str, int], set[str]] = {}
+        # scope -> the deterministic rendezvous owner over the FULL host
+        # set (filled after the pools are served): the seq-1 claim must
+        # land exactly there on every run of this seed
+        self.expected_owners: dict[str, str] = {}
         self._wrap_probes()
         # workload ledgers
         self._serial = 0
@@ -390,6 +475,13 @@ class ChaosCluster:
                               "dwell_s": 1.0, "drain_window_s": 1.0,
                               "max_replicas": 3}})
             assert gout.get("group") or gout.get("already"), gout
+        names = ([self.LM_POOL]
+                 + ([self.LM_POOL_B] if multi_pool else [])
+                 + ([self.LM_GROUP] if autoscale else []))
+        full = set(self.cfg.hosts)
+        self.expected_owners = {
+            pool_scope(n): place_scope(pool_scope(n), self.cfg.hosts, full)
+            for n in names}
 
     # -- probes -----------------------------------------------------------
 
@@ -442,6 +534,12 @@ class ChaosCluster:
                 if sowner is not None:
                     self.scope_owners.setdefault(
                         (scope, se), set()).add(sowner)
+            # ownership claims (ISSUE 15): two hosts claiming one
+            # (scope, seq) would mean the rendezvous/adoption protocol
+            # minted conflicting owners — routing split brain
+            for scope, view in self.members[h].owners.view_all().items():
+                self.claim_owners.setdefault(
+                    (scope, int(view[1])), set()).add(view[0])
 
     # -- client helpers (route like real clients: chain + retry) ----------
 
@@ -450,12 +548,26 @@ class ChaosCluster:
         if idem is not None:
             payload = dict(payload, idem=idem)
         t = self.net._nodes[client]
-        targets = [self.members[client].acting_master()]
-        for x in (self.cfg.coordinator, self.cfg.standby_coordinator):
+        # owner-aware pre-route (ISSUE 15): pool-directed verbs go to the
+        # client's gossiped scope-owner view FIRST — the chain stays as
+        # fallback, and a typed scope_owner redirect adds ONE extra hop
+        targets = []
+        name = payload.get("name")
+        if (payload.get("verb") in ChaosControl._POOL_VERBS
+                and payload.get("placement") is None and name):
+            o = self.members[client].owners.owner(pool_scope(name))
+            if o is not None:
+                targets.append(o)
+        for x in (self.members[client].acting_master(),
+                  self.cfg.coordinator, self.cfg.standby_coordinator):
             if x not in targets:
                 targets.append(x)
         last = None
-        for target in targets:
+        redirected = False
+        i = 0
+        while i < len(targets):
+            target = targets[i]
+            i += 1
             try:
                 out = call_with_retry(
                     lambda target=target: t.call(
@@ -470,7 +582,16 @@ class ChaosCluster:
                 continue
             err = out.payload.get("error", "")
             if out.type is MessageType.ERROR:
+                ro = out.payload.get("scope_owner")
+                if ro and not redirected and ro not in targets:
+                    # follow exactly one typed redirect to the claimed
+                    # owner the deposed holder named
+                    redirected = True
+                    targets.insert(i, ro)
+                    last = err
+                    continue
                 if ("acting master" in err or "fenced" in err
+                        or "scope owner" in err or ro
                         or out.payload.get("stale_epoch")):
                     last = err
                     continue
@@ -626,8 +747,12 @@ class ChaosCluster:
         for h in self.cfg.hosts:
             if self.members[h].is_acting_master:
                 self.services[h].monitor_stragglers_once()
-                self.managers[h].pump_once()
-                self.failovers[h].replicate_once()
+            # multi-owner control plane (ISSUE 15): EVERY host pumps its
+            # manager — pool owners drive their own scopes' dispatch and
+            # WAL shipping, not just the master (pump_once no-ops on
+            # hosts with nothing to do; replicate_once gates internally)
+            self.managers[h].pump_once()
+            self.failovers[h].replicate_once()
 
     def step(self) -> None:
         """One seeded schedule step: a workload or fault op, then a pump
@@ -717,8 +842,20 @@ class ChaosCluster:
         return [(model, q, lo, hi) for model, q, lo, hi in self.cnn_acked
                 if m.scheduler.book.tasks_for_query(model, q)]
 
+    def _pool_owner(self, name: str) -> str:
+        """The host whose manager holds ``name``'s journal NOW: the final
+        master's gossiped claim if its holder is alive, else the master
+        itself (pre-ownership fallback). Every owner-aware read — drains,
+        settle checks, invariant sweeps — goes through here instead of
+        assuming the master holds every pool (ISSUE 15)."""
+        fm = self.final_master()
+        o = self.members[fm].owners.owner(pool_scope(name))
+        if o is not None and o in self.members[fm].members.alive_hosts():
+            return o
+        return fm
+
     def _surviving_lm(self):
-        mgr = self.managers[self.final_master()]
+        mgr = self.managers[self._pool_owner(self.LM_POOL)]
         with mgr._lock:
             pool = mgr._pools.get(self.LM_POOL)
             rids = set(pool["requests"]) if pool else set()
@@ -731,12 +868,12 @@ class ChaosCluster:
         for model, q, lo, hi in self._surviving_cnn():
             if not (m.query_done(model, q) or m.query_failed(model, q)):
                 out.append(f"cnn {model} q{q}")
-        mgr = self.managers[self.final_master()]
-        with mgr._lock:
-            pools = [("lm", self.LM_POOL)]
-            if self.multi_pool:
-                pools.append(("lmB", self.LM_POOL_B))
-            for tag, pname in pools:
+        pools = [("lm", self.LM_POOL)]
+        if self.multi_pool:
+            pools.append(("lmB", self.LM_POOL_B))
+        for tag, pname in pools:
+            mgr = self.managers[self._pool_owner(pname)]
+            with mgr._lock:
                 pool = mgr._pools.get(pname)
                 if pool is None:
                     continue
@@ -745,6 +882,8 @@ class ChaosCluster:
                 for rid, r in pool["requests"].items():
                     if r["status"] in ("pending", "inflight"):
                         out.append(f"{tag} rid {rid} {r['status']}")
+        mgr = self.managers[self._pool_owner(self.LM_GROUP)]
+        with mgr._lock:
             g = mgr._groups.get(self.LM_GROUP)
             if g is not None:
                 replicas = list(g["replicas"])
@@ -839,6 +978,24 @@ class ChaosCluster:
             assert len(owners) <= 1, \
                 f"scope {scope} epoch {e} owned by {sorted(owners)} " \
                 f"(per-pool split brain)"
+        # ownership claims (ISSUE 15): at most one claimant per
+        # (scope, seq) across every host's gossiped view, the FIRST
+        # claim lands exactly on the rendezvous placement, and with a
+        # second pool the owners genuinely spread over >1 host
+        for (scope, seq), owners in self.claim_owners.items():
+            assert len(owners) <= 1, \
+                f"scope {scope} claim seq {seq} by {sorted(owners)} " \
+                f"(ownership split brain)"
+        for scope, want in self.expected_owners.items():
+            got = self.claim_owners.get((scope, 1))
+            if got:
+                assert got == {want}, \
+                    f"scope {scope} first claim {sorted(got)} != " \
+                    f"rendezvous placement {want}"
+        if self.multi_pool:
+            assert len(set(self.expected_owners.values())) >= 2, \
+                f"owner spread: every scope placed on one host " \
+                f"{self.expected_owners}"
         # membership converged: every alive host agrees on the alive set
         views = {h: tuple(self.members[h].members.alive_hosts())
                  for h in self.cfg.hosts}
@@ -899,7 +1056,7 @@ class ChaosCluster:
         # double-spawned by a replayed decision (ISSUE 11)
         grp_summary: dict = {}
         if self.autoscale:
-            mgr = self.managers[self.final_master()]
+            mgr = self.managers[self._pool_owner(self.LM_GROUP)]
             with mgr._lock:
                 g = mgr._groups.get(self.LM_GROUP)
                 gview = (None if g is None
@@ -933,6 +1090,14 @@ class ChaosCluster:
         pool_epochs: dict[str, int] = {}
         for scope, e in self.scope_owners:
             pool_epochs[scope] = max(pool_epochs.get(scope, 0), e)
+        # final ownership map + total claim movement (seq 1 is the
+        # placement claim; every later seq is an adoption move)
+        fm_owners = self.members[self.final_master()].owners
+        final_owners = {s: fm_owners.owner(s) for s in fm_owners.scopes()}
+        max_seq: dict[str, int] = {}
+        for scope, seq in self.claim_owners:
+            max_seq[scope] = max(max_seq.get(scope, 1), seq)
+        owner_moves = sum(s - 1 for s in max_seq.values())
         return {"cnn_acked": len(self.cnn_acked),
                 "cnn_survived": len(survived),
                 "lm_acked": len(self.lm_acked),
@@ -942,6 +1107,8 @@ class ChaosCluster:
                 "sdfs_survived": sdfs_survived,
                 "epochs": max(self.epoch_owners, default=0),
                 "pool_epochs": pool_epochs,
+                "scope_owners": final_owners,
+                "owner_moves": owner_moves,
                 "hosts": len(self.cfg.hosts),
                 "final_master": self.final_master(),
                 **grp_summary}

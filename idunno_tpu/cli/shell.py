@@ -195,10 +195,20 @@ class Shell:
 
     def cmd_list_master(self, args: list[str]) -> str:
         epoch, owner = self.node.membership.epoch.view()
-        return (f"acting master: {self.node.membership.acting_master()}\n"
-                f"standby:       {self.node.config.standby_coordinator}\n"
+        rows = [f"acting master: {self.node.membership.acting_master()}",
+                f"standby:       {self.node.config.standby_coordinator}",
                 f"epoch:         {epoch}"
-                + (f" (owner {owner})" if owner else " (bootstrap)"))
+                + (f" (owner {owner})" if owner else " (bootstrap)")]
+        # per-scope ownership table (ISSUE 15): which host serves each
+        # managed pool/group scope under rendezvous placement, per this
+        # node's gossiped claim map
+        owners = getattr(self.node.membership, "owners", None)
+        if owners is not None and owners.scopes():
+            rows.append("scope owners:")
+            for scope in owners.scopes():
+                o, seq = owners.view(scope)
+                rows.append(f"  {scope} -> {o} (seq {seq})")
+        return "\n".join(rows)
 
     # -- grep -------------------------------------------------------------
 
@@ -701,6 +711,14 @@ class Shell:
         if len(args) != 1:
             return "usage: lm-qos <name>"
         out = self._control("lm_qos", name=args[0])
+        head = []
+        owners = getattr(self.node.membership, "owners", None)
+        if owners is not None:
+            from idunno_tpu.membership.epoch import pool_scope
+            view = owners.view(pool_scope(args[0]))
+            if view is not None:
+                head.append(f"{args[0]}: scope {pool_scope(args[0])} "
+                            f"owned by {view[0]} (seq {view[1]})")
         grp = out.get("group")
         if grp is not None:             # autoscaled replica group
             pol = grp.get("policy", {})
@@ -719,8 +737,8 @@ class Shell:
                             f"{extra} (epoch={d['epoch'][0]})")
             for r, rq in sorted(out.get("replicas", {}).items()):
                 rows.append(self._fmt_qos(r, rq))
-            return "\n".join(rows)
-        return self._fmt_qos(args[0], out)
+            return "\n".join(head + rows)
+        return "\n".join(head + [self._fmt_qos(args[0], out)])
 
     def _fmt_qos(self, name: str, out: dict) -> str:
         rows = []

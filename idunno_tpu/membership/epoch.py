@@ -148,6 +148,96 @@ def pool_scope(name: str) -> str:
     return f"pool:{name.rsplit('@r', 1)[0]}"
 
 
+def place_scope(scope: str, hosts, alive) -> str | None:
+    """Deterministic owner for a pool scope: the first ALIVE host in the
+    scope's rendezvous order over the full configured registry
+    (utils/ring.py:rendezvous_order). Every node computes the same
+    answer from the same membership view, and one host's death moves
+    only the scopes that ranked it first. None when nothing is alive."""
+    from idunno_tpu.utils.ring import rendezvous_order
+    alive = set(alive)
+    for h in rendezvous_order(scope, tuple(hosts)):
+        if h in alive:
+            return h
+    return None
+
+
+class ScopeOwnerRedirect(Exception):
+    """A pool-directed verb landed on a host that is not the scope's
+    placed owner — the typed one-hop redirect: the ERROR reply names the
+    owner so the client re-sends there directly (one hop, counted as
+    ``scope_owner_redirects``), instead of walking the coordinator
+    chain."""
+
+    def __init__(self, scope: str, owner: str | None) -> None:
+        super().__init__(f"scope {scope} is owned by {owner}; redirect")
+        self.scope = scope
+        self.owner = owner
+
+
+class ScopeOwners:
+    """Gossiped scope→owner claim map, the routing half of multi-owner
+    placement (the fences in ``FenceRegistry`` are the safety half).
+    Each claim carries a per-scope monotone seq; ``observe_all`` keeps
+    the higher seq and breaks exact ties on the lexicographically
+    greater owner so every node converges to the same view without
+    coordination. Claims are advisory routing state — a wrong view
+    costs one redirect hop or a scoped fence check, never
+    correctness."""
+
+    def __init__(self) -> None:
+        self._map: dict[str, tuple[str, int]] = {}
+        self._lock = threading.Lock()
+
+    def owner(self, scope: str) -> str | None:
+        with self._lock:
+            ent = self._map.get(scope)
+            return ent[0] if ent else None
+
+    def view(self, scope: str) -> tuple[str, int] | None:
+        with self._lock:
+            ent = self._map.get(scope)
+            return (ent[0], ent[1]) if ent else None
+
+    def scopes(self) -> list[str]:
+        with self._lock:
+            return sorted(self._map)
+
+    def owned_by(self, host: str) -> list[str]:
+        with self._lock:
+            return sorted(s for s, (o, _) in self._map.items()
+                          if o == host)
+
+    def claim(self, scope: str, owner: str) -> int:
+        """Record ``owner`` as the scope's owner at a seq strictly above
+        everything observed — a claim always wins over the state it was
+        made from, and replicated/gossiped copies of an OLD claim can
+        never re-demote it."""
+        with self._lock:
+            ent = self._map.get(scope)
+            seq = (ent[1] if ent else 0) + 1
+            self._map[scope] = (owner, seq)
+            return seq
+
+    def view_all(self) -> dict[str, list]:
+        """Gossip wire form: ``{scope: [owner, seq]}``."""
+        with self._lock:
+            return {s: [o, q] for s, (o, q) in self._map.items()}
+
+    def observe_all(self, views) -> None:
+        if not isinstance(views, dict):
+            return
+        with self._lock:
+            for scope, ent in views.items():
+                if not ent:
+                    continue
+                owner, seq = str(ent[0]), int(ent[1])
+                cur = self._map.get(str(scope))
+                if (cur is None or seq > cur[1]
+                        or (seq == cur[1] and owner > cur[0])):
+                    self._map[str(scope)] = (owner, seq)
+
+
 # -- wire helpers (shared by every stamped service) ------------------------
 
 def stamp(fence: EpochFence, payload: dict) -> dict:

@@ -29,7 +29,7 @@ from idunno_tpu.comm.message import Message
 from idunno_tpu.comm.transport import Transport, TransportError
 from idunno_tpu.config import ClusterConfig
 from idunno_tpu.membership.epoch import (EpochFence, FenceRegistry,
-                                         observe_payload)
+                                         ScopeOwners, observe_payload)
 from idunno_tpu.membership.list import MembershipList
 from idunno_tpu.utils.types import MemberStatus, MessageType
 
@@ -55,6 +55,10 @@ class MembershipService:
         # scoped adoption mints here, scope views gossip beside the
         # cluster view — membership only ever OBSERVES scope stamps
         self.scopes = FenceRegistry()
+        # gossiped scope→owner claims (routing only; the fences above are
+        # the safety): pool-directed verbs go to the claimed owner first,
+        # a wrong view costs one typed redirect hop
+        self.owners = ScopeOwners()
         self._callbacks: list[ChangeCallback] = []
         self._left = False           # voluntary leave: never auto-refute
         transport.serve(SERVICE, self._handle)
@@ -122,7 +126,8 @@ class MembershipService:
         msg = Message(MessageType.JOIN, self.host,
                       {"members": self.members.to_wire(),
                        "epoch": list(self.epoch.view()),
-                       "scopes": self.scopes.view_all()})
+                       "scopes": self.scopes.view_all(),
+                       "owners": self.owners.view_all()})
         for seed in (self.config.introducer, self.config.coordinator,
                      self.config.standby_coordinator):
             if seed == self.host:
@@ -138,6 +143,7 @@ class MembershipService:
                 # one
                 observe_payload(self.epoch, out.payload)
                 self.scopes.observe_all(out.payload.get("scopes"))
+                self.owners.observe_all(out.payload.get("owners"))
                 self._fire(self.members.merge(out.payload["members"]))
                 return
         # nobody reachable — we are first up; keep our solo list.
@@ -151,7 +157,8 @@ class MembershipService:
         msg = Message(MessageType.LEAVE, self.host,
                       {"members": self.members.to_wire(),
                        "epoch": list(self.epoch.view()),
-                       "scopes": self.scopes.view_all()})
+                       "scopes": self.scopes.view_all(),
+                       "owners": self.owners.view_all()})
         for h in self.config.hosts:
             if h != self.host:
                 self.transport.datagram(h, SERVICE, msg)
@@ -166,7 +173,8 @@ class MembershipService:
         msg = Message(MessageType.PING, self.host,
                       {"members": self.members.to_wire(),
                        "epoch": list(self.epoch.view()),
-                       "scopes": self.scopes.view_all()})
+                       "scopes": self.scopes.view_all(),
+                       "owners": self.owners.view_all()})
         for h in self.config.hosts:
             if h != self.host:
                 self.transport.datagram(h, SERVICE, msg)
@@ -243,15 +251,17 @@ class MembershipService:
         # beside it — membership observes scope fences, never rejects
         # (a deposed pool owner must still learn it was deposed)
         observe_payload(self.epoch, msg.payload)
-        self.scopes.observe_all(msg.payload.get("scopes")
-                                if isinstance(msg.payload, dict) else None)
+        if isinstance(msg.payload, dict):
+            self.scopes.observe_all(msg.payload.get("scopes"))
+            self.owners.observe_all(msg.payload.get("owners"))
         if msg.type is MessageType.JOIN:
             self._fire(self.members.merge(msg.payload["members"]))
             self.members.touch(msg.sender, now)
             return Message(MessageType.ACK, self.host,
                            {"members": self.members.to_wire(),
                             "epoch": list(self.epoch.view()),
-                            "scopes": self.scopes.view_all()})
+                            "scopes": self.scopes.view_all(),
+                            "owners": self.owners.view_all()})
         if msg.type in (MessageType.PING, MessageType.PONG,
                         MessageType.LEAVE):
             self._fire(self.members.merge(msg.payload["members"]))
@@ -262,6 +272,7 @@ class MembershipService:
                     Message(MessageType.PONG, self.host,
                             {"members": self.members.to_wire(),
                              "epoch": list(self.epoch.view()),
-                             "scopes": self.scopes.view_all()}))
+                             "scopes": self.scopes.view_all(),
+                             "owners": self.owners.view_all()}))
             return None
         return None

@@ -39,7 +39,10 @@ import os
 from typing import TYPE_CHECKING
 
 from idunno_tpu.comm.message import Message
-from idunno_tpu.membership.epoch import check_payload, check_scoped
+from idunno_tpu.comm.transport import TransportError
+from idunno_tpu.membership.epoch import (ScopeOwnerRedirect, check_payload,
+                                         check_scoped, observe_payload,
+                                         place_scope, pool_scope)
 from idunno_tpu.utils.spans import trace_from_payload
 from idunno_tpu.utils.types import MessageType
 
@@ -110,6 +113,15 @@ class ControlService:
         try:
             out = self._dispatch(msg.payload.get("verb", ""), msg.payload)
             return Message(MessageType.ACK, self.node.host, out)
+        except ScopeOwnerRedirect as e:
+            # typed not-owner redirect (ISSUE 15): the reply names the
+            # scope's owner so the CLIENT re-sends there directly — one
+            # hop, counted; server-side forwarding already absorbed the
+            # common case, this is the loop-stop for a stale owner map
+            self.node.metrics.record_counter("scope_owner_redirects")
+            return Message(MessageType.ERROR, self.node.host,
+                           {"error": str(e), "scope": e.scope,
+                            "scope_owner": e.owner})
         except Exception as e:  # noqa: BLE001 - RPC boundary: report, don't die
             return Message(MessageType.ERROR, self.node.host,
                            {"error": f"{type(e).__name__}: {e}"})
@@ -618,8 +630,19 @@ class ControlService:
                 # adoption/replay counters ride the tracker's
                 # record_counter events automatically
                 extra_g["wal_skips"] = fo.wal_skips
+                # ISSUE 15 satellite: cumulative bytes shipped over the
+                # per-pool WAL (delta frames + full fallbacks) — the
+                # number the delta compaction is supposed to shrink
+                extra_g["pool_wal_bytes"] = fo.pool_wal_bytes()
+            # ISSUE 15: ownership-routing counters are always present in
+            # the scrape (zero until the first redirect/handoff) so
+            # dashboards can alert on them without a priming event
+            extra_c = dict(retry_counters())
+            cc = node.metrics.counters()
+            for k in ("scope_owner_redirects", "scope_owner_moves"):
+                extra_c.setdefault(k, cc.get(k, 0))
             return {"text": node.metrics.prometheus_text(
-                node.host, extra_counters=retry_counters(),
+                node.host, extra_counters=extra_c,
                 extra_gauges=extra_g)}
         if verb == "lm_autoscale":
             # only meaningful for a manager-owned replica group (routed
@@ -681,16 +704,57 @@ class ControlService:
         merged.sort(key=lambda s: (s.get("t_start", 0.0), s["span_id"]))
         return {"trace_id": tid, "spans": merged, "nodes": nodes}
 
+    # pool-directed verbs that route by scope owner (ISSUE 15)
+    _POOL_VERBS = ("lm_submit", "lm_poll", "lm_stats", "lm_stop",
+                   "lm_cancel", "lm_partial", "lm_qos", "lm_autoscale")
+
+    def _forward_scope_owner(self, p: dict, name: str, owner: str) -> dict:
+        """Owner-aware routing (ISSUE 15): this node does not hold the
+        pool but the gossiped ownership map names an alive owner —
+        forward the verb there transparently (ONE hop: the forwarded
+        payload carries ``_owner_hop`` so a stale map can never loop)
+        and relay the owner's reply. The hop is the counted redirect;
+        clients that pre-route by their own owner view skip it."""
+        node = self.node
+        node.metrics.record_counter("scope_owner_redirects")
+        fwd = dict(p, _owner_hop=True,
+                   epoch=list(node.membership.epoch.view()))
+        try:
+            out = node.transport.call(
+                owner, SERVICE,
+                Message(MessageType.INFERENCE, node.host, fwd),
+                timeout=30.0)
+        except TransportError as e:
+            raise ValueError(f"scope owner {owner} for {name!r} "
+                             f"unreachable: {e}") from e
+        if out is None:
+            raise ValueError(
+                f"scope owner {owner} for {name!r} gave no reply")
+        observe_payload(node.membership.epoch, out.payload)
+        if out.type is MessageType.ERROR:
+            err = (out.payload or {}).get("error")
+            raise ValueError(f"{owner}: {err}")
+        return dict(out.payload or {})
+
     def _route_cluster(self, verb: str, p: dict) -> dict | None:
         """Cluster-managed LM tier (serve/lm_manager.py): placement verbs
-        carry ``placement="auto"`` and MUST land on the acting master;
-        follow-up verbs route to the manager whenever it owns the name.
-        ``local=True`` (set by the manager's own node-to-node RPCs) pins
-        the node-local tier, so a managed pool's host still answers the
-        manager. None = not a cluster-routed call, fall through."""
+        carry ``placement="auto"`` and MUST land on the acting master
+        (which hands each scope to its rendezvous owner); follow-up verbs
+        route by SCOPE OWNER — the holder serves them, any other node
+        forwards one hop to the gossiped owner, and a deposed holder
+        answers with a typed ``ScopeOwnerRedirect``. ``local=True`` (set
+        by the manager's own node-to-node RPCs) pins the node-local tier,
+        so a managed pool's host still answers the manager. None = not a
+        cluster-routed call, fall through."""
         mgr = getattr(self.node, "lm_manager", None)
         if mgr is None or p.get("local"):
             return None
+        if verb == "lm_serve" and p.get("placement") == "assign":
+            # owner landing of a scope assign hop (pool_assign contract):
+            # the acting master placed this scope here — serve it now, no
+            # re-forward (assign is a single hop); a replayed assign finds
+            # the named pool and absorbs as already=True
+            return mgr.serve(p, assigned=True)
         placed = (p.get("placement") == "auto"
                   and verb in ("lm_serve", "train_start"))
         if placed:
@@ -703,19 +767,48 @@ class ControlService:
             return (mgr.serve(p) if verb == "lm_serve"
                     else mgr.train(p))
         name = p.get("name")
-        if verb in ("lm_submit", "lm_poll", "lm_stats", "lm_stop",
-                    "lm_cancel", "lm_partial", "lm_qos",
-                    "lm_autoscale") \
-                and mgr.has_pool(name):
-            if not self.node.membership.is_acting_master:
-                # a deposed coordinator still holds the managed journal it
-                # diverged from: serving it would ack submits that can
-                # never complete and re-deliver completions the CURRENT
-                # master also delivers (split-brain double delivery) —
-                # refuse, clients fail over to the epoch owner
-                raise ValueError(
-                    f"{self.node.host} is not the acting master; its "
-                    f"managed journal for {name!r} is fenced")
+        if verb in self._POOL_VERBS and not mgr.has_pool(name):
+            # not held here: forward one hop to the scope's claimed owner.
+            # The claim is trusted even when our liveness view lags (a
+            # healed node may observe the claim a wave before the owner's
+            # RUNNING refutation) — a genuinely dead owner surfaces as
+            # the typed unreachable error, and its successor's fresher
+            # claim arrives on the same gossip that revives liveness.
+            owners = getattr(self.node.membership, "owners", None)
+            if owners is not None and not p.get("_owner_hop"):
+                scope = pool_scope(name)
+                owner = owners.owner(scope)
+                if owner == self.node.host:
+                    # our own stale claim (we just stepped this scope
+                    # down): guess the successor by rendezvous placement
+                    # over the alive view rather than bouncing the client
+                    alive = set(
+                        self.node.membership.members.alive_hosts())
+                    owner = place_scope(
+                        scope, self.node.config.hosts, alive)
+                if owner is not None and owner != self.node.host:
+                    return self._forward_scope_owner(p, name, owner)
+            # UNCLAIMED scope (direct pools, bare harnesses, or the
+            # pre-gossip window): fall through to the node-local tier —
+            # its "no lm_serve pool" error is the pre-ownership behavior
+        if verb in self._POOL_VERBS and mgr.has_pool(name):
+            owners = getattr(self.node.membership, "owners", None)
+            claimed = (owners.owner(pool_scope(name))
+                       if owners is not None else None)
+            if owners is None or claimed is None:
+                # no ownership map (bare harnesses) or an unclaimed
+                # scope: the PR-13 rule — only the acting master may
+                # serve a managed journal
+                if not self.node.membership.is_acting_master:
+                    raise ValueError(
+                        f"{self.node.host} is not the acting master; its "
+                        f"managed journal for {name!r} is fenced")
+            elif claimed != self.node.host:
+                # deposed holder: the scope's adopter out-claimed us —
+                # step down for this scope only and redirect, typed;
+                # serving the stale journal would double-deliver
+                mgr.step_down_scope(pool_scope(name))
+                raise ScopeOwnerRedirect(pool_scope(name), claimed)
             if verb == "lm_submit":
                 rid = mgr.submit(name, [int(t) for t in p["prompt"]],
                                  int(p["max_new"]),

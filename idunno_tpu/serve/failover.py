@@ -18,6 +18,7 @@ the new master.
 """
 from __future__ import annotations
 
+import json
 import logging
 import threading
 from typing import Any
@@ -25,7 +26,9 @@ from typing import Any
 from idunno_tpu.comm.message import Message
 from idunno_tpu.comm.transport import Transport, TransportError
 from idunno_tpu.config import ClusterConfig
-from idunno_tpu.membership.epoch import check_payload, reply_is_stale
+from idunno_tpu.membership.epoch import (check_payload, check_scoped,
+                                         place_scope, pool_scope,
+                                         reply_is_stale, stamp_scoped)
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.serve.inference_service import InferenceService
 from idunno_tpu.utils.types import MemberStatus, MessageType
@@ -69,6 +72,11 @@ class FailoverManager:
         # so adopting one pool's scope replays only that pool's WAL
         # (wal_pool / _handle / adopt)
         self._pool_wal: dict[str, dict[str, Any]] = {}
+        # satellite observability: bytes shipped over the pool WAL (full
+        # entries + delta frames) — the delta-compaction win is this
+        # gauge staying near-linear in mutations instead of quadratic
+        # in journal depth (metrics_export: pool_wal_bytes)
+        self._pool_wal_bytes = 0
         transport.serve(SERVICE, self._handle)
         # front: the adoption (epoch mint) must land BEFORE reassignment
         # callbacks start re-dispatching, so nothing dispatches under the
@@ -161,72 +169,104 @@ class FailoverManager:
             return False
         return out is not None
 
+    def _scope_standby(self, scope: str) -> str | None:
+        """The scope's OWN WAL successor (ISSUE 15): the next host in the
+        scope's rendezvous placement order after this one, over the alive
+        set. Every pool's journal fans out to its own standby instead of
+        one global standby — so one host's death leaves every other
+        scope's (owner, standby) pair serving untouched, and the adopter
+        a death selects is exactly the host already holding the WAL
+        (same formula, same liveness view)."""
+        alive = set(self.membership.members.alive_hosts())
+        return place_scope(scope, self.config.hosts,
+                           alive - {self.host})
+
+    def pool_wal_bytes(self) -> int:
+        with self._lock:
+            return self._pool_wal_bytes
+
     def wal_scale(self, group: str, decision: dict[str, Any],
                   entry: dict[str, Any]) -> bool:
         """Synchronous write-ahead for an autoscaler scaling decision
         (serve/lm_manager.py:_replicate_scale): a spawn/retire/rebalance
-        the acting master just journaled must survive an immediate
-        coordinator death, not just one after the next periodic tick —
-        otherwise the new master would re-derive scaling state from
-        gauges instead of REPLAYING it (the chaos exact-replay
-        invariant). Ships the group's full wire entry (small: routing
-        maps + a bounded decision log — replica request journals ride
-        the pool snapshot as usual). Same skip discipline as
-        wal_append: a dead standby must not stall the control loop, but
-        the skip is counted, never silent."""
-        standby = self.config.standby_coordinator
-        if standby == self.host or not self.membership.is_acting_master:
-            return False
-        if standby not in self.membership.members.alive_hosts():
+        the group's owner just journaled must survive an immediate
+        death, not just one after the next periodic tick — otherwise the
+        adopter would re-derive scaling state from gauges instead of
+        REPLAYING it (the chaos exact-replay invariant). Ships the
+        group's full wire entry (small: routing maps + a bounded
+        decision log — replica request journals ride the pool WAL as
+        usual) to the GROUP SCOPE's own standby successor; gated on
+        holding the journal (the manager only replicates groups it
+        owns), not on cluster mastership — scope owners need not be the
+        acting master (ISSUE 15). Same skip discipline as wal_append: a
+        dead standby must not stall the control loop, but the skip is
+        counted, never silent."""
+        scope = pool_scope(group)
+        standby = self._scope_standby(scope)
+        if standby is None or standby == self.host:
             self.wal_skips += 1
             self.service.metrics.record_counter("wal_skipped_standby_down")
-            log.warning("wal_scale skipped for group %s seq %s: standby "
-                        "%s not alive", group, decision.get("seq"),
-                        standby)
+            log.warning("wal_scale skipped for group %s seq %s: no alive "
+                        "scope standby", group, decision.get("seq"))
             return False
-        msg = Message(MessageType.METADATA, self.host,
-                      {"epoch": list(self.membership.epoch.view()),
-                       "scale_wal": {"group": str(group),
-                                     "decision": dict(decision),
-                                     "entry": dict(entry)}})
+        payload = {"epoch": list(self.membership.epoch.view()),
+                   "scale_wal": {"group": str(group),
+                                 "decision": dict(decision),
+                                 "entry": dict(entry)}}
+        stamp_scoped(self.membership.scopes, scope, payload)
+        msg = Message(MessageType.METADATA, self.host, payload)
         try:
             out = self.transport.call(standby, SERVICE, msg, timeout=2.0)
         except TransportError:
             return False
-        if reply_is_stale(self.membership.epoch, out):
+        if out is None or reply_is_stale(self.membership.epoch, out):
             return False
-        return out is not None
+        return out.type is not MessageType.ERROR
 
-    def wal_pool(self, name: str, entry: dict[str, Any]) -> bool:
+    def wal_pool(self, name: str,
+                 frame: dict[str, Any]) -> dict[str, Any] | None:
         """Synchronous write-ahead for ONE managed pool's journal segment
-        (serve/lm_manager.py:_replicate_pool): ships the pool's full wire
-        entry at its per-pool monotone ``wal_seq`` so an admission or
-        terminal transition the acting master just journaled survives an
-        immediate death without waiting for the periodic full snapshot —
-        and so scoped adoption can replay exactly this pool's segment
-        while other pools' state is untouched. Same skip discipline as
-        wal_append/wal_scale: a dead standby never stalls the serving
-        path, but every skip is counted, never silent."""
-        standby = self.config.standby_coordinator
-        if standby == self.host or not self.membership.is_acting_master:
-            return False
-        if standby not in self.membership.members.alive_hosts():
+        (serve/lm_manager.py:_replicate_pool): ships the pool's wire
+        entry — or a delta frame since the standby's acked base — at its
+        per-pool monotone ``wal_seq`` so an admission or terminal
+        transition the pool's owner just journaled survives an immediate
+        death without waiting for the periodic full snapshot — and so
+        scoped adoption can replay exactly this pool's segment while
+        other pools' state is untouched. The target is the POOL SCOPE's
+        own standby successor, and the gate is holding the journal (the
+        manager only replicates pools it owns), not cluster mastership
+        (ISSUE 15). Returns the standby's ACK payload (which may carry
+        ``need_full`` when a delta frame missed its base) or None when
+        skipped/unreachable/fenced — the caller treats None as an unacked
+        chain and re-seeds with a full entry next mutation. Same skip
+        discipline as wal_append: a dead standby never stalls the
+        serving path, but every skip is counted, never silent."""
+        scope = pool_scope(name)
+        standby = self._scope_standby(scope)
+        if standby is None or standby == self.host:
             self.wal_skips += 1
             self.service.metrics.record_counter("wal_skipped_standby_down")
-            log.warning("wal_pool skipped for pool %s seq %s: standby %s "
-                        "not alive", name, entry.get("wal_seq"), standby)
-            return False
-        msg = Message(MessageType.METADATA, self.host,
-                      {"epoch": list(self.membership.epoch.view()),
-                       "pool_wal": {"name": str(name),
-                                    "entry": dict(entry)}})
+            log.warning("wal_pool skipped for pool %s seq %s: no alive "
+                        "scope standby", name, frame.get("wal_seq"))
+            return None
+        payload = {"epoch": list(self.membership.epoch.view()),
+                   "pool_wal": {"name": str(name),
+                                "entry": dict(frame)}}
+        stamp_scoped(self.membership.scopes, scope, payload)
+        msg = Message(MessageType.METADATA, self.host, payload)
+        with self._lock:
+            self._pool_wal_bytes += len(
+                json.dumps(frame, separators=(",", ":"),
+                           default=str).encode())
         try:
             out = self.transport.call(standby, SERVICE, msg, timeout=2.0)
         except TransportError:
-            return False
-        if reply_is_stale(self.membership.epoch, out):
-            return False
-        return out is not None
+            return None
+        if out is None or reply_is_stale(self.membership.epoch, out):
+            return None
+        if out.type is MessageType.ERROR:
+            return None
+        return dict(out.payload or {})
 
     # -- standby side ------------------------------------------------------
 
@@ -237,6 +277,12 @@ class FailoverManager:
         # the adopted state it diverged from (its seq counter may be
         # HIGHER than ours — seq orders snapshots within one epoch only)
         stale = check_payload(self.membership.epoch, msg.payload, self.host)
+        if stale is not None:
+            return stale
+        # per-scope fence: a deposed POOL owner's WAL frames are refused
+        # for that scope only (the scope's adopter minted a higher scope
+        # epoch; the cluster fence above may not have moved at all)
+        stale = check_scoped(self.membership.scopes, msg.payload, self.host)
         if stale is not None:
             return stale
         with self._lock:
@@ -254,10 +300,20 @@ class FailoverManager:
                 return Message(MessageType.ACK, self.host)
             if "pool_wal" in msg.payload:   # per-pool journal delta
                 d = msg.payload["pool_wal"]
+                frame = d["entry"]
                 cur = self._pool_wal.get(d["name"])
-                if (cur is None
+                if frame.get("delta"):
+                    held = cur["entry"] if cur else None
+                    merged = self._merge_pool_delta_locked(held, frame)
+                    if merged is None:
+                        # gap: NACK so the sender re-ships a full entry
+                        return Message(MessageType.ACK, self.host,
+                                       {"need_full": True})
+                    self._pool_wal[d["name"]] = {"name": d["name"],
+                                                 "entry": merged}
+                elif (cur is None
                         or int(cur["entry"].get("wal_seq", -1))
-                        <= int(d["entry"].get("wal_seq", -1))):
+                        <= int(frame.get("wal_seq", -1))):
                     self._pool_wal[d["name"]] = d
                 return Message(MessageType.ACK, self.host)
             seq = int(msg.payload.get("seq", 0))
@@ -281,16 +337,95 @@ class FailoverManager:
                     < int(v["entry"].get("wal_seq", -1))}
         return Message(MessageType.ACK, self.host)
 
+    @staticmethod
+    def _merge_pool_delta_locked(held: dict[str, Any] | None,
+                                 frame: dict[str, Any]) \
+            -> dict[str, Any] | None:
+        """Apply a delta frame onto the held full entry. A frame applies
+        only when its ``base_seq`` equals the held entry's wal_seq
+        EXACTLY — any gap (no held entry, a lost frame, a standby that
+        restarted) returns None and the ACK carries ``need_full``, so the
+        sender re-ships the full entry. The standby therefore always
+        holds FULL merged entries: adoption-time replay
+        (``apply_pool_wal``) never sees a frame."""
+        if held is None or held.get("delta") \
+                or int(held.get("wal_seq", -1)) \
+                != int(frame.get("base_seq", -2)):
+            return None
+        merged = dict(held)
+        merged.update(frame.get("fields", {}))
+        if "idem" in frame:
+            merged["idem"] = dict(frame["idem"])
+        reqs = dict(held.get("requests", {}))
+        for rid, req in frame.get("changed", {}).items():
+            reqs[rid] = req
+        for rid in frame.get("removed", ()):
+            reqs.pop(rid, None)
+        merged["requests"] = reqs
+        merged["wal_seq"] = int(frame["wal_seq"])
+        return merged
+
     def _on_member_change(self, host: str, old: MemberStatus | None,
                           new: MemberStatus) -> None:
-        # adopt when the CURRENT master (fence owner once one exists, the
-        # configured coordinator before any mint) is marked dead and this
-        # node is next in the chain
         if new is not MemberStatus.LEAVE:
             return
+        # scope-scoped adoption FIRST (ISSUE 15): ANY host's death makes
+        # each survivor adopt exactly the dead host's pool scopes that
+        # place on it — cluster mastership may not move at all
+        self._adopt_scopes_of(host)
+        # then cluster adoption: when the CURRENT master (fence owner once
+        # one exists, the configured coordinator before any mint) is the
+        # dead host and this node is next in the chain
         owner = self.membership.epoch.owner() or self.config.coordinator
         if host == owner and self.membership.acting_master() == self.host:
             self.adopt()
+
+    def _adopt_scopes_of(self, dead: str) -> None:
+        """Adopt the pool scopes the dead host owned (gossiped claims)
+        whose rendezvous placement over the survivors lands here: replay
+        exactly those scopes' WAL segments, mint their scope fences (the
+        dead owner's stamps are refused per pool from here on), and
+        claim ownership so routing converges. Every OTHER owner's scopes
+        are untouched — the blast radius of one death is exactly its own
+        scopes (ISSUE 15)."""
+        owners = getattr(self.membership, "owners", None)
+        mgr = self.lm_manager
+        if owners is None or mgr is None:
+            return
+        alive = set(self.membership.members.alive_hosts()) - {dead}
+        # quorum gate: an isolated minority falsely suspects the WHOLE
+        # majority — if it adopted their scopes it would mint claims and
+        # scope fences that win the merge at heal, deposing the rightful
+        # owners. A node may adopt a dead owner's scopes only while it
+        # sees a strict majority of the configured registry alive; a
+        # minority successor stays put (unavailable, never split-brained)
+        if 2 * len(alive | {self.host}) <= len(self.config.hosts):
+            return
+        scopes = [s for s in owners.owned_by(dead)
+                  if place_scope(s, self.config.hosts, alive) == self.host]
+        if not scopes:
+            return
+        want = set(scopes)
+        with self._lock:
+            pool_wal = {n: dict(d) for n, d in self._pool_wal.items()
+                        if pool_scope(n) in want}
+            scale_wal = {g: dict(d) for g, d in self._scale_wal.items()
+                         if pool_scope(g) in want}
+        svc = self.service
+        if pool_wal:
+            replayed = mgr.apply_pool_wal(pool_wal)
+            if replayed:
+                svc.metrics.record_counter("pool_wal_replayed", replayed)
+        if scale_wal:
+            mgr.apply_scale_wal(scale_wal)
+        for scope in scopes:
+            self.membership.scopes.fence(scope).mint(self.host)
+            svc.metrics.record_counter("pool_scope_adopted")
+            owners.claim(scope, self.host)
+            svc.metrics.record_counter("scope_owner_moves")
+        log.info("%s adopted %d pool scope(s) of dead owner %s: %s",
+                 self.host, len(scopes), dead, scopes)
+        mgr.on_adopt()
 
     def adopt(self) -> None:
         """Become the coordinator: mint a strictly higher epoch (fencing
@@ -356,33 +491,65 @@ class FailoverManager:
                 svc.record_idem(d["idem"], int(q))
         self.resume_in_flight()
         if self.lm_manager is not None:
+            # multi-owner filter (ISSUE 15): becoming cluster master
+            # adopts master DUTIES (CNN book, train jobs, fair share) —
+            # NOT every pool scope. A scope whose claimed owner is a
+            # SURVIVOR stays that owner's, untouched; scopes of the dead
+            # master (or unclaimed ones) load here only if their
+            # rendezvous placement over the survivors lands on this host
+            # (the scope's own successor adopted the rest via
+            # _adopt_scopes_of, which ran first).
+            owners = getattr(self.membership, "owners", None)
+            alive = set(self.membership.members.alive_hosts())
+
+            def keep(scope: str) -> bool:
+                if owners is None:
+                    return True
+                claimed = owners.owner(scope)
+                if claimed == self.host:
+                    return True
+                if claimed is not None and claimed in alive:
+                    return False    # surviving owner keeps serving
+                return place_scope(scope, self.config.hosts,
+                                   alive) == self.host
+
+            held_before = set(self.lm_manager.scope_names())
             loaded = False
             if snap is not None and "lm" in snap:
-                self.lm_manager.load_wire(snap["lm"])
+                self.lm_manager.load_wire(snap["lm"], keep_scope=keep)
                 loaded = True
             if scale_wal:
                 # scaling decisions WAL'd after the newest snapshot:
                 # replay them exactly (group wire entries are
                 # authoritative where their decision log is longer)
-                self.lm_manager.apply_scale_wal(scale_wal)
+                self.lm_manager.apply_scale_wal(scale_wal,
+                                                keep_scope=keep)
                 loaded = True
             if pool_wal:
                 # per-pool journal segments WAL'd after the newest
                 # snapshot: replay per scope — a pool whose wal_seq moved
                 # past the snapshot gets exactly its own newer journal
-                replayed = self.lm_manager.apply_pool_wal(pool_wal)
+                replayed = self.lm_manager.apply_pool_wal(pool_wal,
+                                                          keep_scope=keep)
                 if replayed:
                     svc.metrics.record_counter("pool_wal_replayed",
                                                replayed)
                 loaded = True
             if loaded:
                 # per-scope fences: mint a strictly-higher epoch for every
-                # adopted pool/group scope, so the deposed master's
-                # pool-directed stamps are rejected per pool — unrelated
-                # scopes (none here, but in general) keep their owner
+                # NEWLY adopted pool/group scope, so the deposed master's
+                # pool-directed stamps are rejected per pool — scopes this
+                # host already held (a surviving owner becoming master)
+                # keep their fence AND their claim untouched
                 for scope in self.lm_manager.scope_names():
+                    if scope in held_before:
+                        continue
                     self.membership.scopes.fence(scope).mint(self.host)
                     svc.metrics.record_counter("pool_scope_adopted")
+                    if owners is not None \
+                            and owners.owner(scope) != self.host:
+                        owners.claim(scope, self.host)
+                        svc.metrics.record_counter("scope_owner_moves")
                 self.lm_manager.on_adopt()
         if asp is not None:
             svc.spans.finish(

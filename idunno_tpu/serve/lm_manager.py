@@ -46,9 +46,9 @@ from typing import Any
 from idunno_tpu.comm.message import Message
 from idunno_tpu.comm.transport import Transport, TransportError
 from idunno_tpu.config import ClusterConfig
-from idunno_tpu.membership.epoch import (StaleEpoch, StaleScope, pool_scope,
-                                         reply_is_stale, reply_stale_scope,
-                                         stamp_scoped)
+from idunno_tpu.membership.epoch import (StaleEpoch, StaleScope, place_scope,
+                                         pool_scope, reply_is_stale,
+                                         reply_stale_scope, stamp_scoped)
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.serve.admission import PRIORITIES, shed_reason
 from idunno_tpu.serve.autoscaler import Autoscaler, AutoscalePolicy
@@ -142,6 +142,10 @@ class LMPoolManager:
         #          "decisions", "next_seq", "t_last_decision",
         #          "route_counts"}
         self._groups: dict[str, dict[str, Any]] = {}
+        # per-pool WAL delta baseline: the last FULL wire entry the scope
+        # standby ACKed, so _replicate_pool can ship journal deltas and
+        # fall back to a full entry on any gap (ISSUE 15)
+        self._wal_shipped: dict[str, dict[str, Any]] = {}
         # the control loop; tick() runs from pump_once, so it inherits
         # the acting-master gate. clock/gauges_fn are injectable
         # (tests/test_autoscaler.py, chaos harness).
@@ -260,14 +264,113 @@ class LMPoolManager:
         if dropped and self.service is not None:
             self.service.metrics.record_counter("pool_scope_fenced")
 
+    # -- scope ownership (ISSUE 15) ----------------------------------------
+
+    def step_down_scope(self, scope: str) -> None:
+        """Public step-down for one scope: drop its pools/groups from the
+        local registry (the new owner holds an at-least-as-new journal).
+        Same semantics as a fence-driven step-down."""
+        self._fence_scope(scope)
+
+    def _scope_held_locally(self, scope: str) -> bool:
+        with self._lock:
+            return (any(pool_scope(n) == scope for n in self._pools)
+                    or any(pool_scope(g) == scope for g in self._groups))
+
+    def _scope_names_nonempty(self) -> bool:
+        with self._lock:
+            return bool(self._pools or self._groups)
+
+    def _scope_owner(self, scope: str) -> str | None:
+        """Where ``scope``'s journal should live: the gossiped claim if
+        its holder is alive, else the deterministic rendezvous placement
+        over the alive hosts. None when the membership plane carries no
+        ownership map (bare test doubles) — callers then serve locally,
+        the pre-ISSUE-15 behavior."""
+        owners = getattr(self.membership, "owners", None)
+        if owners is None:
+            return None
+        claimed = owners.owner(scope)
+        alive = set(self.membership.members.alive_hosts())
+        if claimed in alive:
+            return claimed
+        return place_scope(scope, self.config.hosts, alive)
+
+    def _claim_scope(self, scope: str) -> None:
+        """Advisory ownership claim, gossiped on membership payloads.
+        Routing-only: the scope FENCE stays the safety mechanism — a
+        stale claim costs one redirect hop, never correctness."""
+        owners = getattr(self.membership, "owners", None)
+        if owners is not None and owners.owner(scope) != self.host:
+            owners.claim(scope, self.host)
+
+    def _assign_scope(self, owner: str, spec: dict[str, Any],
+                      scope: str) -> dict[str, Any] | None:
+        """Hand an lm_serve spec to the scope's placed owner. The payload
+        routes into the owner's ``_route_cluster`` (placement="assign",
+        NOT local) so the owner's manager journals the pool. Returns the
+        owner's reply, or None when the owner is unreachable — the caller
+        then serves locally and claims the scope itself."""
+        payload = dict(spec, verb="lm_serve", placement="assign",
+                       epoch=list(self.membership.epoch.view()))
+        stamp_scoped(self.membership.scopes, scope, payload)
+        try:
+            reply = self.transport.call(
+                owner, CONTROL,
+                Message(MessageType.INFERENCE, self.host, payload),
+                timeout=self.build_rpc_timeout_s)
+        except TransportError:
+            return None
+        if reply is None or reply_is_stale(self.membership.epoch, reply):
+            return None
+        if reply.type is MessageType.ERROR:
+            raise ValueError(f"{owner}: {reply.payload.get('error')}")
+        return dict(reply.payload, owner=owner)
+
+    def _step_down_moved_scopes(self) -> None:
+        """Drop any locally-held scope whose gossiped claim names another
+        ALIVE host: its adopter minted a higher claim (and fence) — the
+        fence would reject us anyway on the next stamped call, this just
+        stops the pump from re-serving a moved scope in the window before
+        that rejection lands."""
+        owners = getattr(self.membership, "owners", None)
+        if owners is None:
+            return
+        with self._lock:
+            held = {pool_scope(n) for n in self._pools}
+            held.update(pool_scope(g) for g in self._groups)
+        alive = set(self.membership.members.alive_hosts())
+        for scope in held:
+            o = owners.owner(scope)
+            if o is not None and o != self.host and o in alive:
+                self.step_down_scope(scope)
+
     # -- pools: client surface (acting master) -----------------------------
 
-    def serve(self, spec: dict[str, Any]) -> dict[str, Any]:
+    def serve(self, spec: dict[str, Any],
+              assigned: bool = False) -> dict[str, Any]:
         """Place a decode pool on the least-loaded alive node and register
         it. ``spec`` is the node-local ``lm_serve`` payload (name,
-        prompt_len, max_len, slots, draft, ...)."""
+        prompt_len, max_len, slots, draft, ...).
+
+        Multi-owner placement (ISSUE 15): the pool's fence scope has a
+        deterministic rendezvous owner over the alive hosts; when that
+        owner is another host, this manager hands the WHOLE spec over
+        (placement="assign") and the owner journals it locally — the
+        acting master never funnels every scope. ``assigned=True`` is the
+        landing half of that hop: serve here unconditionally, no
+        re-forward."""
         spec = {k: v for k, v in spec.items()
                 if k not in ("verb", "placement", "local", "reload")}
+        scope = pool_scope(spec["name"])
+        if not assigned and not self._scope_held_locally(scope):
+            owner = self._scope_owner(scope)
+            if owner is not None and owner != self.host:
+                out = self._assign_scope(owner, spec, scope)
+                if out is not None:
+                    return out
+                # owner unreachable: serve locally below and claim the
+                # scope ourselves so routing follows the journal
         auto = spec.pop("autoscale", None)
         if auto is not None:
             return self._serve_group(spec, auto)
@@ -311,6 +414,10 @@ class LMPoolManager:
                      "slots_target_prev": None,
                      "t_last_resize": 0.0}
             self._pools[name] = entry
+        # claim the scope at reservation time (not commit) so the gossiped
+        # owner map converges while the ~80 s build runs; a failed build
+        # leaves a harmless advisory claim (routing finds no pool)
+        self._claim_scope(pool_scope(name))
         try:
             node = self._place()
             out = self._call(node, dict(spec, verb="lm_serve"),
@@ -953,6 +1060,7 @@ class LMPoolManager:
                 # prefill-heavy admission fraction since group creation:
                 # feeds the autoscaler's role-split spawn choice
                 "route_counts": {"total": 0, "prefill": 0}}
+        self._claim_scope(pool_scope(name))
         spawned = []
         for _ in range(policy.min_replicas):
             d = self.group_spawn(name, role="decode")
@@ -1507,16 +1615,21 @@ class LMPoolManager:
                 "t_last_decision": float(g["t_last_decision"]),
                 "route_counts": dict(g["route_counts"])}
 
-    def apply_scale_wal(self, deltas: dict[str, Any]) -> None:
+    def apply_scale_wal(self, deltas: dict[str, Any],
+                        keep_scope=None) -> None:
         """Adoption-time replay of scale-WAL deltas (failover.py). Each
         delta carries the group's full wire entry at decision time;
         apply any strictly newer than the adopted snapshot — the
         decision journal is append-only, so 'newer' is just a longer
-        log (next_seq)."""
+        log (next_seq). ``keep_scope`` filters to the group scopes this
+        host actually adopts (scope-scoped adoption, ISSUE 15)."""
         with self._lock:
             for name, d in sorted(deltas.items()):
                 entry = d.get("entry")
                 if not entry:
+                    continue
+                if keep_scope is not None \
+                        and not keep_scope(pool_scope(name)):
                     continue
                 cur = self._groups.get(name)
                 if (cur is None or int(cur["next_seq"])
@@ -1627,11 +1740,20 @@ class LMPoolManager:
 
     def pump_once(self) -> None:
         """Forward pending requests, drain completions, refresh job
-        status. All RPCs outside the lock; only the acting master pumps
-        (the standby's copy stays passive until adoption)."""
-        if not self.membership.is_acting_master:
-            return
+        status. All RPCs outside the lock.
+
+        Multi-owner gate (ISSUE 15): any host holding pool scopes pumps
+        ITS pools/groups — scope owners are full control planes for their
+        journals, not passive standbys. Train jobs and the cluster-wide
+        fair share stay acting-master duties (they arbitrate the shared
+        CNN+LM capacity, which has exactly one arbiter)."""
+        master = self.membership.is_acting_master
+        self._step_down_moved_scopes()
         now = self.wall()
+        with self._lock:
+            has_lm = bool(self._pools or self._groups)
+        if not master and not has_lm:
+            return
         with self._lock:
             for pool in self._pools.values():
                 self._requeue_stale_locked(pool, now)
@@ -1640,8 +1762,8 @@ class LMPoolManager:
                           sorted(p["requests"].items())
                           if r["status"] == _PENDING])
                      for n, p in self._pools.items()}
-            jobs = [(n, j["node"]) for n, j in self._jobs.items()
-                    if not self._job_over(j)]
+            jobs = ([(n, j["node"]) for n, j in self._jobs.items()
+                     if not self._job_over(j)] if master else [])
             # stop-requested jobs whose node never confirmed: retry the
             # stop (the job may still be burning its node's chip)
             stop_retries = [
@@ -1649,7 +1771,8 @@ class LMPoolManager:
                 if j.get("stop_requested") and j["node"] is not None
                 and not ((j.get("status") or {}).get("stopped")
                          or (j.get("status") or {}).get("done")
-                         or (j.get("status") or {}).get("error"))]
+                         or (j.get("status") or {}).get("error"))] \
+                if master else []
         for name, (node, pending) in pools.items():
             if node is None:
                 self._recover_pool(name)
@@ -1682,10 +1805,11 @@ class LMPoolManager:
             have_groups = bool(self._groups)
         if have_groups:
             # replica-group upkeep + the closed capacity loop — both run
-            # only here, so they inherit the acting-master gate above
+            # only here, so they inherit the owner/master gate above
             self._ensure_group_replicas()
             self.autoscaler.tick()
-        self._update_fair_share()
+        if master:
+            self._update_fair_share()
 
     # -- heterogeneous fair share (round-2 VERDICT item 4) -----------------
 
@@ -1986,7 +2110,11 @@ class LMPoolManager:
     def _on_member_change(self, host: str, old, new) -> None:
         if new is not MemberStatus.LEAVE:
             return
-        if not self.membership.is_acting_master:
+        # multi-owner gate (ISSUE 15): every manager holding pools — the
+        # acting master AND every scope owner — recovers its own placed
+        # nodes; a non-master owner must not strand a dead pool node
+        if not (self.membership.is_acting_master
+                or self._scope_names_nonempty()):
             return
         with self._lock:
             dead_pools = [n for n, p in self._pools.items()
@@ -2174,12 +2302,38 @@ class LMPoolManager:
                                         "trace": None, **dict(r)}
                              for rid, r in p["requests"].items()}}
 
+    @staticmethod
+    def _pool_delta(base: dict[str, Any],
+                    cur: dict[str, Any]) -> dict[str, Any]:
+        """Delta frame between two wire entries: changed scalar fields +
+        changed/removed request rows since the standby's acked base.
+        Linear in the mutation, not the journal depth — the full-entry
+        ship was quadratic at depth (ISSUE 15 satellite)."""
+        fields = {k: v for k, v in cur.items()
+                  if k not in ("requests", "idem") and base.get(k) != v}
+        breq, creq = base.get("requests", {}), cur.get("requests", {})
+        frame = {"delta": True,
+                 "base_seq": int(base.get("wal_seq", 0)),
+                 "wal_seq": int(cur.get("wal_seq", 0)),
+                 "fields": fields,
+                 "changed": {rid: req for rid, req in creq.items()
+                             if breq.get(rid) != req},
+                 "removed": [rid for rid in breq if rid not in creq]}
+        if cur.get("idem") != base.get("idem"):
+            frame["idem"] = dict(cur.get("idem", {}))
+        return frame
+
     def _replicate_pool(self, name: str) -> None:
-        """Push the pool's full journal entry to the standby's per-pool
-        WAL segment (FailoverManager.wal_pool — the journal twin of the
+        """Push the pool's journal mutation to its scope standby's WAL
+        segment (FailoverManager.wal_pool — the journal twin of the
         scale WAL) between snapshots. ``wal_seq`` is the per-pool
         monotone the standby's keep-newest and ``apply_pool_wal`` dedupe
-        on, so a replayed/duplicated delta collapses per scope."""
+        on, so a replayed/duplicated delta collapses per scope.
+
+        Ships a DELTA since the standby's last acked full entry when one
+        exists; any gap (standby restarted, a frame lost, a need_full
+        NACK) falls back to the full entry — correctness never depends
+        on the delta chain, only the byte count does."""
         fo = self.failover
         if fo is None:
             return
@@ -2189,19 +2343,37 @@ class LMPoolManager:
                 return
             p["wal_seq"] = int(p.get("wal_seq", 0)) + 1
             entry = self._pool_wire(p)
-        fo.wal_pool(name, entry)
+            base = self._wal_shipped.get(name)
+        frame = entry if base is None else self._pool_delta(base, entry)
+        ack = fo.wal_pool(name, frame)
+        if ack is not None and ack.get("need_full") and frame is not entry:
+            ack = fo.wal_pool(name, entry)
+        with self._lock:
+            if ack is not None and not ack.get("need_full"):
+                self._wal_shipped[name] = entry
+            else:
+                # unacked: the standby's held base is unknown — next
+                # mutation re-ships full and re-seeds the chain
+                self._wal_shipped.pop(name, None)
 
-    def apply_pool_wal(self, deltas: dict[str, Any]) -> int:
+    def apply_pool_wal(self, deltas: dict[str, Any],
+                       keep_scope=None) -> int:
         """Adoption-time replay of per-pool WAL deltas (failover.py).
-        Each delta carries the pool's full wire entry at mutation time;
-        apply exactly those strictly newer (by wal_seq) than the adopted
-        snapshot's copy — one pool's fresher journal never disturbs
-        another's. Returns the number of pools replayed."""
+        Each delta carries the pool's full wire entry at mutation time
+        (the standby merges delta frames on receive, so adoption never
+        sees a frame); apply exactly those strictly newer (by wal_seq)
+        than the adopted snapshot's copy — one pool's fresher journal
+        never disturbs another's. ``keep_scope`` (scope-scoped adoption)
+        filters to the scopes this host actually adopts. Returns the
+        number of pools replayed."""
         n = 0
         with self._lock:
             for name, d in sorted(deltas.items()):
                 entry = d.get("entry")
-                if not entry:
+                if not entry or entry.get("delta"):
+                    continue
+                if keep_scope is not None \
+                        and not keep_scope(pool_scope(name)):
                     continue
                 cur = self._pools.get(name)
                 if (cur is None or int(cur.get("wal_seq", 0))
@@ -2233,17 +2405,47 @@ class LMPoolManager:
                            for n, g in self._groups.items()},
             }
 
-    def load_wire(self, snap: dict[str, Any]) -> None:
+    def load_wire(self, snap: dict[str, Any], keep_scope=None) -> None:
+        """Adopt a replicated snapshot. ``keep_scope=None`` is the
+        wholesale replace (the pre-ISSUE-15 standby shape). With a
+        predicate, adoption is scope-scoped and MERGING: only pools/
+        groups whose scope passes load, a local copy that is already
+        NEWER (per-pool wal_seq / group next_seq — WAL replay may have
+        landed first) is kept, and everything this manager already
+        holds — a surviving owner's own scopes — stays untouched. Jobs
+        always load: they are an acting-master duty, and a filtered
+        load only ever runs while adopting mastership."""
         with self._lock:
-            self._pools = {n: self._pool_from_wire(p)
-                           for n, p in snap.get("pools", {}).items()}
+            for n, p in snap.get("pools", {}).items():
+                if keep_scope is not None \
+                        and not keep_scope(pool_scope(n)):
+                    continue
+                cur = self._pools.get(n)
+                if (keep_scope is not None and cur is not None
+                        and int(cur.get("wal_seq", 0))
+                        >= int(p.get("wal_seq", 0))):
+                    continue
+                self._pools[n] = self._pool_from_wire(p)
+            for n, d in snap.get("groups", {}).items():
+                if keep_scope is not None \
+                        and not keep_scope(pool_scope(n)):
+                    continue
+                cur = self._groups.get(n)
+                if (keep_scope is not None and cur is not None
+                        and int(cur["next_seq"])
+                        >= int(d.get("next_seq", 0))):
+                    continue
+                self._groups[n] = self._group_from_wire(d)
             self._jobs = {
                 n: {"spec": dict(j["spec"]), "node": j["node"],
                     "stop_requested": bool(j.get("stop_requested")),
                     "status": dict(j["status"]) if j["status"] else None}
                 for n, j in snap.get("jobs", {}).items()}
-            self._groups = {n: self._group_from_wire(d)
-                            for n, d in snap.get("groups", {}).items()}
+            if keep_scope is None:
+                self._pools = {n: p for n, p in self._pools.items()
+                               if n in snap.get("pools", {})}
+                self._groups = {n: g for n, g in self._groups.items()
+                                if n in snap.get("groups", {})}
 
     def on_adopt(self) -> None:
         """Called by the failover manager when this standby becomes the

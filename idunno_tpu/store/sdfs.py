@@ -359,6 +359,14 @@ class FileStoreService:
             return Message(MessageType.ACK, self.host,
                            {"files": self.local.files(),
                             "tombstones": self.local.tombstones()})
+        if msg.type is MessageType.STAT and "names" in msg.payload:
+            # batched inventory probe (ISSUE 15): one round-trip answers
+            # every name a resolving master needs from this host
+            files, tombs = self.local.files(), self.local.tombstones()
+            return Message(MessageType.ACK, self.host,
+                           {"stats": {n: {"versions": files.get(n, []),
+                                          "tombstone": tombs.get(n, 0)}
+                                      for n in msg.payload["names"]}})
         name = msg.payload["name"]
         if msg.type is MessageType.STAT:       # per-key inventory probe
             return Message(MessageType.ACK, self.host,
@@ -550,43 +558,71 @@ class FileStoreService:
         version is at or below the newest tombstone stays dead — delete
         semantics survive failover without any cluster-wide sweep — and
         the tombstone is adopted locally so a later re-put reserves past
-        it."""
-        alive = set(self.membership.members.alive_hosts())
-        targets = [h for h in ring_order(name, self.config.hosts)
-                   if h in alive][:self.config.replication_factor + 2]
-        for h in (self.config.coordinator, self.config.standby_coordinator,
-                  self.host):
-            if (h in alive or h == self.host) and h not in targets:
-                targets.append(h)
-        req = Message(MessageType.STAT, self.host,
-                      {"name": name, "internal": True,
-                       "epoch": list(self.membership.epoch.view())})
-        latest, tomb = 0, self.local.tombstones().get(name, 0)
-        holders: set[str] = set()
-        for h in targets:
-            if h == self.host:
-                vs = self.local.files().get(name, [])
-            else:
-                try:
-                    out = self.transport.call(h, SERVICE, req, timeout=10.0)
-                except TransportError:
-                    continue
-                if out is None or out.type is not MessageType.ACK:
-                    continue
-                vs = out.payload.get("versions", [])
-                tomb = max(tomb, int(out.payload.get("tombstone", 0)))
-            if vs:
-                latest = max(latest, max(int(v) for v in vs))
-                holders.add(h)
-        if latest <= tomb:
-            if tomb > self.local.tombstones().get(name, 0):
-                # adopt the newest tombstone so version numbers stay
-                # monotone when this master re-puts the deleted name
-                self.local.delete(name, tomb)
+        it. Delegates to the batched `_resolve_many`."""
+        self._resolve_many([name])
+
+    def _resolve_many(self, names: list[str]) -> None:
+        """Batched resolution (ISSUE 15 satellite): ONE internal STAT
+        round-trip per distinct target host covering every name whose
+        ring window lands there, instead of a per-name probe fan-out.
+        Each name merges exactly as the per-key `_resolve` contract
+        states — max surviving version, newest tombstone, holders only
+        for hosts that actually hold the name."""
+        names = list(dict.fromkeys(names))
+        if not names:
             return
-        with self._meta_lock:
-            self._versions[name] = max(self._versions.get(name, 0), latest)
-            self._locations.setdefault(name, set()).update(holders)
+        alive = set(self.membership.members.alive_hosts())
+        per_name: dict[str, list[str]] = {}
+        host_names: dict[str, list[str]] = {}
+        for name in names:
+            targets = [h for h in ring_order(name, self.config.hosts)
+                       if h in alive][:self.config.replication_factor + 2]
+            for h in (self.config.coordinator,
+                      self.config.standby_coordinator, self.host):
+                if (h in alive or h == self.host) and h not in targets:
+                    targets.append(h)
+            per_name[name] = targets
+            for h in targets:
+                host_names.setdefault(h, []).append(name)
+        stats: dict[str, dict] = {}
+        for h, ns in host_names.items():
+            if h == self.host:
+                files, tombs = self.local.files(), self.local.tombstones()
+                stats[h] = {n: {"versions": files.get(n, []),
+                                "tombstone": tombs.get(n, 0)} for n in ns}
+                continue
+            req = Message(MessageType.STAT, self.host,
+                          {"names": list(ns), "internal": True,
+                           "epoch": list(self.membership.epoch.view())})
+            try:
+                out = self.transport.call(h, SERVICE, req, timeout=10.0)
+            except TransportError:
+                continue
+            if out is None or out.type is not MessageType.ACK:
+                continue
+            stats[h] = out.payload.get("stats", {})
+        for name in names:
+            latest, tomb = 0, self.local.tombstones().get(name, 0)
+            holders: set[str] = set()
+            for h in per_name[name]:
+                st = stats.get(h, {}).get(name)
+                if st is None:
+                    continue
+                tomb = max(tomb, int(st.get("tombstone", 0)))
+                vs = st.get("versions", [])
+                if vs:
+                    latest = max(latest, max(int(v) for v in vs))
+                    holders.add(h)
+            if latest <= tomb:
+                if tomb > self.local.tombstones().get(name, 0):
+                    # adopt the newest tombstone so version numbers stay
+                    # monotone when this master re-puts the deleted name
+                    self.local.delete(name, tomb)
+                continue
+            with self._meta_lock:
+                self._versions[name] = max(self._versions.get(name, 0),
+                                           latest)
+                self._locations.setdefault(name, set()).update(holders)
 
     def _master_get(self, name: str, want: int | None = None,
                     trace: tuple | None = None) -> Message:

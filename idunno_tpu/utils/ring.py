@@ -25,3 +25,15 @@ def ring_order(name: str, hosts: tuple[str, ...] | list[str]) -> list[str]:
     n = len(hosts)
     start = hash_ring_index(name, n)
     return [hosts[(start + i) % n] for i in range(n)]
+
+
+def rendezvous_order(name: str,
+                     hosts: tuple[str, ...] | list[str]) -> list[str]:
+    """Highest-random-weight (rendezvous) preference order of ``hosts``
+    for ``name``: every node computes the same ranking from the full
+    configured registry, and removing one host perturbs only the names
+    that ranked it first — the minimal-disruption property ring slots
+    don't have. Ties (crc32 collisions) break on the host name so the
+    order is total."""
+    return sorted(hosts,
+                  key=lambda h: (-zlib.crc32(f"{h}|{name}".encode()), h))

@@ -105,7 +105,9 @@ def test_lm_submit_retry_and_lost_forward_dedupe(tmp_path):
     master's forward to the pool node loses its reply → the pump
     re-forwards under the same node-side key → the node decodes once."""
     c = ChaosCluster(303, str(tmp_path))
-    mgr = c.managers["n0"]
+    # the pool's journal lives on its rendezvous scope owner (n4 for
+    # pool:chaos-lm over n0..n4), not on the cluster master
+    mgr = c.managers["n4"]
     # (a) client-side: same key twice → same rid, single journal row
     p = {"verb": "lm_submit", "name": c.LM_POOL,
          "prompt": [9, 9, 9], "max_new": 4, "seed": 9}
@@ -118,7 +120,7 @@ def test_lm_submit_retry_and_lost_forward_dedupe(tmp_path):
         assert len(pool["requests"]) == 1
     # (b) node-side: lose the forward's reply; the pump's re-forward must
     # hit the node's dedupe, not decode a second copy
-    c.net.lose_next_reply("n0", node)
+    c.net.lose_next_reply("n4", node)
     c._client_control("n3", {"verb": "lm_submit", "name": c.LM_POOL,
                              "prompt": [8, 8, 8], "max_new": 4,
                              "seed": 8}, idem="n3:k2")
@@ -183,7 +185,11 @@ def test_autoscale_partition_mid_scale_out(tmp_path):
         c.pump_work()
         c.record_fences()
     assert c.members["n1"].is_acting_master
-    g1 = c.managers["n1"]._groups.get(c.LM_GROUP)
+    # scoped adoption (ISSUE 15): the group's scope rendezvous-places on
+    # n3 among the survivors (order n0→n3→n4→… for pool:chaos-grp), so
+    # n3 — not the new cluster master — replays the scale WAL and owns
+    # the group from here
+    g1 = c.managers["n3"]._groups.get(c.LM_GROUP)
     assert g1 is not None, "adoption lost the replica group"
     # new-master lineage continues: more admissions, then underload so
     # the loop drains a replica and retires it with zero loss
@@ -202,7 +208,7 @@ def test_autoscale_partition_mid_scale_out(tmp_path):
     # overload-era replica, or fresh decisions) without ever reusing a
     # replica name — the no-double-spawn invariant inside
     # check_invariants covers the journal; spot-check the epochs moved
-    g1 = c.managers["n1"]._groups[c.LM_GROUP]
+    g1 = c.managers[c._pool_owner(c.LM_GROUP)]._groups[c.LM_GROUP]
     eps = [int(d["epoch"][0]) for d in g1["decisions"]]
     assert eps and eps[-1] >= 1, eps     # post-adoption decisions fenced
     assert summary["grp_acked"] >= 2
@@ -219,12 +225,16 @@ def test_multi_pool_seeded_schedule_invariants(tmp_path):
 
 
 def test_pool_fence_cross_pool_isolation(tmp_path):
-    """ISSUE 14 directed schedule: partition deposes pool A's fence owner
-    mid-stream while pool B keeps serving — pool B completes with ZERO
-    resubmission, and pool A replays exactly-once after the scoped
-    adoption (the per-pool journal replay covers only pool A's scope)."""
+    """ISSUE 14/15 directed schedule: the two pools have DISTINCT
+    rendezvous owners (pool:chaos-lm → n4; pool:chaos-lmB → n0, which is
+    also the cluster master). Isolating n0 deposes the cluster master
+    AND pool B's owner in one stroke — pool B's scope adopts at its
+    rendezvous successor n3 with an exactly-once journal replay, while
+    pool A's owner n4 keeps serving UNINTERRUPTED: its scope fence never
+    moves, its ownership never changes hands, and its node tier sees
+    zero resubmission. Blast radius = exactly the dead owner's scopes."""
     c = ChaosCluster(616, str(tmp_path), multi_pool=True)
-    c.pump_work()        # replication cycle: standby snapshot + pool WALs
+    c.pump_work()        # replication cycle: per-scope WALs shipped
     # in-flight work on BOTH pools before the fault
     for client in ("n2", "n3"):
         c.op_lm(client)
@@ -232,26 +242,29 @@ def test_pool_fence_cross_pool_isolation(tmp_path):
         c.pump_membership(waves=1)
         c.pump_work()
         c.record_fences()
-    # pool B's requests complete under the ORIGINAL master; snapshot its
-    # node-side submit count so post-adoption resubmission would show
-    mgrs0 = c.managers["n0"]
-    with mgrs0._lock:
-        b_node = mgrs0._pools[c.LM_POOL_B]["node"]
-        b_reqs0 = dict(mgrs0._pools[c.LM_POOL_B]["requests"])
-    b_next0 = c.controls[b_node]._loops[c.LM_POOL_B]["next"]
-    assert all(r["status"] == "done" for r in b_reqs0.values()), b_reqs0
-    # depose the master: the standby's scoped adoption mints BOTH pool
-    # fences (its manager journals both scopes) and replays each pool's
-    # journal independently
+    # pool A (chaos-lm) lives on surviving owner n4: snapshot its
+    # node-side submit counter so post-fault resubmission would show
+    mgr4 = c.managers["n4"]
+    with mgr4._lock:
+        a_node = mgr4._pools[c.LM_POOL]["node"]
+        a_reqs0 = dict(mgr4._pools[c.LM_POOL]["requests"])
+    a_next0 = c.controls[a_node]._loops[c.LM_POOL]["next"]
+    assert all(r["status"] == "done" for r in a_reqs0.values()), a_reqs0
+    # depose the cluster master = pool B's owner; pool A's owner survives
     c.op_isolate("n0")
     for _ in range(10):
         c.pump_membership(waves=1)
         c.pump_work()
         c.record_fences()
     assert c.members["n1"].is_acting_master
+    # ONLY pool B's scope fence minted (scoped adoption at successor n3);
+    # pool A's fence never moved — its owner was never deposed
     scopes1 = dict(c.members["n1"].scopes.view_all())
-    assert scopes1.get(f"pool:{c.LM_POOL}", [0])[0] >= 1
     assert scopes1.get(f"pool:{c.LM_POOL_B}", [0])[0] >= 1
+    assert scopes1.get(f"pool:{c.LM_POOL}", [0, None])[0] == 0
+    assert c.managers["n3"].has_pool(c.LM_POOL_B), \
+        "pool B's journal did not adopt at its scope successor"
+    assert c.members["n1"].owners.owner(f"pool:{c.LM_POOL}") == "n4"
     # new-lineage work on both pools, then converge + full invariants
     for client in ("n2", "n4"):
         c.op_lm(client)
@@ -263,14 +276,89 @@ def test_pool_fence_cross_pool_isolation(tmp_path):
     summary = c.check_invariants()
     assert summary["final_master"] == "n1"
     assert not c.violations
-    # zero resubmission into pool B's node tier: every pre-fault pool-B
-    # request was already done, so the adopted journal re-forwards
-    # nothing — the node-side rid counter moved only for NEW submissions
+    # zero resubmission into pool A's node tier: every pre-fault pool-A
+    # request was already done and its owner was never deposed — the
+    # node-side rid counter moved only for NEW submissions
+    a_next1 = c.controls[a_node]._loops[c.LM_POOL]["next"]
+    assert a_next1 - a_next0 == summary["lm_acked"] - len(a_reqs0)
+    # pool B's scope minted by the adoption; pool A's never did, and the
+    # ownership map moved only for the dead owner's scope
+    assert summary["pool_epochs"][f"pool:{c.LM_POOL_B}"] >= 1
+    assert f"pool:{c.LM_POOL}" not in summary["pool_epochs"]
+    assert summary["scope_owners"][f"pool:{c.LM_POOL_B}"] == "n3"
+    assert summary["scope_owners"][f"pool:{c.LM_POOL}"] == "n4"
+
+
+def test_scope_owner_death_blast_radius(tmp_path):
+    """ISSUE 15 acceptance schedule: three managed scopes spread over two
+    distinct owners (pool:chaos-lm → n4; pool:chaos-lmB and pool:chaos-grp
+    → n0, the cluster master). Kill the NON-master owner n4 — only its
+    scope adopts (at rendezvous successor n1), the cluster fence never
+    moves, the surviving owners' pools serve uninterrupted with zero
+    resubmission and zero fence movement, and the dead owner comes back
+    fenced for exactly its old scope."""
+    c = ChaosCluster(717, str(tmp_path), multi_pool=True, autoscale=True)
+    c.pump_work()        # replication cycle: per-scope WALs shipped
+    # the placement the whole test hangs on: two distinct owners
+    assert c.expected_owners == {f"pool:{c.LM_POOL}": "n4",
+                                 f"pool:{c.LM_POOL_B}": "n0",
+                                 f"pool:{c.LM_GROUP}": "n0"}
+    for client in ("n1", "n2"):
+        c.op_lm(client)
+        c.op_lm_b(client)
+        c.op_lm_group(client)
+        c.pump_membership(waves=1)
+        c.pump_work()
+        c.record_fences()
+    # surviving pool B: snapshot its node tier so resubmission would show
+    mgr0 = c.managers["n0"]
+    with mgr0._lock:
+        b_node = mgr0._pools[c.LM_POOL_B]["node"]
+        b_reqs0 = dict(mgr0._pools[c.LM_POOL_B]["requests"])
+    b_next0 = c.controls[b_node]._loops[c.LM_POOL_B]["next"]
+    assert all(r["status"] == "done" for r in b_reqs0.values()), b_reqs0
+    epoch0 = c.members["n0"].epoch.view()
+    # isolate the owner of pool:chaos-lm — NOT the cluster master
+    c.op_isolate("n4")
+    for _ in range(10):
+        c.pump_membership(waves=1)
+        c.pump_work()
+        c.record_fences()
+    # cluster mastership never moved: the death was not the master's
+    assert c.members["n0"].is_acting_master
+    assert c.members["n0"].epoch.view() == epoch0
+    # ONLY the dead owner's scope adopted — at its successor n1, which
+    # now holds the journal and the minted scope fence
+    assert c.managers["n1"].has_pool(c.LM_POOL), \
+        "dead owner's pool did not adopt at its scope successor"
+    assert c.members["n0"].owners.owner(f"pool:{c.LM_POOL}") == "n1"
+    assert c.members["n0"].owners.owner(f"pool:{c.LM_POOL_B}") == "n0"
+    assert c.members["n0"].owners.owner(f"pool:{c.LM_GROUP}") == "n0"
+    scopes0 = dict(c.members["n0"].scopes.view_all())
+    assert scopes0.get(f"pool:{c.LM_POOL}", [0])[0] >= 1
+    assert scopes0.get(f"pool:{c.LM_POOL_B}", [0, None])[0] == 0
+    assert scopes0.get(f"pool:{c.LM_GROUP}", [0, None])[0] == 0
+    # surviving scopes keep serving mid-outage, uninterrupted
+    for client in ("n2", "n3"):
+        c.op_lm(client)
+        c.op_lm_b(client)
+        c.pump_membership(waves=1)
+        c.pump_work()
+        c.record_fences()
+    c.converge()
+    summary = c.check_invariants()
+    assert summary["final_master"] == "n0"
+    assert not c.violations
+    # zero resubmission into the surviving pool's node tier: every
+    # pre-fault pool-B request was done before the fault, so the
+    # node-side rid counter moved only for NEW submissions
     b_next1 = c.controls[b_node]._loops[c.LM_POOL_B]["next"]
     assert b_next1 - b_next0 == summary["lmb_acked"] - len(b_reqs0)
-    # both pool scopes minted exactly once, by the adopter
-    assert summary["pool_epochs"][f"pool:{c.LM_POOL}"] >= 1
-    assert summary["pool_epochs"][f"pool:{c.LM_POOL_B}"] >= 1
+    # exactly one ownership move (the dead owner's scope), none else
+    assert summary["owner_moves"] == 1
+    assert summary["scope_owners"][f"pool:{c.LM_POOL}"] == "n1"
+    assert f"pool:{c.LM_POOL_B}" not in summary["pool_epochs"]
+    assert f"pool:{c.LM_GROUP}" not in summary["pool_epochs"]
 
 
 def test_invariant_trip_snapshots_span_dump(tmp_path):
@@ -302,8 +390,9 @@ def test_invariant_trip_snapshots_span_dump(tmp_path):
     traces = {s["trace_id"] for spans in dump.values() for s in spans}
     assert root.trace_id in traces, \
         "dump names the failing request's trace"
-    # both the client hop (n3) and the master's journal booking (n0) are
-    # in the snapshot under that one trace
+    # both the client hop (n3) and the journal booking — on the pool's
+    # scope OWNER (n4), not the master — are in the snapshot under that
+    # one trace
     assert any(s["name"] == "client.lm_submit" for s in dump["n3"])
     assert any(s["name"] == "lm.submit"
-               and s["trace_id"] == root.trace_id for s in dump["n0"])
+               and s["trace_id"] == root.trace_id for s in dump["n4"])

@@ -398,8 +398,10 @@ def test_gateway_pool_survives_coordinator_partition(tmp_path):
     token-exact — replays carry readmit=True, so admitted-but-unfinished
     work from a rate-capped tenant bypasses the drained token bucket (the
     client was already told it was in). After the heal the deposed
-    coordinator is fenced: its managed verbs are refused, its stale-epoch
-    pump traffic is rejected, and it never acts as master again."""
+    coordinator is fenced: it never serves a managed verb from its own
+    (empty/divergent) journal — owner-aware routing forwards the call one
+    counted hop to the scope's claimed owner — and it never acts as
+    master again."""
     net = InProcNetwork()
     cfg, nodes = _cluster(tmp_path, net)
     try:
@@ -492,13 +494,19 @@ def test_gateway_pool_survives_coordinator_partition(tmp_path):
         assert not nodes["n0"].membership.is_acting_master
         assert nodes["n0"].membership.epoch.view() == (epoch, "n1")
 
-        # a managed verb on the deposed coordinator is refused outright —
-        # its divergent journal must never take bookings again
+        # a managed verb on the deposed coordinator never touches its own
+        # (empty) journal: owner-aware routing forwards it one counted hop
+        # to the scope's claimed owner, whose journal answers
+        before = nodes["n0"].metrics.counters().get(
+            "scope_owner_redirects", 0)
         out = nodes["n0"].control._handle("control", Message(
             MessageType.INFERENCE, "client",
             {"verb": "lm_stats", "name": "klm"}))
-        assert out.type is MessageType.ERROR, out.payload
-        assert "acting master" in out.payload["error"], out.payload
+        assert out.type is MessageType.ACK, out.payload
+        assert out.payload["stats"]["journal"]["shed"] == 1, out.payload
+        assert nodes["n0"].metrics.counters().get(
+            "scope_owner_redirects", 0) == before + 1
+        assert not nodes["n0"].membership.is_acting_master
     finally:
         for n in nodes.values():
             n.stop()

@@ -108,27 +108,31 @@ def test_scope_views_ride_membership_gossip():
 
 def test_manager_fences_one_scope_only(tmp_path):
     """A stale-scope rejection drops the named scope's pools/groups from
-    the deposed manager — other pools keep serving untouched, and the
-    cluster fence never moves."""
-    c = ChaosCluster(42, str(tmp_path), multi_pool=True)
+    the deposed manager — its other scopes keep serving untouched, and
+    the cluster fence never moves. Under ISSUE 15 rendezvous ownership
+    the scopes are spread: n0 owns pool:chaos-lmB plus the group scope,
+    n4 owns pool:chaos-lm — fencing the lmB scope at n0 leaves both the
+    group (same manager) and pool A (different owner) untouched."""
+    c = ChaosCluster(42, str(tmp_path), multi_pool=True, autoscale=True)
     mgr = c.managers["n0"]
-    scope_a = f"pool:{c.LM_POOL}"
-    assert mgr.scope_names() == sorted([scope_a, f"pool:{c.LM_POOL_B}"])
-    # a peer that saw a higher epoch for pool A's scope rejects the
-    # manager's next scoped call; the manager fences pool A only
+    scope_b = f"pool:{c.LM_POOL_B}"
+    assert mgr.scope_names() == sorted([scope_b, f"pool:{c.LM_GROUP}"])
+    assert c.managers["n4"].scope_names() == [f"pool:{c.LM_POOL}"]
+    # a peer that saw a higher epoch for pool B's scope rejects the
+    # manager's next scoped call; the manager fences pool B only
     target = next(h for h in c.cfg.hosts if h != "n0")
-    c.members[target].scopes.fence(scope_a).mint("n1")
+    c.members[target].scopes.fence(scope_b).mint("n1")
     with pytest.raises(StaleScope) as ei:
-        mgr._call(target, {"verb": "lm_qos", "name": c.LM_POOL,
-                           "local": True}, scope=scope_a)
-    assert ei.value.scope == scope_a
+        mgr._call(target, {"verb": "lm_qos", "name": c.LM_POOL_B,
+                           "local": True}, scope=scope_b)
+    assert ei.value.scope == scope_b
     assert ei.value.epoch == 1 and ei.value.owner == "n1"
     with mgr._lock:
-        assert c.LM_POOL not in mgr._pools          # fenced scope dropped
-        assert c.LM_POOL_B in mgr._pools            # other pool untouched
-    assert mgr.scope_names() == [f"pool:{c.LM_POOL_B}"]
+        assert c.LM_POOL_B not in mgr._pools        # fenced scope dropped
+    assert mgr.scope_names() == [f"pool:{c.LM_GROUP}"]  # group untouched
+    assert c.managers["n4"].has_pool(c.LM_POOL)     # other owner untouched
     # the deposed manager observed the scope's higher view...
-    assert c.members["n0"].scopes.fence(scope_a).view() == (1, "n1")
+    assert c.members["n0"].scopes.fence(scope_b).view() == (1, "n1")
     # ...but its CLUSTER fence is untouched: pool deposal is not deposal
     assert c.members["n0"].epoch.view() == (0, None)
     assert c.members["n0"].is_acting_master

@@ -168,6 +168,55 @@ def test_master_failover_preserves_files(cluster):
     assert v2 == 2
 
 
+def test_resolve_many_batches_stat_probes(cluster):
+    """ISSUE 15 satellite: a fresh master resolving a SET of unknown
+    keys sends at most ONE internal STAT per target host (batched
+    "names" payload over the union of the names' ring windows), not a
+    per-name probe fan-out — and every name still resolves to its
+    surviving latest version with real holders."""
+    cfg, net, clock, members, stores = cluster
+    names = [f"batch{i}.bin" for i in range(4)]
+    for i, n in enumerate(names):
+        stores["n2"].put_bytes(n, f"payload{i}".encode())
+    net.kill("n0")
+    pump(members, clock, waves=8, dt=0.3)
+    members["n1"].monitor_once()        # standby notices, takes over
+    assert members["n1"].is_acting_master
+    stores["n1"].join_repair()
+    pump(members, clock, waves=2)
+    fresh = stores["n1"]
+    # drop any metadata the standby already held so every name MUST probe
+    with fresh._meta_lock:
+        for n in names:
+            fresh._versions.pop(n, None)
+            fresh._locations.pop(n, None)
+    calls = []
+    real_call = fresh.transport.call
+
+    def counting_call(host, service, msg, **kw):
+        if msg.payload.get("internal") and msg.type.name == "STAT":
+            calls.append((host, tuple(msg.payload.get("names", ()))
+                          or (msg.payload.get("name"),)))
+        return real_call(host, service, msg, **kw)
+
+    fresh.transport.call = counting_call
+    try:
+        fresh._resolve_many(names)
+    finally:
+        fresh.transport.call = real_call
+    hosts_probed = [h for h, _ in calls]
+    assert hosts_probed, "no probes at all — nothing was resolved"
+    assert len(hosts_probed) == len(set(hosts_probed)), \
+        f"per-host batching violated: {calls}"
+    # the batched wire format carried real name lists, never the
+    # single-name format in a loop
+    assert all(ns and None not in ns for _, ns in calls), calls
+    with fresh._meta_lock:
+        for n in names:
+            assert fresh._versions.get(n) == 1, n
+            assert fresh._locations.get(n), n
+
+
 def test_sanitized_name_survives_failover(cluster):
     # names needing sanitisation must still resolve after metadata rebuild
     cfg, net, clock, members, stores = cluster

@@ -279,9 +279,11 @@ def test_retry_keeps_one_trace_with_duplicate_span_visible(tmp_path):
 
 def test_trace_survives_failover_adoption(tmp_path):
     """The journaled trace ctx rides standby replication: after the
-    coordinator is isolated and the standby adopts (epoch bump), the new
-    owner still resolves the old request's trace id, records the adoption
-    as a span, and books fresh traced submits under ITS node name."""
+    coordinator AND the pool's scope owner are isolated, n1 — cluster
+    standby and the scope's rendezvous successor — adopts both (epoch
+    bump + scoped journal replay), still resolves the old request's
+    trace id, records the adoption as a span, and books fresh traced
+    submits under ITS node name."""
     from idunno_tpu.chaos import ChaosCluster
 
     c = ChaosCluster(616, str(tmp_path))
@@ -299,8 +301,8 @@ def test_trace_survives_failover_adoption(tmp_path):
                "trace": [root.trace_id, root.span_id]}, idem="n3:tr1")
     rid = int(out["id"])
     c.spans["n3"].finish(root, rid=rid)
-    assert c.managers["n0"].trace_of(c.LM_POOL, rid) == root.trace_id
-    c.pump_membership(waves=1)
+    assert c.managers["n4"].trace_of(c.LM_POOL, rid) == root.trace_id
+    c.pump_membership(waves=3)          # ownership claim gossips out
     c.pump_work()                       # journal reaches the standby
     # a second submit lands AFTER the snapshot replication above: its
     # synchronous write-ahead makes pool A's WAL strictly newer than the
@@ -311,8 +313,11 @@ def test_trace_survives_failover_adoption(tmp_path):
     c._client_control("n3", {"verb": "lm_submit", "name": c.LM_POOL,
                              "prompt": [9, 9, 9], "max_new": 4,
                              "seed": 9}, idem="n3:tr3")
-    c.op_isolate("n0")
-    for _ in range(10):                 # push past the suspicion timeout
+    c.op_isolate("n0")                  # deposes the cluster master...
+    c.op_isolate("n4")                  # ...and pool A's scope owner
+    # push past BOTH suspicion timeouts: the standby's monitor notices
+    # n0 fast, peer failure detection of n4 takes a few more waves
+    for _ in range(18):
         c.pump_membership(waves=1)
         c.pump_work()
         c.record_fences()
@@ -478,6 +483,12 @@ def test_two_node_cluster_collects_lm_trace(tmp_path):
         # PR-5 durability-gap counter joins the scrape (ISSUE 14): acked
         # work whose write-ahead was skipped because the standby was down
         assert 'idunno_gauge{node="n0",name="wal_skips"}' in text
+        # ISSUE 15: the delta-WAL byte gauge and the ownership-routing
+        # counters join the scrape unconditionally (zero-valued until
+        # the first redirect / scope handoff)
+        assert 'idunno_gauge{node="n0",name="pool_wal_bytes"}' in text
+        assert 'name="scope_owner_redirects"' in text
+        assert 'name="scope_owner_moves"' in text
         remote = _call(nodes["n0"], {"verb": "metrics_export",
                                      "host": "n1"})["text"]
         assert 'node="n1"' in remote

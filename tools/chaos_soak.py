@@ -74,6 +74,13 @@ def main() -> int:
     worst_convergence = 0.0
     epochs_total = 0
     pool_epochs: dict[str, int] = {}
+    # ISSUE 15 ownership ledger: the final rendezvous owner per scope
+    # (last schedule's converged claim map wins — same scopes recur
+    # across schedules) and the total ownership handoffs observed. The
+    # owner-SPREAD invariant (multi-pool scopes land on >=2 distinct
+    # hosts) is asserted inside every schedule's check_invariants.
+    owner_moves_total = 0
+    scope_owners: dict[str, str] = {}
     work = {"cnn_acked": 0, "lm_acked": 0, "lmb_acked": 0,
             "sdfs_acked": 0, "spans_recorded": 0}
     for i in range(args.schedules):
@@ -117,6 +124,8 @@ def main() -> int:
         epochs_total += out["epochs"]
         for scope, e in out.get("pool_epochs", {}).items():
             pool_epochs[scope] = max(pool_epochs.get(scope, 0), int(e))
+        owner_moves_total += int(out.get("owner_moves", 0))
+        scope_owners.update(out.get("scope_owners", {}))
         for k in work:
             work[k] += out.get(k, 0)
     print(json.dumps({
@@ -125,6 +134,8 @@ def main() -> int:
         "violations": failures,
         "epochs_minted_total": epochs_total,
         "pool_epochs": pool_epochs,
+        "scope_owners": scope_owners,
+        "owner_moves": owner_moves_total,
         "worst_convergence_s": round(worst_convergence, 3),
         **work}))
     return 0 if not failures else 1
