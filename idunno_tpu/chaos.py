@@ -57,6 +57,10 @@ from idunno_tpu.membership.epoch import (check_payload, check_scoped,
                                          pool_scope)
 from idunno_tpu.membership.service import MembershipService
 from idunno_tpu.scheduler.fair import FairScheduler
+# the typed owner-hop relay is SHARED with the real control plane (ISSUE
+# 16): one class, so the sim and the product can never drift on which
+# markers survive a forwarded hop
+from idunno_tpu.serve.control import RelayedError as _RelayedError
 from idunno_tpu.serve.failover import FailoverManager
 from idunno_tpu.serve.inference_service import (InferenceService,
                                                 InferenceServiceError)
@@ -112,14 +116,6 @@ def lm_tokens(prompt: list[int], seed: int, max_new: int) -> list[int]:
                            for i in range(max_new)]
 
 
-class _RelayedError(Exception):
-    """An ERROR reply from a forwarded owner hop, relayed verbatim: the
-    payload keeps its typed markers (``stale_epoch``, ``scope_owner``)
-    so the CLIENT's retry logic still sees them through the proxy."""
-
-    def __init__(self, payload: dict) -> None:
-        super().__init__(payload.get("error", "relayed error"))
-        self.payload = dict(payload)
 
 
 class ChaosControl:

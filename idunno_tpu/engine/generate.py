@@ -129,14 +129,15 @@ def generate(model: TransformerLM, params: Any, prompt: jnp.ndarray,
         if temperature > 0.0:
             scaled = logits / temperature
             if top_p < 1.0 or top_k > 0:
-                # top-k then nucleus: mask everything the shared filter
-                # (`ops.sampling.filtered_probs`, also the serving
-                # pool's) zeroes out, applied here as a -inf mask so the
-                # categorical draw below is unchanged
-                from idunno_tpu.ops.sampling import filtered_probs
-                keep = filtered_probs(
+                # top-k then nucleus: mask everything outside the shared
+                # survivor set (`ops.sampling.sample_keep_mask` — the
+                # SAME mask the serving tail builds, so serve-vs-generate
+                # token-exactness is structural) as -inf; the categorical
+                # draw below is unchanged
+                from idunno_tpu.ops.sampling import sample_keep_mask
+                keep = sample_keep_mask(
                     scaled, jnp.full((b,), top_p),
-                    jnp.full((b,), top_k, jnp.int32)) > 0.0
+                    jnp.full((b,), top_k, jnp.int32))
                 scaled = jnp.where(keep, scaled, -jnp.inf)
             rng, sub = jax.random.split(rng)
             nxt = jax.random.categorical(sub, scaled, axis=-1)

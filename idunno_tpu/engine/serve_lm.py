@@ -47,13 +47,14 @@ from idunno_tpu.engine.kv_blocks import concat_kv_prefix
 from idunno_tpu.models.transformer import (TransformerLM, decode_apply,
                                            scan_compatible,
                                            stack_block_params)
-from idunno_tpu.parallel.sharding import tp_collective_bytes
+from idunno_tpu.parallel.sharding import (sampling_collective_bytes,
+                                          tp_collective_bytes)
 from idunno_tpu.ops.paged_attention import (PagedContext,
                                             resolve_paged_kernel)
 from idunno_tpu.ops.quantize import dequantize_tree, quantize_tree
 from idunno_tpu.ops.sampling import (filter_on as _filter_on,
                                      filtered_probs, fused_decode_tail,
-                                     row_sample_logits as _row_sample_logits,
+                                     masked_sample_logits,
                                      safe_log as _safe_log)
 
 # slot default shared with the serving control plane (`serve/control.py`,
@@ -222,7 +223,8 @@ def _make_paged_ctx(pages: dict, tables: jnp.ndarray, lengths: jnp.ndarray,
                     start: int, kernel: str, interpret: bool
                     ) -> PagedContext:
     """PagedContext from a `KVBlockPool.kv_pages()` dict (int8 pools
-    carry scale pages; the resolver already forced kernel='xla' there)."""
+    carry scale pages; BOTH backends dequantize them — the pallas
+    kernel in-VMEM per block tile, the xla fallback after the gather)."""
     return PagedContext(
         pages["cached_k"], pages["cached_v"], tables, lengths,
         k_scale_pages=pages.get("k_scale"),
@@ -300,8 +302,8 @@ def _prefill_chunk(model: TransformerLM, params: Any, cache: Any,
     return cache, logits
 
 
-# _safe_log/_filter_on/_row_sample_logits live in `ops.sampling` (shared
-# with the fused decode tail); imported above under their former names.
+# _safe_log/_filter_on live in `ops.sampling` (shared with the fused
+# decode tail and the spec round); imported above under their former names.
 
 
 def _next_token(logits: jnp.ndarray, temp: jnp.ndarray,
@@ -309,11 +311,14 @@ def _next_token(logits: jnp.ndarray, temp: jnp.ndarray,
                 top_k: jnp.ndarray) -> jnp.ndarray:
     """Greedy (temp == 0) or temperature + top-k/nucleus-sampled next
     token; shared by the prefill pick and the batched decode step
-    (vmapped there, so every array is one row's)."""
+    (vmapped there, so every array is one row's). Samples from the
+    MASKED-SCALED form (`ops.sampling.masked_sample_logits`) — the same
+    construction `generate` and the fused tail use, so the first token
+    of a stream is picked by the identical math as every later one."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temp, 1e-6)
     sampled = jax.random.categorical(
-        key, _row_sample_logits(scaled, top_p, top_k),
+        key, masked_sample_logits(scaled, top_p, top_k),
         axis=-1).astype(jnp.int32)
     return jnp.where(temp > 0.0, sampled, greedy)
 
@@ -551,10 +556,10 @@ class DecodeServer:
         # on real hardware).
         if paged_kernel is not None and not self.kv_block_size:
             raise ValueError("paged_kernel needs kv_block_size > 0")
+        # int8 pools resolve like any other since ISSUE 16 (the pallas
+        # kernel dequantizes block tiles in-VMEM) — no forcing to xla
         self.paged_kernel = (None if paged_kernel is None else
-                             resolve_paged_kernel(
-                                 paged_kernel,
-                                 int8=model.kv_cache_dtype == "int8"))
+                             resolve_paged_kernel(paged_kernel))
         self._paged = paged_kernel is not None
         # chunked prefill: long suffixes apply prefill_chunk tokens at a
         # time, one chunk per step() call, so resident rows keep decoding
@@ -1416,6 +1421,11 @@ class DecodeServer:
             # block over a [slots, 1, dim] activation; 0 when TP is off)
             "n_model": self.n_model,
             "tp_collective_bytes": tp_collective_bytes(
+                self.model, self.slots, self.n_model),
+            # vocab-sharded sampling tail (ISSUE 16): per-row scalar
+            # merge payload instead of an all-gathered [S, vocab]; 0
+            # when TP is off or the vocab degraded to replicated
+            "sampling_collective_bytes": sampling_collective_bytes(
                 self.model, self.slots, self.n_model),
         }
         out = dict(self._stats, live=len(self._live),

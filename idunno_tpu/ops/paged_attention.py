@@ -14,8 +14,14 @@ PAPERS.md). Two interchangeable backends behind the same signature:
   broadcast-scratch trick for m/l and the `_out_struct` vma convention.
   ``interpret=True`` runs the same kernel on CPU for tier-1 tests.
 - ``kernel="xla"``: stock-XLA fallback (gather + masked softmax) —
-  the earn-it-or-swap baseline, also the only int8 path (the kernel
-  handles f32/bf16 pages only; int8 pools dequantize in the fallback).
+  the earn-it-or-swap baseline.
+
+Both backends are int8-native (ISSUE 16): quantized pools hand their
+per-token `k_scale`/`v_scale` leaves (`engine/kv_blocks.py:KV_LEAF_KEYS`,
+``[N, bs, KVH]`` f32) through the same signature, and each backend
+dequantizes its own tiles — the pallas kernel multiplies the scale
+column into the block tile right after the int8→f32 cast, so no
+dequantized copy of the pool ever materializes in HBM.
 
 Both return *normalized* per-(query, kv-head, group) outputs plus the
 log-sum-exp of their softmax, so the caller can merge with the
@@ -50,16 +56,15 @@ AUTO_KERNEL = "xla"
 
 def resolve_paged_kernel(kind: str, *, int8: bool = False) -> str:
     """Earn-it-or-swap selection: "auto" → measured winner ("xla" until
-    the decode sweep says otherwise); int8 pools always take the xla
-    path (the kernel consumes f32/bf16 pages only)."""
+    the decode sweep says otherwise). Since ISSUE 16 the pallas kernel
+    dequantizes int8 pages in-kernel, so ``int8`` no longer forces the
+    xla path or refuses "pallas" — the kwarg stays for callers that
+    still pass it, and "auto" resolves identically either way."""
     if kind not in ("auto", "pallas", "xla"):
         raise ValueError(f"paged_kernel must be auto|pallas|xla, got {kind!r}")
-    if kind == "pallas" and int8:
-        raise ValueError(
-            "paged_kernel='pallas' does not support int8 KV pages; "
-            "use 'auto' or 'xla' on quantized pools")
+    del int8  # both backends are int8-native now
     if kind == "auto":
-        return "xla" if int8 else AUTO_KERNEL
+        return AUTO_KERNEL
     return kind
 
 
@@ -111,17 +116,28 @@ class PagedContext:
 # ---------------------------------------------------------------------------
 
 def _paged_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
-                  o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                  scale: float, block_size: int):
+                  *refs, scale: float, block_size: int,
+                  quantized: bool):
     """Grid (B, KVH, C), C innermost sequential: one program per
     (row, kv-head, chain position). The K/V BlockSpec index_map already
     resolved ``tables[b, j]`` — this body only decides liveness and
     runs one online-softmax step over the block.
 
+    ``quantized=True`` threads two extra per-token scale tiles
+    (``ks_ref``/``vs_ref``, one f32 scale per (token, kv-head)) into
+    ``refs`` right before the outputs; dequant is the elementwise
+    multiply into the int8→f32 cast below — the block never exists
+    dequantized outside VMEM.
+
     No causal/position masking: the paged region wholly precedes the
     queries and ``lengths`` are block-aligned, so a live block is live
     in full. m/l live as (rows, 128) broadcast scratch (min-tile rule,
     same trick as `_flash_kernel`)."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        ks_ref = vs_ref = None
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     b = pl.program_id(0)
     j = pl.program_id(2)
     nc = pl.num_programs(2)
@@ -137,6 +153,9 @@ def _paged_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
         q = q_ref[0, 0].astype(jnp.float32)          # [rows, d]
         k = k_ref[0, :, 0].astype(jnp.float32)       # [bs, d]
         v = v_ref[0, :, 0].astype(jnp.float32)       # [bs, d]
+        if quantized:
+            k = k * ks_ref[0, :, 0].astype(jnp.float32)[:, None]
+            v = v * vs_ref[0, :, 0].astype(jnp.float32)[:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [rows, bs]
@@ -163,12 +182,17 @@ def _paged_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
 
 
 def _paged_pallas(q5, k_pages, v_pages, tables, lengths, *,
+                  k_scale_pages=None, v_scale_pages=None,
                   scale: float, interpret: bool):
     """q5 [B,T,KVH,G,D] against pages [N,bs,KVH,D] via the block table.
 
     Rows = T*G query vectors per (batch, kv-head), padded to a multiple
     of 8 for the f32 min tile. The table is flattened and handed to the
     grid as a scalar-prefetch operand so the K/V index_map can read it.
+    Quantized pools add two ``[N, bs, KVH]`` scale-page operands that
+    ride the SAME index_map as their pages (one (bs, 1) scale column
+    per program, the last-dim-1 block shape the lse out_spec already
+    uses), so the dequant multiply happens in VMEM per block.
     """
     b, t, kvh, g, d = q5.shape
     n, bs, _, _ = k_pages.shape
@@ -178,20 +202,29 @@ def _paged_pallas(q5, k_pages, v_pages, tables, lengths, *,
     qz = jnp.transpose(q5, (0, 2, 1, 3, 4)).reshape(b, kvh, r, d)
     if rp != r:
         qz = jnp.pad(qz, ((0, 0), (0, 0), (0, rp - r), (0, 0)))
+    quantized = k_scale_pages is not None
+
+    page_spec = pl.BlockSpec((1, bs, 1, d),
+                             lambda bi, hi, ji, tbl, lens:
+                             (tbl[bi * c + ji], 0, hi, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, rp, d),
+                     lambda bi, hi, ji, tbl, lens: (bi, hi, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [qz, k_pages, v_pages]
+    if quantized:
+        scale_spec = pl.BlockSpec((1, bs, 1),
+                                  lambda bi, hi, ji, tbl, lens:
+                                  (tbl[bi * c + ji], 0, hi))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale_pages, v_scale_pages]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, kvh, c),
-        in_specs=[
-            pl.BlockSpec((1, 1, rp, d),
-                         lambda bi, hi, ji, tbl, lens: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, bs, 1, d),
-                         lambda bi, hi, ji, tbl, lens:
-                         (tbl[bi * c + ji], 0, hi, 0)),
-            pl.BlockSpec((1, bs, 1, d),
-                         lambda bi, hi, ji, tbl, lens:
-                         (tbl[bi * c + ji], 0, hi, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, rp, d),
                          lambda bi, hi, ji, tbl, lens: (bi, hi, 0, 0)),
@@ -205,14 +238,15 @@ def _paged_pallas(q5, k_pages, v_pages, tables, lengths, *,
         ],
     )
     out, lse = pl.pallas_call(
-        functools.partial(_paged_kernel, scale=scale, block_size=bs),
+        functools.partial(_paged_kernel, scale=scale, block_size=bs,
+                          quantized=quantized),
         grid_spec=grid_spec,
         out_shape=[
             _out_struct((b, kvh, rp, d), jnp.float32, q5),
             _out_struct((b, kvh, rp, 1), jnp.float32, q5),
         ],
         interpret=interpret,
-    )(tables.reshape(-1), lengths, qz, k_pages, v_pages)
+    )(tables.reshape(-1), lengths, *operands)
     out = out[:, :, :r].reshape(b, kvh, t, g, d)
     lse = lse[:, :, :r, 0].reshape(b, kvh, t, g)
     return (jnp.transpose(out, (0, 2, 1, 3, 4)),
@@ -220,7 +254,7 @@ def _paged_pallas(q5, k_pages, v_pages, tables, lengths, *,
 
 
 # ---------------------------------------------------------------------------
-# Stock-XLA fallback (gather + masked softmax; the only int8 path)
+# Stock-XLA fallback (gather + masked softmax)
 # ---------------------------------------------------------------------------
 
 def _paged_xla(q5, k_pages, v_pages, tables, lengths, *,
@@ -279,9 +313,9 @@ def paged_attention_grouped(q5, k_pages, v_pages, tables, lengths, *,
         return (jnp.zeros((b, t, kvh, g, d), jnp.float32),
                 jnp.full((b, t, kvh, g), _NEG_INF, jnp.float32))
     if kernel == "pallas":
-        if k_scale_pages is not None:
-            raise ValueError("pallas paged kernel does not take int8 scales")
         return _paged_pallas(q5, k_pages, v_pages, tables, lengths,
+                             k_scale_pages=k_scale_pages,
+                             v_scale_pages=v_scale_pages,
                              scale=scale, interpret=interpret)
     return _paged_xla(q5, k_pages, v_pages, tables, lengths,
                       k_scale_pages=k_scale_pages,

@@ -4,8 +4,21 @@ One implementation of top-k and nucleus (top-p) filtering serves both
 one-shot `engine.generate` and the continuous-batching pool /
 speculative-sampling path (`engine.serve_lm`) — the pool's
 distribution-exactness contract depends on the two tiers filtering
-identically, so the construction lives here once. Reference has no
-sampling at all (`alexnet_resnet.py` serves argmax classifications only).
+identically, so the construction lives here once. Two forms of it:
+
+- `sample_keep_mask`/`masked_sample_logits`: the TOKEN-exact hot path
+  (generate loop, `fused_decode_tail`, the prefill pick). Thresholds
+  come from exact bit-bisection over f32 patterns, so the whole tail is
+  elementwise ops + per-row reductions — GSPMD partitions it over a
+  vocab-sharded unembed without all-gathering [rows, vocab] logits
+  (ISSUE 16).
+- `filtered_probs`/`nucleus_probs`: the sort-based NORMALIZED
+  distribution, kept for speculative verification (`spec_commit` needs
+  actual probabilities, and the spec contract is distribution-exact,
+  not stream-exact).
+
+Reference has no sampling at all (`alexnet_resnet.py` serves argmax
+classifications only).
 """
 from __future__ import annotations
 
@@ -79,6 +92,91 @@ def filtered_probs(scaled_logits: jnp.ndarray, top_p: jnp.ndarray,
     return filt / filt.sum(axis=-1, keepdims=True)
 
 
+# float32 1.0 bit pattern: the bisection space for values in [0, 1]
+_ONE_BITS = 0x3F800000
+
+
+def _largest_true_bits(pred, rows: tuple) -> jnp.ndarray:
+    """Largest f32 ``t`` in [0, nextafter(1)] with ``pred(t)`` True, per
+    row. Non-negative IEEE floats order like their int32 bit patterns,
+    so an exact binary search over the bit space finds the exact float
+    where a monotone (non-increasing) predicate flips — no sort, no
+    cumsum, only the elementwise compares and small reductions ``pred``
+    itself makes. 31 rounds cover the ~2^30-wide pattern range."""
+    lo = jnp.zeros(rows, jnp.int32)
+    hi = jnp.full(rows, _ONE_BITS + 1, jnp.int32)
+
+    def body(_, lh):
+        lo, hi = lh
+        mid = (lo + hi) >> 1
+        ok = pred(jax.lax.bitcast_convert_type(mid, jnp.float32))
+        return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, 31, body, (lo, hi))
+    return jax.lax.bitcast_convert_type(lo, jnp.float32)
+
+
+def sample_keep_mask(scaled: jnp.ndarray, top_p: jnp.ndarray,
+                     top_k: jnp.ndarray) -> jnp.ndarray:
+    """Top-k + nucleus survivor mask over the LAST axis, in the
+    partition-friendly form the vocab-sharded tail needs (ISSUE 16).
+
+    Selects the same set as ``filtered_probs(scaled, top_p, top_k) > 0``
+    — the k largest tokens (ties AT the k-th value all kept), then the
+    smallest prefix of the renormalized top-k mass reaching ``top_p``
+    (ties at the cutoff kept; an unreachable target degrades to the
+    achievable mass automatically) — but computes its two thresholds by
+    exact bit-bisection (`_largest_true_bits`) on the unnormalized
+    softmax numerator ``e = exp(scaled - max)``:
+
+      k-th value   = largest t with  count(e >= t)          >= k
+      nucleus cut  = largest t with  mass(kept & e >= t)    >= top_p·Z
+
+    Everything is elementwise ops + per-row reductions, so GSPMD
+    partitions it over a sharded vocab axis with one small collective
+    per reduction — no sort, cumsum, or take_along_axis to force an
+    all-gather of the ``[rows, vocab]`` tensor. Working on ``e`` (not
+    the normalized probs) keeps every comparison input elementwise —
+    bitwise identical across mesh shapes; only the mass sums carry
+    reduction-order rounding. Both generation tiers (`engine.generate`
+    and the serving tail) build their masks here, so cross-tier
+    token-exactness is structural."""
+    v = scaled.shape[-1]
+    rows = scaled.shape[:-1]
+    e = jnp.exp((scaled - jnp.max(scaled, axis=-1, keepdims=True))
+                .astype(jnp.float32))
+    k = jnp.clip(top_k, 0, v)
+    k_off = (k <= 0) | (k >= v)
+    kth = _largest_true_bits(
+        lambda t: jnp.sum(e >= t[..., None], axis=-1) >= k, rows)
+    keep_k = (e >= kth[..., None]) | k_off[..., None]
+    masked = jnp.where(keep_k, e, 0.0)
+    z = jnp.sum(masked, axis=-1)
+    # the tiny floor makes top_p→0 keep the argmax tie-set (the mass
+    # predicate must fail above the largest kept value, not everywhere)
+    target = jnp.maximum(top_p * z, jnp.float32(1e-38))
+    cut = _largest_true_bits(
+        lambda t: jnp.sum(jnp.where(masked >= t[..., None], masked, 0.0),
+                          axis=-1) >= target, rows)
+    p_off = top_p >= 1.0
+    return keep_k & ((e >= cut[..., None]) | p_off[..., None])
+
+
+def masked_sample_logits(scaled: jnp.ndarray, top_p: jnp.ndarray,
+                         top_k: jnp.ndarray) -> jnp.ndarray:
+    """Per-row sampling logits in the MASKED-SCALED form: filtered rows
+    keep their scaled logits on the survivor set and -inf elsewhere;
+    filter-off rows pass through untouched. `jax.random.categorical` is
+    shift-invariant per row, so drawing from these equals drawing from
+    ``log(filtered_probs)`` — without normalizing over the (possibly
+    vocab-sharded) axis. The per-ROW select keeps every row's formula a
+    function of its own request alone (journal replays redraw the same
+    stream without former co-residents)."""
+    keep = sample_keep_mask(scaled, top_p, top_k)
+    off = ~filter_on(top_p, top_k)
+    return jnp.where(keep | off[..., None], scaled, -jnp.inf)
+
+
 def safe_log(probs: jnp.ndarray) -> jnp.ndarray:
     """log with EXACT -inf outside the support — a filtered-out token
     must have probability zero, not e^-69 (matches generate's -inf
@@ -90,18 +188,6 @@ def safe_log(probs: jnp.ndarray) -> jnp.ndarray:
 def filter_on(top_p: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
     """Per-row: does this row ask for any sampling filter at all?"""
     return (top_p < 1.0) | (top_k > 0)
-
-
-def row_sample_logits(scaled: jnp.ndarray, top_p: jnp.ndarray,
-                      top_k: jnp.ndarray) -> jnp.ndarray:
-    """Per-row sampling logits: top-k/nucleus-filtered for rows that ask
-    for a filter, plain log-softmax otherwise. The per-ROW select (not a
-    batch-level branch) keeps every row's formula a function of its own
-    request alone, so a journal replay without its former co-residents
-    redraws the SAME stream bit-for-bit."""
-    plain = jax.nn.log_softmax(scaled, axis=-1)
-    filtered = safe_log(filtered_probs(scaled, top_p, top_k))
-    return jnp.where(filter_on(top_p, top_k)[..., None], filtered, plain)
 
 
 def fused_decode_tail(l_raw: jnp.ndarray, tokens: jnp.ndarray,
@@ -127,7 +213,15 @@ def fused_decode_tail(l_raw: jnp.ndarray, tokens: jnp.ndarray,
     ``drawn`` (greedy picks argmax) and frozen keys are harmless (a
     retired sampled row never draws again; admission re-seeds the slot's
     key). ``track``/``pen``/``eos_id`` are compile-time flags — off means
-    zero traced ops for that feature."""
+    zero traced ops for that feature.
+
+    Every op over the vocab axis is partition-friendly (ISSUE 16): the
+    filter mask comes from `sample_keep_mask`, the draw/argmax are
+    reductions GSPMD splits into shard-local stats + one small merge,
+    the logprob pick is a one-hot sum and the count update an elementwise
+    add — nothing sorts, cumsums, gathers, or scatters ``[S, vocab]``,
+    so a vocab-sharded unembed (`parallel.sharding.lm_tp_specs`) flows
+    through without an all-gather of the logits."""
     active = remaining > 0
     l = l_raw
     if pen:   # counts cover this row's GENERATED tokens only
@@ -139,16 +233,19 @@ def fused_decode_tail(l_raw: jnp.ndarray, tokens: jnp.ndarray,
         # independent of co-resident rows and of admissions)
         split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
         scaled = l / jnp.maximum(temps, 1e-6)[:, None]
-        # the full-vocab sort+cumsum only runs when some live row
-        # actually asked for a filter; inside that branch the PER-ROW
-        # select gives unfiltered rows the identical plain log-softmax
-        # the other branch computes, so no row's stream ever depends on
-        # its co-residents (token-exact journal replay)
+        # the threshold bisections only run when some live row actually
+        # asked for a filter; inside that branch the PER-ROW select in
+        # `masked_sample_logits` passes unfiltered rows their untouched
+        # scaled logits — identical to the other branch — so no row's
+        # stream ever depends on its co-residents (token-exact journal
+        # replay). categorical's shift-invariance makes the masked-scaled
+        # form draw the same tokens `generate` draws from its own
+        # identically-built mask.
         sample_logits = jax.lax.cond(
             jnp.any((remaining > 0) & (temps > 0.0)
                     & filter_on(top_ps, top_ks)),
-            lambda: row_sample_logits(scaled, top_ps, top_ks),
-            lambda: jax.nn.log_softmax(scaled, axis=-1))
+            lambda: masked_sample_logits(scaled, top_ps, top_ks),
+            lambda: scaled)
         d = jax.vmap(jax.random.categorical)(
             split[:, 0], sample_logits).astype(jnp.int32)
         return d, split[:, 1]
@@ -165,9 +262,16 @@ def fused_decode_tail(l_raw: jnp.ndarray, tokens: jnp.ndarray,
     tokens = tokens.at[rows, wpos].set(jnp.where(active, nxt, old))
     if track:
         # logprobs report the RAW model distribution even on penalized
-        # rows (sampler-independent semantics)
-        lp_all = jax.nn.log_softmax(l_raw.astype(jnp.float32), axis=-1)
-        lp = jnp.take_along_axis(lp_all, nxt[:, None], axis=1)[:, 0]
+        # rows (sampler-independent semantics). Same float composition
+        # as log_softmax + take_along_axis, but the pick is a one-hot
+        # sum — summing one value against zeros is exact — so nothing
+        # gathers over the vocab axis
+        l32 = l_raw.astype(jnp.float32)
+        shifted = l32 - jnp.max(l32, axis=-1, keepdims=True)
+        lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
+        iota = jnp.arange(l32.shape[-1])
+        lp = jnp.sum(jnp.where(iota[None, :] == nxt[:, None],
+                               shifted, 0.0), axis=-1) - lse
         lp_old = jnp.take_along_axis(logprobs, wpos[:, None], axis=1)[:, 0]
         logprobs = logprobs.at[rows, wpos].set(
             jnp.where(active, lp, lp_old))
@@ -177,5 +281,7 @@ def fused_decode_tail(l_raw: jnp.ndarray, tokens: jnp.ndarray,
         new_remaining = jnp.where(nxt == eos_id, 0, new_remaining)
     remaining = jnp.where(active, new_remaining, remaining)
     if pen:
-        counts = counts.at[rows, nxt].add(jnp.where(active, 1, 0))
+        iota_v = jnp.arange(counts.shape[-1])
+        hit = (iota_v[None, :] == nxt[:, None]) & active[:, None]
+        counts = counts + hit.astype(counts.dtype)
     return tokens, cursors, remaining, keys, logprobs, counts

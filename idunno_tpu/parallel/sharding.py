@@ -54,10 +54,16 @@ def tp_param_spec(path: tuple, leaf: Any) -> P:
 # mlp_down are ROW-parallel (contraction dim sharded → one psum each), so
 # GSPMD inserts exactly TWO collectives per block — and because the specs
 # ride the *stacked* `[depth, ...]` leaves, those collectives live inside
-# the scan body of the ONE `lax.scan`, not per unrolled layer. Embedding
-# and unembed stay replicated: sharding them saves little at serving sizes
-# and replicating keeps the logits bit-identical across n_model (the
-# token-exactness tests compare streams across mesh shapes).
+# the scan body of the ONE `lax.scan`, not per unrolled layer. The UNEMBED
+# (head) is COLUMN-sharded over the vocab axis (ISSUE 16): the fused
+# sampling tail resolves greedy/sampled/filtered picks from per-shard
+# partial stats (`ops/sampling.py:sample_keep_mask`), so the [S, vocab]
+# logits never all-gather — when the vocab doesn't divide n_model,
+# `_sanitize` degrades the head to replicated and everything still
+# serves. The EMBEDDING stays replicated (a [S, 1] token lookup saves
+# nothing sharded, and the logits stay bit-identical across n_model
+# everywhere the math is elementwise — the token-exactness tests compare
+# streams across mesh shapes).
 #
 # GQA rule: Q heads MUST divide n_model (`mesh.check_head_divisibility`);
 # KV heads divide-or-replicate — when `num_kv_heads % n_model != 0` the
@@ -119,8 +125,17 @@ def lm_tp_specs(params: Any, *, n_model: int,
         if n_model <= 1 or not hasattr(leaf, "ndim"):
             return P()
         names = _path_names(path)
+        if "head" in names:
+            # unembed column-shards over the vocab (ISSUE 16): kernel
+            # [dim, vocab] / bias [vocab]; non-dividing vocab degrades
+            # to replicated via _sanitize
+            if "kernel" in names:
+                return _sanitize(P(None, M), leaf, n_model)
+            if "bias" in names:
+                return _sanitize(P(M), leaf, n_model)
+            return P()
         if "blocks" not in names:
-            return P()                          # embed/head/ln_f replicated
+            return P()                          # embed/ln_f replicated
         # module name is the segment just before kernel/bias; QTensor
         # fields ("q"/"scale") come AFTER, so cut the path there first
         for kind, rules in (("kernel", kernel_rules), ("bias", bias_rules)):
@@ -186,6 +201,19 @@ def tp_collective_bytes(model: Any, slots: int, n_model: int) -> int:
         return 0
     itemsize = jnp.zeros((), model.dtype).dtype.itemsize
     return 2 * model.depth * slots * model.dim * itemsize
+
+
+def sampling_collective_bytes(model: Any, slots: int, n_model: int) -> int:
+    """Estimated merge payload of the vocab-sharded sampling tail per
+    decode step (ISSUE 16): with the unembed column-sharded, each pick
+    merges per-row SCALAR shard stats (running max, mass sum, argmax
+    value+index — 4 f32-sized words per row) instead of all-gathering
+    the [slots, vocab] logits. 0 when TP is off or the vocab doesn't
+    divide the model axis (the head degrades to replicated and the tail
+    runs shard-local)."""
+    if n_model <= 1 or model.vocab % n_model:
+        return 0
+    return 4 * slots * 4
 
 
 # -- CNN tensor parallelism (pod-slice serving) -----------------------------

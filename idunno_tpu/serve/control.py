@@ -52,6 +52,18 @@ if TYPE_CHECKING:                                    # pragma: no cover
 SERVICE = "control"
 
 
+class RelayedError(Exception):
+    """An ERROR reply from a forwarded owner hop, relayed VERBATIM (ISSUE
+    16): the payload keeps its typed markers (``stale_epoch``, ``scope``,
+    ``scope_owner``, ``scope_epoch``) so a client behind the proxy hop
+    still sees the typed error — its retry/re-route logic must not be
+    blinded by a flattened string."""
+
+    def __init__(self, payload: dict) -> None:
+        super().__init__(payload.get("error", "relayed error"))
+        self.payload = dict(payload)
+
+
 class _Starting:
     """Registry placeholder while an `lm_serve` builds its pool outside the
     lock — reserves the name without blocking other verbs."""
@@ -122,6 +134,10 @@ class ControlService:
             return Message(MessageType.ERROR, self.node.host,
                            {"error": str(e), "scope": e.scope,
                             "scope_owner": e.owner})
+        except RelayedError as e:
+            # forwarded owner answered with a typed error: pass the
+            # payload through untouched so markers survive the hop
+            return Message(MessageType.ERROR, self.node.host, e.payload)
         except Exception as e:  # noqa: BLE001 - RPC boundary: report, don't die
             return Message(MessageType.ERROR, self.node.host,
                            {"error": f"{type(e).__name__}: {e}"})
@@ -499,7 +515,9 @@ class ControlService:
             cfg = stats.get("config", {})
             gauges = {"n_model": cfg.get("n_model", 1),
                       "tp_collective_bytes": cfg.get(
-                          "tp_collective_bytes", 0)}
+                          "tp_collective_bytes", 0),
+                      "sampling_collective_bytes": cfg.get(
+                          "sampling_collective_bytes", 0)}
             pc = stats.get("prefix_cache")
             if pc is not None:
                 gauges.update(
@@ -732,8 +750,10 @@ class ControlService:
                 f"scope owner {owner} for {name!r} gave no reply")
         observe_payload(node.membership.epoch, out.payload)
         if out.type is MessageType.ERROR:
-            err = (out.payload or {}).get("error")
-            raise ValueError(f"{owner}: {err}")
+            # relay the owner's typed error verbatim — flattening it to a
+            # string here would strip the stale_epoch/scope/scope_owner
+            # markers a chained redirect needs (ISSUE 16 satellite)
+            raise RelayedError(dict(out.payload or {}))
         return dict(out.payload or {})
 
     def _route_cluster(self, verb: str, p: dict) -> dict | None:

@@ -874,8 +874,10 @@ def run_lm_paged_bench(platform: str, device_kind: str, n_devices: int,
     cache exists for), so admission is a full-depth hit and the timed
     dispatches are pure decode. Per grid point: ``paged`` (auto kernel =
     the shipped default) first — a deadline hit must cost the baseline —
-    then ``gathered``, then ``paged_pallas`` (the AUTO_KERNEL flip
-    candidate; kernel-level grid lives in tools/flash_sweep.py)."""
+    then ``gathered``, then ``paged_int8`` (the int8-native pool, ISSUE
+    16: half the block-pool HBM traffic, scales dequantized in-path),
+    then ``paged_pallas``/``paged_int8_pallas`` (the AUTO_KERNEL flip
+    candidates; kernel-level grid lives in tools/flash_sweep.py)."""
     from idunno_tpu.engine.serve_lm import DecodeServer
     from idunno_tpu.models.transformer import TransformerLM
 
@@ -894,10 +896,16 @@ def run_lm_paged_bench(platform: str, device_kind: str, n_devices: int,
     n_params, _ = _count_params(params)
     out["n_params"] = n_params
     max_new = cfg["decode_steps"] * 3 + 1
+    # int8 twin: same params, quantized KV block pool (ISSUE 16 — both
+    # paged backends dequantize the per-token scales in-path)
+    model_i8 = TransformerLM(vocab=cfg["vocab"], dim=cfg["dim"],
+                             depth=cfg["depth"], num_heads=cfg["heads"],
+                             causal=True, dtype=dt, param_dtype=dt,
+                             kv_cache_dtype="int8")
 
-    def run_point(slots: int, ctx: int, paged_kernel) -> dict:
+    def run_point(slots: int, ctx: int, paged_kernel, lm=model) -> dict:
         per_chain = -(-ctx // block)
-        srv = DecodeServer(model, params, slots=slots, prompt_len=ctx,
+        srv = DecodeServer(lm, params, slots=slots, prompt_len=ctx,
                            max_len=ctx + max_new + 1,
                            decode_steps=cfg["decode_steps"],
                            kv_block_size=block,
@@ -933,18 +941,20 @@ def run_lm_paged_bench(platform: str, device_kind: str, n_devices: int,
 
     points: list[dict] = []
     out["points"] = points
-    modes = [("paged", "auto"), ("gathered", None)]
+    modes = [("paged", "auto", model), ("gathered", None, model),
+             ("paged_int8", "auto", model_i8)]
     if tpu or os.environ.get("BENCH_LM_PAGED_PALLAS") == "1":
-        modes.append(("paged_pallas", "pallas"))
+        modes.append(("paged_pallas", "pallas", model))
+        modes.append(("paged_int8_pallas", "pallas", model_i8))
     for slots, ctx in lm_paged_grid(platform):
         point: dict = {"slots": slots, "context": ctx}
         points.append(point)
-        for name, kern in modes:
+        for name, kern, lm in modes:
             if points[:-1] and time.perf_counter() > deadline:
                 point[name] = {"skipped": "time budget"}
                 continue
             try:
-                point[name] = run_point(slots, ctx, kern)
+                point[name] = run_point(slots, ctx, kern, lm)
             except Exception as e:  # noqa: BLE001 - record, never hide
                 point[name] = {"error": f"{type(e).__name__}: {e}"}
         if "tokens_per_s" in point.get("paged", {}) and \
@@ -952,6 +962,11 @@ def run_lm_paged_bench(platform: str, device_kind: str, n_devices: int,
             point["paged_vs_gathered"] = round(
                 point["paged"]["tokens_per_s"]
                 / point["gathered"]["tokens_per_s"], 3)
+        if "tokens_per_s" in point.get("paged_int8", {}) and \
+                "tokens_per_s" in point.get("paged", {}):
+            point["int8_vs_native"] = round(
+                point["paged_int8"]["tokens_per_s"]
+                / point["paged"]["tokens_per_s"], 3)
     ok = [p for p in points if "tokens_per_s" in p.get("paged", {})]
     if ok:
         best = max(ok, key=lambda p: p["paged"]["tokens_per_s"])

@@ -361,6 +361,62 @@ def test_scope_owner_death_blast_radius(tmp_path):
     assert f"pool:{c.LM_GROUP}" not in summary["pool_epochs"]
 
 
+def test_forwarded_owner_hop_relays_typed_errors(tmp_path):
+    """ISSUE 16 satellite: when a forwarded pool verb's owner answers
+    with a TYPED error, the forwarding node must relay the payload
+    VERBATIM — `scope`/`scope_owner` (deposed holder) and `stale_epoch`
+    markers survive the proxy hop instead of flattening to a ValueError
+    string the client can't route on. Exercises the relay class now
+    SHARED with serve/control.py (`RelayedError`)."""
+    from idunno_tpu.comm.message import Message
+    from idunno_tpu.membership.epoch import pool_scope
+    from idunno_tpu.utils.types import MessageType
+
+    c = ChaosCluster(717, str(tmp_path), multi_pool=True)
+    c.pump_work()
+    for _ in range(4):            # claims need ~3 gossip waves
+        c.pump_membership(waves=1)
+
+    def ask(forwarder: str, name: str) -> Message:
+        # raw client send (no redirect-following helper): the reply we
+        # inspect is exactly what the FORWARDER relayed
+        return c.net._nodes["n2"].call(
+            forwarder, "control",
+            Message(MessageType.INFERENCE, "n2",
+                    {"verb": "lm_stats", "name": name}))
+
+    # -- deposed holder: scope/scope_owner markers through the hop -------
+    scope = pool_scope(c.LM_POOL)
+    owner = c.members["n1"].owners.owner(scope)
+    assert owner is not None and c.managers[owner].has_pool(c.LM_POOL)
+    forwarder = next(h for h in c.cfg.hosts
+                     if h != owner and not c.managers[h].has_pool(c.LM_POOL))
+    # out-claim the scope in the HOLDER's own view: it steps down and
+    # answers the typed redirect — which must reach the client intact
+    usurper = next(h for h in c.cfg.hosts if h != owner and h != forwarder)
+    c.members[owner].owners.claim(scope, usurper)
+    out = ask(forwarder, c.LM_POOL)
+    assert out.type is MessageType.ERROR
+    assert out.payload.get("scope") == scope, out.payload
+    assert out.payload.get("scope_owner") == usurper, out.payload
+    assert "ValueError" not in out.payload.get("error", "")
+
+    # -- stale cluster epoch: stale_epoch marker through the hop ---------
+    scope_b = pool_scope(c.LM_POOL_B)
+    owner_b = c.members["n1"].owners.owner(scope_b)
+    assert owner_b is not None and c.managers[owner_b].has_pool(c.LM_POOL_B)
+    fwd_b = next(h for h in c.cfg.hosts
+                 if h != owner_b and not c.managers[h].has_pool(c.LM_POOL_B))
+    # the owner's fence runs ahead of the forwarder's view, so the
+    # forwarder's stamped hop is rejected stale — typed, and relayed
+    cur, _ = c.members[owner_b].epoch.view()
+    c.members[owner_b].epoch.observe(cur + 3, "n1")
+    out = ask(fwd_b, c.LM_POOL_B)
+    assert out.type is MessageType.ERROR
+    assert out.payload.get("stale_epoch") is True, out.payload
+    assert "ValueError" not in out.payload.get("error", "")
+
+
 def test_invariant_trip_snapshots_span_dump(tmp_path):
     """Chaos-causal dumps: when any invariant trips, `check_invariants`
     snapshots every host's span window BEFORE re-raising, so the failing

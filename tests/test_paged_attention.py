@@ -89,7 +89,8 @@ def test_flat_wrapper_gqa_shapes(t):
                         jnp.asarray(tables), jnp.asarray(lengths))
 
 
-def test_int8_scales_dequantize_on_xla_path():
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_int8_scales_dequantize_on_both_paths(kernel):
     q5, kp, vp, tables, lengths = make_case(seed=5)
     scl = 0.25
     kq = (kp / scl).astype(np.float32)     # pretend-quantized pages
@@ -100,16 +101,59 @@ def test_int8_scales_dequantize_on_xla_path():
         jnp.asarray(q5), jnp.asarray(kq), jnp.asarray(vq),
         jnp.asarray(tables), jnp.asarray(lengths),
         k_scale_pages=jnp.asarray(ks), v_scale_pages=jnp.asarray(ks),
-        kernel="xla")
+        kernel=kernel, interpret=True)
     live = lengths > 0
     np.testing.assert_allclose(np.asarray(got_o)[live], want_o[live],
                                rtol=2e-5, atol=2e-5)
-    with pytest.raises(ValueError, match="int8"):
-        paged_attention_grouped(
+
+
+def make_int8_case(bs, kvh, g, seed=0, n_blocks=12, c=3, b=3, t=2, d=16):
+    """Genuinely-quantized pages: per-(token, kv-head) absmax scales,
+    int8 values, plus the dequantized f32 twin the oracle attends over."""
+    rng = np.random.default_rng(seed)
+    q5 = rng.standard_normal((b, t, kvh, g, d)).astype(np.float32)
+    kf = rng.standard_normal((n_blocks, bs, kvh, d)).astype(np.float32)
+    vf = rng.standard_normal((n_blocks, bs, kvh, d)).astype(np.float32)
+    ks = (np.abs(kf).max(-1) / 127.0).astype(np.float32)
+    vs = (np.abs(vf).max(-1) / 127.0).astype(np.float32)
+    kq = np.clip(np.round(kf / ks[..., None]), -127, 127).astype(np.int8)
+    vq = np.clip(np.round(vf / vs[..., None]), -127, 127).astype(np.int8)
+    kd = kq.astype(np.float32) * ks[..., None]   # what attention sees
+    vd = vq.astype(np.float32) * vs[..., None]
+    tables = rng.permutation(n_blocks)[: b * c].reshape(b, c).astype(np.int32)
+    lengths = np.array([c * bs, bs, 0], np.int32)
+    return q5, kq, vq, ks, vs, kd, vd, tables, lengths
+
+
+@pytest.mark.parametrize("bs", [2, 4, 8])
+@pytest.mark.parametrize("kvh,g", [(1, 4), (2, 2), (4, 1)])
+def test_int8_pallas_matches_xla_every_blocksize_gqa(bs, kvh, g):
+    """ISSUE 16 acceptance: the in-kernel dequant matches the XLA
+    fallback on the numpy oracle at every block size × GQA layout —
+    both backends attend over the identical dequantized values, so
+    they agree with the oracle AND (tightly) with each other."""
+    q5, kq, vq, ks, vs, kd, vd, tables, lengths = make_int8_case(
+        bs, kvh, g, seed=7 + bs)
+    want_o, want_lse = ref_paged(q5, kd, vd, tables, lengths)
+    got = {}
+    for kernel in ("xla", "pallas"):
+        got[kernel] = paged_attention_grouped(
             jnp.asarray(q5), jnp.asarray(kq), jnp.asarray(vq),
             jnp.asarray(tables), jnp.asarray(lengths),
-            k_scale_pages=jnp.asarray(ks), v_scale_pages=jnp.asarray(ks),
-            kernel="pallas", interpret=True)
+            k_scale_pages=jnp.asarray(ks), v_scale_pages=jnp.asarray(vs),
+            kernel=kernel, interpret=True)
+        live = lengths > 0
+        o, lse = got[kernel]
+        np.testing.assert_allclose(np.asarray(o)[live], want_o[live],
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(lse)[live], want_lse[live],
+                                   rtol=2e-5, atol=2e-5)
+        # dead rows keep the (zeros, -inf-ish) merge contract on int8 too
+        np.testing.assert_array_equal(np.asarray(o)[~live], 0.0)
+        assert (np.asarray(lse)[~live] <= -1e30).all()
+    np.testing.assert_allclose(np.asarray(got["pallas"][0]),
+                               np.asarray(got["xla"][0]),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_merge_attention_exact_vs_union_softmax():
@@ -142,13 +186,15 @@ def test_resolve_kernel_earn_it_or_swap():
     assert AUTO_KERNEL == "xla", \
         "flip AUTO_KERNEL only after paged_suite blesses pallas on-chip"
     assert resolve_paged_kernel("auto") == AUTO_KERNEL
-    assert resolve_paged_kernel("auto", int8=True) == "xla"
+    # int8 no longer forces or forbids anything (ISSUE 16): the pallas
+    # kernel dequantizes in-kernel, so "auto" resolves identically and
+    # an explicit "pallas" is honored on quantized pools
+    assert resolve_paged_kernel("auto", int8=True) == AUTO_KERNEL
     assert resolve_paged_kernel("pallas") == "pallas"
+    assert resolve_paged_kernel("pallas", int8=True) == "pallas"
     assert resolve_paged_kernel("xla", int8=True) == "xla"
     with pytest.raises(ValueError, match="auto\\|pallas\\|xla"):
         resolve_paged_kernel("fast")
-    with pytest.raises(ValueError, match="int8"):
-        resolve_paged_kernel("pallas", int8=True)
 
 
 # -- structural: no contiguous gather on the pallas path --------------------
@@ -220,6 +266,34 @@ def test_serving_paged_path_never_calls_pool_gather(monkeypatch):
     assert srv.prefix_cache_stats()["hits"] == 1
     assert len(done[rid].tokens) == len(prompt) + 4
     assert srv.stats()["kv_gather_bytes_saved"] > 0
+
+
+def test_int8_pool_serves_on_pallas_kernel():
+    """End-to-end (ISSUE 16): an int8 pool with paged_kernel='pallas'
+    consumes radix hits through the in-kernel dequant path and streams
+    the same tokens as its xla twin — no resolver refusal, no silent
+    fallback (the config reports the kernel actually asked for)."""
+    from idunno_tpu.engine.serve_lm import DecodeServer
+    from idunno_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab=61, dim=32, depth=2, num_heads=4,
+                          num_kv_heads=2, kv_cache_dtype="int8")
+    params = model.init(jax.random.PRNGKey(4),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    streams = {}
+    for kernel in ("xla", "pallas"):
+        srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                           kv_block_size=2, kv_cache_blocks=16,
+                           paged_kernel=kernel)
+        assert srv.paged_kernel == kernel
+        srv.submit(prompt, max_new=4)
+        srv.run_until_drained()
+        rid = srv.submit(prompt, max_new=4)    # radix hit → paged attend
+        done = {c.id: c for c in srv.run_until_drained()}
+        assert srv.prefix_cache_stats()["hits"] == 1
+        streams[kernel] = done[rid].tokens
+    assert streams["pallas"] == streams["xla"]
 
 
 def test_paged_context_is_pytree():

@@ -171,3 +171,81 @@ def test_tp_step_still_one_scan_and_collectives_depth_invariant():
     assert counts[2] > 0, "TP step must contain model-axis reductions"
     assert counts[2] == counts[4], \
         f"collective count grew with depth: {counts}"
+
+
+def test_tp_sharded_tail_one_scan_no_sort_depth_invariant():
+    """ISSUE 16: the decode step PLUS the fused sampling tail, with the
+    unembed column-sharded (vocab 64 divides the 2-wide model axis).
+    Still ONE `lax.scan`; the whole traced program carries ZERO
+    sort/cumsum primitives (the tail's filters resolve via bit-bisected
+    threshold reductions, not a vocab sort); and the compiled all-reduce
+    count stays depth-invariant — the picks merge per-shard scalar
+    stats, never the [S, vocab] logits."""
+    from jax.sharding import NamedSharding
+    from idunno_tpu.ops.sampling import fused_decode_tail
+    from idunno_tpu.parallel.mesh import make_mesh
+    from idunno_tpu.parallel.sharding import lm_cache_specs, shard_lm_params
+
+    mesh = make_mesh(1, 2, devices=jax.devices()[:2])
+    S, max_len, vocab = 2, 16, 64
+    ar_counts = {}
+    for depth in (2, 4):
+        model = TransformerLM(vocab=vocab, dim=32, depth=depth,
+                              num_heads=4)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        dec_s = dataclasses.replace(decode_model(model, max_len),
+                                    scan_layers=True)
+        sp = shard_lm_params(mesh, dec_s, params)
+        cache = init_cache(dec_s, S, max_len)
+        cache = jax.tree.map(
+            lambda l, s: jax.device_put(l, NamedSharding(mesh, s)),
+            cache, lm_cache_specs(cache, n_model=2))
+
+        def step(p, c, tokens, cursors, remaining, keys, logprobs, cnts):
+            # mirrors engine/serve_lm._build_decode's body: model step,
+            # then the one fused tail with every feature flag ON
+            tok = jnp.take_along_axis(tokens, cursors[:, None], axis=1)
+            logits, c = decode_apply(dec_s, p, c, tok)
+            out = fused_decode_tail(
+                logits[:, 0], tokens, cursors, remaining,
+                jnp.full((S,), 0.9, jnp.float32),
+                jnp.full((S,), 0.8, jnp.float32),
+                jnp.full((S,), 5, jnp.int32),
+                keys, logprobs,
+                jnp.full((S,), 0.5, jnp.float32),
+                jnp.full((S,), 0.25, jnp.float32), cnts,
+                max_len=max_len, eos_id=None, track=True, pen=True)
+            return out, c
+
+        args = (sp, cache,
+                jnp.zeros((S, max_len), jnp.int32),
+                jnp.full((S,), 3, jnp.int32),       # cursors
+                jnp.full((S,), 5, jnp.int32),       # remaining
+                jnp.zeros((S, 2), jnp.uint32),      # raw rng keys
+                jnp.zeros((S, max_len), jnp.float32),
+                jnp.zeros((S, vocab), jnp.int32))
+        jx = jax.make_jaxpr(step)(*args)
+        prims = [e.primitive.name for e in jx.jaxpr.eqns]
+        assert prims.count("scan") == 1, depth
+        # recursive primitive walk: the sampled branch lives inside a
+        # lax.cond, so a vocab sort there would not show in the
+        # top-level eqn list
+        names, stack = set(), [jx.jaxpr]
+        while stack:
+            j = stack.pop()
+            for e in j.eqns:
+                names.add(e.primitive.name)
+                for v in e.params.values():
+                    for x in (v if isinstance(v, (list, tuple)) else [v]):
+                        if getattr(x, "jaxpr", None) is not None:
+                            stack.append(x.jaxpr)
+        for banned in ("sort", "cumsum", "cummax", "top_k",
+                       "approx_top_k"):
+            assert banned not in names, \
+                f"{banned} primitive in the fused-tail step at depth {depth}"
+        compiled = jax.jit(step).lower(*args).compile().as_text()
+        ar_counts[depth] = compiled.count("all-reduce")
+    assert ar_counts[2] > 0, "TP step must contain model-axis reductions"
+    assert ar_counts[2] == ar_counts[4], \
+        f"collective count grew with depth: {ar_counts}"

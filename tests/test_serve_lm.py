@@ -1441,6 +1441,63 @@ def test_tp_decode_2d_mesh_with_gqa(lm, eight_devices):
                                         len(c.tokens) - len(p)), kvh
 
 
+@pytest.fixture(scope="module")
+def lm64():
+    """Vocab 64 DIVIDES n_model 2 and 4, so the unembed genuinely
+    column-shards (the module-level VOCAB=61 degrades to replicated)."""
+    model = TransformerLM(vocab=64, dim=32, depth=2, num_heads=4)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+@pytest.mark.parametrize("n_model", [2, 4])
+def test_tp_sharded_tail_five_modes_token_exact(lm64, eight_devices,
+                                                n_model):
+    """ISSUE 16: with the unembed column-sharded, the fused tail resolves
+    every pick from per-shard partial stats (`ops/sampling.py:
+    sample_keep_mask` bit-bisection — no [S, vocab] all-gather, no sort).
+    Five serving modes must stay token-exact vs the replicated n_model=1
+    pool, and the deterministic rows vs the `generate` oracle: greedy,
+    seeded-sampled, filtered (top_k+top_p), penalized, and per-token-
+    logprob rows."""
+    model, params = lm64
+
+    def serve(nm):
+        srv = DecodeServer(model, params, slots=3, prompt_len=8,
+                           max_len=32, n_model=nm,
+                           penalties=True, track_logprobs=True)
+        rows = {
+            "greedy": srv.submit([5, 11, 17], max_new=8),
+            "sampled": srv.submit([4, 17, 2], max_new=8,
+                                  temperature=0.8, seed=21),
+            "filtered": srv.submit([9, 1], max_new=8, temperature=0.9,
+                                   top_k=7, top_p=0.85, seed=5),
+            "penalized": srv.submit([3, 7, 31, 8], max_new=8,
+                                    presence_penalty=0.6,
+                                    frequency_penalty=0.4),
+            "logprobs": srv.submit([2, 40, 13], max_new=6),
+        }
+        done = {c.id: c for c in srv.run_until_drained()}
+        return {k: done[rid] for k, rid in rows.items()}
+
+    got, ref = serve(n_model), serve(1)
+    for mode in got:
+        assert got[mode].tokens == ref[mode].tokens, \
+            f"{mode} row diverged at n_model={n_model}"
+    # deterministic rows also match the standalone generate oracle
+    assert got["greedy"].tokens == expected(model, params, [5, 11, 17], 8)
+    pen = generate(model, params, jnp.asarray([[3, 7, 31, 8]], jnp.int32),
+                   prompt_len=4, max_new=8,
+                   presence_penalty=0.6, frequency_penalty=0.4)
+    assert got["penalized"].tokens == [int(t) for t in np.asarray(pen[0])]
+    # logprobs ride the sharded tail's one-hot pick — same values as the
+    # replicated pool within float reduction-order noise
+    for mode in got:
+        np.testing.assert_allclose(got[mode].logprobs, ref[mode].logprobs,
+                                   atol=1e-5, err_msg=mode)
+
+
 def test_tp_rejects_bad_shapes(lm, eight_devices):
     """n_model must divide Q heads (typed MeshShapeError), conflict with
     an explicit mesh raises, and the unscanned layout refuses TP."""

@@ -480,6 +480,9 @@ def test_two_node_cluster_collects_lm_trace(tmp_path):
         assert 'node="n0"' in text and "span_buffer_depth" in text
         assert 'name="n_model"' in text
         assert 'name="tp_collective_bytes"' in text
+        # ISSUE 16: the vocab-sharded sampling tail's merge-payload gauge
+        # rides beside it (0 for an n_model=1 pool, but always named)
+        assert 'name="sampling_collective_bytes"' in text
         # PR-5 durability-gap counter joins the scrape (ISSUE 14): acked
         # work whose write-ahead was skipped because the standby was down
         assert 'idunno_gauge{node="n0",name="wal_skips"}' in text

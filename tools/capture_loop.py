@@ -202,12 +202,16 @@ STEPS = [
 FORCE_RECAPTURE = {"lm_suite", "lm_suite_refresh", "lm_slots",
                    "prefix_suite", "spec_trace", "two_model_fairshare",
                    # flash_sweep: the committed artifact predates the
-                   # 256x512/512x1024/512x256 neighbors + 4x4096 long-seq
-                   # AND (ISSUE 7) the decode-shaped paged_decode section
+                   # 256x512/512x1024/512x256 neighbors + 4x4096 long-seq,
+                   # (ISSUE 7) the decode-shaped paged_decode section AND
+                   # (ISSUE 16) the paged_int8 section
                    "flash_sweep",
-                   # paged_suite: new this round — never touched the chip
+                   # paged_suite: never captured, and (ISSUE 16) the suite
+                   # gained its paged_int8/int8_vs_native arms
                    "paged_suite",
-                   # tp_suite: new this round (ISSUE 9) — never captured
+                   # tp_suite: never captured, and (ISSUE 16) the sharded
+                   # step changed — the unembed now column-shards with the
+                   # fused tail resolving picks from per-shard stats
                    "tp_suite",
                    # train_suite: BENCH_LAST_GOOD_train.json provenance is
                    # two rounds stale (round-5 VERDICT) — the committed

@@ -14,7 +14,9 @@ kernel (`ops/paged_attention.py`) vs its XLA gather fallback at serving
 shapes — q_len 1 and 8 (plain decode / fused spec verify) x KV 512 and
 4096 x block sizes 16/32/64 — the evidence `AUTO_KERNEL` needs before it
 may flip to "pallas" (earn-it-or-swap, same discipline as the prefill
-default above).
+default above), and a `paged_int8` section (ISSUE 16): the same two
+kernels over int8 pages with per-token scale columns dequantized
+in-path, at the quantized pool's decode shapes.
 
 Writes FLASH_SWEEP.json incrementally after EVERY variant (a window
 that closes mid-sweep still leaves the variants it measured). Each
@@ -221,17 +223,20 @@ def main() -> int:
                            "variants": pv}
     prng = np.random.default_rng(2)
 
-    def time_paged(kernel, q, kp, vp, tables, lengths):
-        f = jax.jit(lambda *a: paged_attention_grouped(
-            *a, kernel=kernel, interpret=args.cpu))
+    def time_paged(kernel, q, kp, vp, tables, lengths, scales=()):
+        f = jax.jit(lambda q, kp, vp, tb, ln, *sc: paged_attention_grouped(
+            q, kp, vp, tb, ln,
+            **dict(zip(("k_scale_pages", "v_scale_pages"), sc)),
+            kernel=kernel, interpret=args.cpu))
+        operands = (q, kp, vp, tables, lengths, *scales)
         t0 = time.perf_counter()
-        f(q, kp, vp, tables, lengths)[0].block_until_ready()
+        f(*operands)[0].block_until_ready()
         c_s = time.perf_counter() - t0
         reps = []
         for _ in range(3):
             t0 = time.perf_counter()
             for _ in range(10):
-                o, _ = f(q, kp, vp, tables, lengths)
+                o, _ = f(*operands)
             o.block_until_ready()
             reps.append((time.perf_counter() - t0) / 10)
         return float(np.median(reps)), c_s
@@ -273,27 +278,81 @@ def main() -> int:
                     flush()
                     print(json.dumps(row), flush=True)
 
+    # -- int8-native paged decode (ISSUE 16): the quantized pool's block
+    # tiles ride the SAME kernels with per-token scale pages dequantized
+    # in-path (pallas: in-VMEM right after the int8->f32 cast; xla:
+    # after the gather). Decode shape only (q_len 1) — the int8 pool's
+    # serving regime; the native grid above already maps the q_len axis.
+    pi: list = []
+    out["paged_int8"] = {"slots": slots, "kv_heads": kvh, "head_dim": hd,
+                         "variants": pi}
+    for pbs in (32, 16):
+        for kv_len in (512, 4096):
+            nb_row = kv_len // pbs
+            kq = jnp.asarray(prng.integers(
+                -127, 128, size=(slots * nb_row, pbs, kvh, hd)), jnp.int8)
+            vq = jnp.asarray(prng.integers(
+                -127, 128, size=(slots * nb_row, pbs, kvh, hd)), jnp.int8)
+            ks = jnp.asarray(prng.uniform(
+                0.5, 1.5, size=(slots * nb_row, pbs, kvh)), jnp.float32)
+            vs = jnp.asarray(prng.uniform(
+                0.5, 1.5, size=(slots * nb_row, pbs, kvh)), jnp.float32)
+            tables = jnp.asarray(prng.permutation(slots * nb_row)
+                                 .reshape(slots, nb_row), jnp.int32)
+            lengths = jnp.full((slots,), kv_len, jnp.int32)
+            # HBM the quantized pool actually moves: int8 K+V plus the
+            # two f32 scale columns per (token, kv-head)
+            kv_bytes = (2 * slots * kv_len * kvh * hd
+                        + 2 * slots * kv_len * kvh * 4)
+            q = jnp.asarray(prng.standard_normal(
+                (slots, 1, kvh, 1, hd)), dt)
+            for kern in ("pallas", "xla"):
+                label = f"paged_{kern}_bs{pbs}_kv{kv_len}_q1"
+                if time.perf_counter() - t_start > args.budget_s:
+                    pi.append({"variant": label, "skipped": "time budget"})
+                    flush()
+                    continue
+                try:
+                    sec, c_s = time_paged(kern, q, kq, vq, tables,
+                                          lengths, scales=(ks, vs))
+                    row = {"variant": label,
+                           "median_us": round(sec * 1e6, 1),
+                           "kv_gb_per_s": round(kv_bytes / sec / 1e9, 2),
+                           "compile_s": round(c_s, 2)}
+                except Exception as e:  # noqa: BLE001
+                    row = {"variant": label,
+                           "error": f"{type(e).__name__}: {e}"}
+                pi.append(row)
+                flush()
+                print(json.dumps(row), flush=True)
+
     # per-shape pallas-vs-xla verdict: AUTO_KERNEL may flip to "pallas"
     # only if the kernel wins at EVERY measured serving shape — a split
-    # decision keeps the gather fallback (it is never wrong, only slow)
-    pairs = {}
-    for v in pv:
-        if "median_us" not in v:
-            continue
-        kern, shape = v["variant"].split("_", 2)[1], v["variant"].split(
-            "_", 2)[2]
-        pairs.setdefault(shape, {})[kern] = v["median_us"]
-    both = {s: d for s, d in pairs.items() if len(d) == 2}
-    if both:
-        wins = sum(d["pallas"] < d["xla"] for d in both.values())
-        out["paged_decode"]["pallas_wins"] = f"{wins}/{len(both)}"
-        out["paged_decode"]["recommendation"] = (
-            "flip ops/paged_attention.py:AUTO_KERNEL to 'pallas'"
-            if wins == len(both) else
-            "keep AUTO_KERNEL='xla' (gather fallback)")
-    else:
-        out["paged_decode"]["incomplete"] = (
-            "need pallas AND xla at >=1 shape for a default decision")
+    # decision keeps the gather fallback (it is never wrong, only slow).
+    # The int8 grid gets its own verdict line: its winner informs the
+    # int8 pools' default independently of the native-dtype decision.
+    def verdict(rows: list, dest: dict) -> None:
+        pairs: dict = {}
+        for v in rows:
+            if "median_us" not in v:
+                continue
+            kern, shape = v["variant"].split("_", 2)[1], v["variant"].split(
+                "_", 2)[2]
+            pairs.setdefault(shape, {})[kern] = v["median_us"]
+        both = {s: d for s, d in pairs.items() if len(d) == 2}
+        if both:
+            wins = sum(d["pallas"] < d["xla"] for d in both.values())
+            dest["pallas_wins"] = f"{wins}/{len(both)}"
+            dest["recommendation"] = (
+                "flip ops/paged_attention.py:AUTO_KERNEL to 'pallas'"
+                if wins == len(both) else
+                "keep AUTO_KERNEL='xla' (gather fallback)")
+        else:
+            dest["incomplete"] = (
+                "need pallas AND xla at >=1 shape for a default decision")
+
+    verdict(pv, out["paged_decode"])
+    verdict(pi, out["paged_int8"])
 
     ok = [v for v in out["variants"] if "tokens_per_s" in v]
     flash_ok = [v for v in ok if v["variant"].startswith("flash_")]
