@@ -42,12 +42,13 @@ REFERENCE_IMAGES_PER_S = 400 / 9.0   # ≈44.4, whole reference cluster
 # beyond-parity capability and carries its own surface,
 # utils/train_bench.py).
 BENCH_SUITE = os.environ.get("BENCH_SUITE", "cnn")
-if BENCH_SUITE not in ("cnn", "lm", "lm_prefix", "lm_slots", "lm_paged",
-                       "lm_tp", "lm_gateway", "lm_autoscale", "train"):
+if BENCH_SUITE not in ("cnn", "lm", "lm_prefix", "lm_cluster_prefix",
+                       "lm_slots", "lm_paged", "lm_tp", "lm_gateway",
+                       "lm_autoscale", "train"):
     raise SystemExit(
         f"BENCH_SUITE={BENCH_SUITE!r}: want "
-        "cnn|lm|lm_prefix|lm_slots|lm_paged|lm_tp|lm_gateway|"
-        "lm_autoscale|train")
+        "cnn|lm|lm_prefix|lm_cluster_prefix|lm_slots|lm_paged|lm_tp|"
+        "lm_gateway|lm_autoscale|train")
 # BENCH_MODEL selects the measured network: resnet18 (headline, matches the
 # reference's "resnet"), resnet50 (bottleneck — ~4x the FLOPs/image, the
 # MXU-utilisation probe), alexnet (the other half of the reference's
@@ -65,6 +66,7 @@ if BENCH_MODEL not in ("resnet18", "resnet50", "alexnet", "vit",
 METRIC = {"cnn": f"{BENCH_MODEL}_imagenet_inference_throughput",
           "lm": "lm_decode_throughput",
           "lm_prefix": "lm_prefix_cache_throughput",
+          "lm_cluster_prefix": "lm_cluster_prefix_warm_throughput",
           "lm_slots": "lm_slot_scaling_throughput",
           "lm_paged": "lm_paged_decode_throughput",
           "lm_tp": "lm_tp_decode_throughput",
@@ -82,6 +84,8 @@ _LAST_GOOD = os.path.join(
      if BENCH_SUITE == "cnn" and BENCH_MODEL == "resnet18"
      else "BENCH_LAST_GOOD_lm.json" if BENCH_SUITE == "lm"
      else "BENCH_LAST_GOOD_lm_prefix.json" if BENCH_SUITE == "lm_prefix"
+     else "BENCH_LAST_GOOD_lm_cluster_prefix.json"
+     if BENCH_SUITE == "lm_cluster_prefix"
      else "BENCH_LAST_GOOD_lm_slots.json" if BENCH_SUITE == "lm_slots"
      else "BENCH_LAST_GOOD_lm_paged.json" if BENCH_SUITE == "lm_paged"
      else "BENCH_LAST_GOOD_lm_tp.json" if BENCH_SUITE == "lm_tp"
@@ -741,6 +745,19 @@ def run_lm_prefix_suite(devices) -> None:
                       "lm prefix-cache measurement failed", compact=False)
 
 
+def run_lm_cluster_prefix_suite(devices) -> None:
+    """BENCH_SUITE=lm_cluster_prefix: what a ring-published KV chain buys
+    a replica that never served the prompt family (ISSUE 17) — first-
+    request TTFT of a no-cluster baseline vs a cold cluster replica
+    (probe+fetch on the request) vs a warm-at-spawn replica
+    (prefix_warm first); headline is the warmed replica's drain
+    throughput, the suffix-only prefill fraction rides in details."""
+    from idunno_tpu.utils.lm_bench import run_lm_cluster_prefix_bench
+    _run_record_suite(devices, run_lm_cluster_prefix_bench, "warmed",
+                      "lm cluster-prefix measurement failed",
+                      compact=False)
+
+
 def run_lm_slots_suite(devices) -> None:
     """BENCH_SUITE=lm_slots: the decode slot-scaling curve (16/32/64 on
     TPU) behind the blessed serving slot default; headline is the curve's
@@ -847,6 +864,8 @@ def main() -> None:
             run_lm_suite(devices)
         elif BENCH_SUITE == "lm_prefix":
             run_lm_prefix_suite(devices)
+        elif BENCH_SUITE == "lm_cluster_prefix":
+            run_lm_cluster_prefix_suite(devices)
         elif BENCH_SUITE == "lm_slots":
             run_lm_slots_suite(devices)
         elif BENCH_SUITE == "lm_paged":

@@ -180,6 +180,35 @@ IDEM_VERBS = (
         why="pools are a named resource on the owner too: a replayed "
             "assign finds the live pool (or its _Starting reservation) "
             "and returns already=True instead of a second build"),
+    IdemVerb("prefix_publish", "natural", anchors=(
+        ("idunno_tpu/serve/control.py",
+         "ControlService._dispatch", "prefix_publish"),
+        # blobs are content-addressed by the rolling chunk hash: a
+        # duplicate publish PUTs identical bytes under identical names
+        ("idunno_tpu/serve/cluster_prefix.py",
+         "ClusterPrefixCache.publish", "chain_names"),),
+        why="chain blobs are content-addressed by the rolling token-chunk "
+            "hash, so a duplicated or replayed publish writes the "
+            "identical bytes under the identical SDFS names and the "
+            "version history converges instead of forking"),
+    IdemVerb("prefix_probe", "natural", anchors=(
+        ("idunno_tpu/serve/control.py",
+         "ControlService._dispatch", "prefix_probe"),
+        ("idunno_tpu/serve/cluster_prefix.py",
+         "ClusterPrefixCache.probe", "stat"),),
+        why="probe is a pure read (ring STATs of content-addressed "
+            "names); it mutates nothing on any node so a retried or "
+            "duplicated probe is trivially exactly-once"),
+    IdemVerb("prefix_fetch", "natural", anchors=(
+        ("idunno_tpu/serve/control.py",
+         "ControlService._dispatch", "prefix_fetch"),
+        # grafting a chunk the radix tree already holds is a no-op: the
+        # walk reuses the existing node instead of allocating a block
+        ("idunno_tpu/serve/prefix_cache.py",
+         "RadixPrefixCache.graft", "children"),),
+        why="fetch grafts content-addressed chunks into the radix tree; "
+            "chunks already present are reused not reallocated, so a "
+            "duplicated fetch converges on the same tree and pool state"),
 )
 
 GUARDED = (

@@ -116,6 +116,18 @@ def lm_tokens(prompt: list[int], seed: int, max_new: int) -> list[int]:
                            for i in range(max_new)]
 
 
+def chunk_content(chunk: list[int]) -> dict:
+    """Deterministic stand-in for one KV block's leaf arrays: a pure
+    function of the chunk tokens, so two replicas publishing the same
+    prefix produce byte-identical blobs (the content-address dedupe
+    property the real pool gets from causal attention) and every fetched
+    blob is CHECKABLE against its tokens — a mismatch is a wrong-token
+    graft, recorded as an invariant violation (ISSUE 17)."""
+    import numpy as np
+    return {"kv": np.asarray([(t * 31 + 7) % 997 for t in chunk],
+                             np.int64)}
+
+
 
 
 class ChaosControl:
@@ -126,13 +138,20 @@ class ChaosControl:
     idempotency purged on rebuild/stop)."""
 
     _POOL_VERBS = ("lm_submit", "lm_poll", "lm_stats", "lm_qos",
-                   "lm_autoscale")
+                   "lm_autoscale", "prefix_publish", "prefix_probe",
+                   "prefix_fetch")
 
     def __init__(self, host: str, membership: MembershipService,
-                 lm_manager: LMPoolManager) -> None:
+                 lm_manager: LMPoolManager, store=None,
+                 violations: list | None = None) -> None:
         self.host = host
         self.membership = membership
         self.mgr = lm_manager
+        # this host's FileStoreService + the cluster violation ledger:
+        # the fake LM tier runs the REAL ClusterPrefixCache against the
+        # real SDFS ring (ISSUE 17), checking fetched content inline
+        self.store = store
+        self.violations = violations if violations is not None else []
         self._loops: dict = {}     # name -> {"next", "done"}
         self._lm_idem: dict = {}   # (name, key) -> node-local row id
 
@@ -242,6 +261,11 @@ class ChaosControl:
                     if p.get("policy"):
                         return mgr.autoscale_set(name, dict(p["policy"]))
                     return mgr.autoscale_get(name)
+                if verb in ("prefix_publish", "prefix_probe",
+                            "prefix_fetch"):
+                    # same relay as serve/control.py:_route_cluster —
+                    # prefix state lives on the serving node
+                    return mgr.prefix_op(verb, name, p)
                 return {"stats": mgr.stats(name)}
         # -- node-local fake LM tier --
         if verb == "lm_serve":
@@ -259,7 +283,24 @@ class ChaosControl:
             self._loops[name] = {"next": 0, "done": [], "defer": [],
                                  "chunk": int(p.get("prefill_chunk")
                                               or 0),
-                                 "n_model": int(p.get("n_model") or 1)}
+                                 "n_model": int(p.get("n_model") or 1),
+                                 "cp": None, "bs": 0, "tree": set(),
+                                 "remote_hits": 0, "published": 0,
+                                 "warmed": 0}
+            if p.get("cluster_prefix") and self.store is not None:
+                # ISSUE 17: the fake tier runs the REAL
+                # ClusterPrefixCache against the real SDFS ring; only
+                # the KV content is a stand-in (chunk_content). The
+                # namespace is the pool BASE name so group replicas and
+                # a failover rebuild share the published set.
+                from idunno_tpu.serve.cluster_prefix import \
+                    ClusterPrefixCache
+                bs = int(p.get("kv_block_size") or 2)
+                loop = self._loops[name]
+                loop["bs"] = bs
+                loop["cp"] = ClusterPrefixCache(
+                    self.store, str(name).split("@", 1)[0], bs,
+                    publish_min_hits=0)
             for k in [k for k in self._lm_idem if k[0] == name]:
                 del self._lm_idem[k]
             return {"slots": int(p.get("slots", 4))}
@@ -276,6 +317,9 @@ class ChaosControl:
             rid = loop["next"]
             loop["next"] += 1
             prompt = [int(t) for t in p["prompt"]]
+            if loop["cp"] is not None:
+                self._prefix_admit(name, loop, prompt,
+                                   str(p.get("tenant", "default")))
             toks = lm_tokens(prompt, int(p.get("seed") or 0),
                              int(p["max_new"]))
             comp = {"id": rid, "tokens": toks,
@@ -309,7 +353,149 @@ class ChaosControl:
             # chaos schedules drive pressure through the injected
             # gauges_fn instead
             return {"qos": None}
+        if verb in ("prefix_publish", "prefix_probe", "prefix_fetch"):
+            return self._prefix_verb(verb, p)
         raise ValueError(f"unknown control verb {verb!r}")
+
+    # -- fake-tier cluster prefix cache (ISSUE 17) -------------------------
+
+    @staticmethod
+    def _chunks(toks: list[int], bs: int) -> list[tuple[int, ...]]:
+        return [tuple(toks[j * bs:(j + 1) * bs])
+                for j in range(len(toks) // bs)]
+
+    @staticmethod
+    def _tree_depth(tree: set, chunks: list, cap: int) -> int:
+        d = 0
+        while d < cap and tuple(chunks[:d + 1]) in tree:
+            d += 1
+        return d
+
+    def _fetch_into(self, name: str, loop: dict, toks: list[int],
+                    local: int, depth: int) -> int:
+        """Fetch depths [local, depth) through the REAL cache, verify
+        each blob's content against the pure ``chunk_content`` of its
+        chunk (a mismatch is a wrong-token graft — invariant violation),
+        and insert contiguously into the fake radix tree. Returns blocks
+        grafted. A store failure mid-fetch just ends the graft early —
+        degradation is legal, corruption is not."""
+        import numpy as np
+        cp, bs, tree = loop["cp"], loop["bs"], loop["tree"]
+        chunks = self._chunks(toks, bs)
+        got = 0
+        for i, (chunk, arrays) in enumerate(cp.fetch(toks, local, depth)):
+            if tuple(chunk) != chunks[local + i]:
+                self.violations.append(
+                    f"{self.host}/{name}: fetched chunk at depth "
+                    f"{local + i} mismatches the prompt (double-prefill "
+                    f"hazard): {chunk}")
+                return got
+            want = chunk_content(list(chunk))["kv"]
+            if not np.array_equal(np.asarray(arrays.get("kv")), want):
+                self.violations.append(
+                    f"{self.host}/{name}: wrong-token KV content fetched "
+                    f"at depth {local + i} for chunk {chunk}")
+                return got
+            tree.add(tuple(chunks[:local + i + 1]))
+            got += 1
+        return got
+
+    def _prefix_admit(self, name: str, loop: dict, prompt: list[int],
+                      tenant: str = "default") -> None:
+        """Model the admission-path flow over the REAL subsystem: local
+        radix depth from the fake tree, ring probe + suffix-only fetch,
+        inline content checks, then insert + publish. Mirrors
+        engine/serve_lm.py:_admit/_finish_admission."""
+        cp, bs, tree = loop["cp"], loop["bs"], loop["tree"]
+        if len(prompt) <= bs:
+            return
+        # admission caps the hit so >= 1 token always prefills
+        want = (len(prompt) - 1) // bs
+        chunks = self._chunks(prompt, bs)
+        local = self._tree_depth(tree, chunks, want)
+        hit = local
+        if local < want:
+            depth = cp.probe(prompt[:want * bs], start_depth=local)
+            if depth > local:
+                got = self._fetch_into(name, loop, prompt[:want * bs],
+                                       local, depth)
+                if got:
+                    hit = local + got
+                    loop["remote_hits"] += 1
+                    cp.remote_hits += 1
+        if hit > want or len(prompt) - hit * bs < 1:
+            self.violations.append(
+                f"{self.host}/{name}: admission covered {hit} blocks of "
+                f"a {len(prompt)}-token prompt (no tokens left to "
+                f"prefill)")
+            return
+        for d in range(1, want + 1):
+            tree.add(tuple(chunks[:d]))
+        out = cp.publish(
+            [t for c in chunks[:want] for t in c], want,
+            lambda j: chunk_content(list(chunks[j])), tenant=tenant)
+        loop["published"] += out["published"]
+
+    def _prefix_verb(self, verb: str, p: dict) -> dict:
+        """Node-local handlers mirroring serve/lm_pool.py:_fulfill_prefix
+        over the fake tier's tree + the real ClusterPrefixCache."""
+        name = p["name"]
+        loop = self._loops.get(name)
+        if loop is None:
+            raise ValueError(f"no lm_serve pool for {name!r}; "
+                             "call lm_serve first")
+        cp, bs, tree = loop["cp"], loop["bs"], loop["tree"]
+        if cp is None:
+            raise ValueError(f"pool {name!r} has no cluster prefix "
+                             "cache (serve with cluster_prefix=...)")
+        if verb == "prefix_probe":
+            toks = [int(t) for t in p.get("tokens") or []]
+            chunks = self._chunks(toks, bs)
+            local = self._tree_depth(tree, chunks, len(chunks))
+            return {"local_blocks": local,
+                    "remote_blocks": cp.probe(toks),
+                    "namespace": cp.namespace, "block_size": bs}
+        if verb == "prefix_publish":
+            targets = []
+            if p.get("tokens") is not None:
+                targets.append([int(t) for t in p["tokens"]])
+            else:
+                # every maximal chain in the tree (no extension present)
+                for path in sorted(tree):
+                    if not any(len(o) > len(path) and
+                               o[:len(path)] == path for o in tree):
+                        targets.append([t for c in path for t in c])
+            published = 0
+            for toks in targets:
+                chunks = self._chunks(toks, bs)
+                out = cp.publish(
+                    toks, len(chunks),
+                    lambda j, c=chunks: chunk_content(list(c[j])),
+                    force=True)
+                published += out["published"]
+            loop["published"] += published
+            return {"published_blocks": published,
+                    "chains": len(targets)}
+        # prefix_fetch: warm explicit tokens or a tenant's published set
+        targets = []
+        if p.get("tokens") is not None:
+            targets.append([int(t) for t in p["tokens"]])
+        elif p.get("tenant") is not None:
+            targets = [[int(t) for t in e.get("tokens", [])]
+                       for e in cp.tenant_entries(str(p["tenant"]))]
+        fetched = 0
+        for toks in targets:
+            chunks = self._chunks(toks, bs)
+            local = self._tree_depth(tree, chunks, len(chunks))
+            if local >= len(chunks):
+                continue
+            depth = cp.probe(toks, start_depth=local)
+            if depth > local:
+                fetched += self._fetch_into(name, loop, toks, local,
+                                            depth)
+        cp.warm_blocks += fetched
+        loop["warmed"] += fetched
+        return {"fetched_blocks": fetched, "targets": len(targets)}
 
 
 class ChaosCluster:
@@ -323,7 +509,8 @@ class ChaosCluster:
 
     def __init__(self, seed: int, data_dir: str, n_hosts: int = 5,
                  prefill_chunk: int = 0, n_model: int = 1,
-                 autoscale: bool = False, multi_pool: bool = False) -> None:
+                 autoscale: bool = False, multi_pool: bool = False,
+                 cluster_prefix: bool = False) -> None:
         self.seed = seed
         self.prefill_chunk = prefill_chunk
         self.n_model = n_model
@@ -333,6 +520,14 @@ class ChaosCluster:
         # ISSUE 14: a second concurrent managed pool, flag-gated for the
         # same reason — its submissions draw extra rng in step()
         self.multi_pool = multi_pool
+        # ISSUE 17: cluster prefix cache over the SDFS ring — flag-gated
+        # for the same reason (prefix submissions draw extra rng, and the
+        # real store traffic the cache generates draws chaos rng)
+        self.cluster_prefix = cluster_prefix
+        # created before the host loop: the controls hold a reference so
+        # the fake tier's inline content checks (wrong-token graft,
+        # double-prefill) land in the same invariant ledger
+        self.violations: list[str] = []
         # synthetic interactive-p95 the injected gauges_fn reports for
         # group replicas; schedules script overload/underload through it
         self.group_pressure = 0.0
@@ -403,10 +598,11 @@ class ChaosCluster:
             if autoscale:
                 mgr.autoscaler.gauges_fn = (
                     lambda name, _m=mgr: self._scripted_gauges(_m, name))
-            self.controls[h] = ChaosControl(h, self.members[h], mgr)
+            self.controls[h] = ChaosControl(
+                h, self.members[h], mgr, store=self.stores[h],
+                violations=self.violations)
             t.serve("control", self.controls[h].handle)
-        # invariant recorders
-        self.violations: list[str] = []
+        # invariant recorders (violations created above, pre-host-loop)
         self.epoch_owners: dict[int, set[str]] = {}
         self.acting_by_epoch: dict[int, set[str]] = {}
         # (scope, epoch) -> owners seen: >1 owner = per-pool split brain
@@ -430,6 +626,7 @@ class ChaosCluster:
         # ever attempted would mean cross-wired journals
         self.lm_attempted: list[dict] = []
         self.grp_acked: list[dict] = []      # group-routed lm submissions
+        self.lmp_acked: list[dict] = []      # shared-head prefix workload
         # (name, version, blob, holders-at-ack): the holder set feeds the
         # ring-RF invariant — a death must not shrink it below min(RF, |set|)
         self.sdfs_acked: list[tuple[str, int, bytes, frozenset]] = []
@@ -448,7 +645,9 @@ class ChaosCluster:
             **({"prefill_chunk": self.prefill_chunk}
                if self.prefill_chunk else {}),
             **({"n_model": self.n_model}
-               if self.n_model > 1 else {})})
+               if self.n_model > 1 else {}),
+            **({"cluster_prefix": True, "kv_block_size": 2}
+               if self.cluster_prefix else {})})
         assert out.get("node") or out.get("already"), out
         if multi_pool:
             # a SECOND independent managed pool: its journal, fence scope,
@@ -668,6 +867,34 @@ class ChaosCluster:
         self.grp_acked.append({"serial": s, "grid": int(out["id"]),
                                "prompt": prompt, "seed": s, "max_new": 4})
 
+    # shared 6-token head = exactly 3 full blocks at kv_block_size=2:
+    # every prefix submission publishes/remote-hits the SAME chain, so a
+    # serving-node death followed by a failover rebuild must re-derive it
+    # from the ring (the fake radix tree died with the node)
+    PREFIX_HEAD = (11, 13, 17, 19, 23, 29)
+
+    def op_lm_prefix(self, client: str) -> None:
+        """A shared-head submission to the prefix-enabled pool (ISSUE
+        17): the head is 3 publishable blocks, the 1-token tail keeps
+        the token tuple serial-unique for the exactness ledger. The
+        fake tier's admission probes/fetches/publishes the head through
+        the REAL ClusterPrefixCache; content checks append violations."""
+        self._serial += 1
+        s = self._serial
+        prompt = list(self.PREFIX_HEAD) + [s % 251]
+        self.lm_attempted.append({"serial": s, "prompt": prompt,
+                                  "seed": s, "max_new": 4,
+                                  "pool": self.LM_POOL})
+        try:
+            out = self._client_control(
+                client, {"verb": "lm_submit", "name": self.LM_POOL,
+                         "prompt": prompt, "max_new": 4, "seed": s},
+                idem=f"{client}:{s}:p")
+        except (TransportError, RuntimeError):
+            return
+        self.lmp_acked.append({"serial": s, "rid": int(out["id"]),
+                               "prompt": prompt, "seed": s, "max_new": 4})
+
     def _scripted_gauges(self, mgr: LMPoolManager, name: str) -> dict:
         """Deterministic stand-in for `group_gauges`: scripted p95
         pressure (one number for the whole group), real journal backlog
@@ -770,6 +997,8 @@ class ChaosCluster:
                 self.op_lm_group(client)
             elif self.multi_pool and self.rng.random() < 0.5:
                 self.op_lm_b(client)
+            elif self.cluster_prefix and self.rng.random() < 0.5:
+                self.op_lm_prefix(client)
             else:
                 self.op_lm(client)
         elif r < 0.58:
@@ -1083,6 +1312,21 @@ class ChaosCluster:
             grp_summary = {"grp_acked": len(self.grp_acked),
                            "grp_replicas": len(gview["replicas"]),
                            "grp_decisions": gview["next_seq"]}
+        # cluster prefix cache (ISSUE 17): inline content checks landed
+        # in self.violations (asserted empty above); the summary carries
+        # the aggregate fake-tier gauges so soak JSON shows the workload
+        # actually exercised remote hits, not just cold misses
+        prefix_summary: dict = {}
+        if self.cluster_prefix:
+            loops = [loop for ctl in self.controls.values()
+                     for loop in ctl._loops.values()
+                     if loop.get("cp") is not None]
+            prefix_summary = {
+                "lmp_acked": len(self.lmp_acked),
+                "prefix_remote_hits": sum(x["remote_hits"]
+                                          for x in loops),
+                "prefix_published": sum(x["published"] for x in loops),
+                "prefix_warmed": sum(x["warmed"] for x in loops)}
         pool_epochs: dict[str, int] = {}
         for scope, e in self.scope_owners:
             pool_epochs[scope] = max(pool_epochs.get(scope, 0), e)
@@ -1107,7 +1351,7 @@ class ChaosCluster:
                 "owner_moves": owner_moves,
                 "hosts": len(self.cfg.hosts),
                 "final_master": self.final_master(),
-                **grp_summary}
+                **grp_summary, **prefix_summary}
 
 
 def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
@@ -1116,7 +1360,8 @@ def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
                         n_model: int = 1,
                         autoscale: bool = False,
                         multi_pool: bool = False,
-                        n_hosts: int = 5) -> dict:
+                        n_hosts: int = 5,
+                        cluster_prefix: bool = False) -> dict:
     """One full seeded chaos run: schedule -> converge -> invariants.
     Returns the invariant summary plus convergence time.
     ``prefill_chunk`` rides the managed pool's lm_serve spec (ISSUE 7):
@@ -1129,11 +1374,16 @@ def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
     ``multi_pool`` serves a SECOND concurrent managed pool and
     ``n_hosts`` scales the cluster (ISSUE 14): per-pool fence scopes,
     scoped adoption, and cross-pool isolation join the invariant surface,
-    certified at 50-100 hosts by the soak driver."""
+    certified at 50-100 hosts by the soak driver.
+    ``cluster_prefix`` serves the first pool with the cluster prefix
+    cache on (ISSUE 17): a shared-head workload publishes/remote-hits
+    real KVC1 blobs on the real SDFS ring, with inline wrong-token /
+    double-prefill checks feeding the violations ledger."""
     c = ChaosCluster(seed, data_dir, n_hosts=n_hosts,
                      prefill_chunk=prefill_chunk,
                      n_model=n_model, autoscale=autoscale,
-                     multi_pool=multi_pool)
+                     multi_pool=multi_pool,
+                     cluster_prefix=cluster_prefix)
     try:
         c.run_schedule(steps=steps,
                        chaos=chaos if chaos is not None

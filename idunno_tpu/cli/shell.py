@@ -660,12 +660,24 @@ class Shell:
             pc = stats.get("prefix_cache")
             if not pc:
                 return ""
-            return (f"\n  prefix_cache: hit_rate="
-                    f"{pc['prefix_hit_rate']:.2f} "
-                    f"saved={pc['cached_tokens_saved']}tok "
-                    f"blocks={pc['kv_blocks_used']}/"
-                    f"{pc['kv_blocks_used'] + pc['kv_blocks_free']} "
-                    f"evictions={pc['evictions']}")
+            out = (f"\n  prefix_cache: hit_rate="
+                   f"{pc['prefix_hit_rate']:.2f} "
+                   f"saved={pc['cached_tokens_saved']}tok "
+                   f"blocks={pc['kv_blocks_used']}/"
+                   f"{pc['kv_blocks_used'] + pc['kv_blocks_free']} "
+                   f"evictions={pc['evictions']}")
+            # cluster tier (ISSUE 17): only worth a line once the ring
+            # has been touched — published, hit, warmed or fetched
+            if any(pc.get(k) for k in ("prefix_remote_hits",
+                                       "prefix_published_chains",
+                                       "prefix_warm_blocks",
+                                       "prefix_fetch_bytes")):
+                out += (f"\n  cluster_prefix: remote_hits="
+                        f"{pc['prefix_remote_hits']} "
+                        f"published={pc['prefix_published_chains']} "
+                        f"warm_blocks={pc['prefix_warm_blocks']} "
+                        f"fetched={pc['prefix_fetch_bytes']}B")
+            return out
 
         def gateway_line(stats: dict) -> str:
             gw = stats.get("gateway")

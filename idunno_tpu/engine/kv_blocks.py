@@ -56,6 +56,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from idunno_tpu.engine.generate import init_cache
 
@@ -270,6 +271,48 @@ class KVBlockPool:
         for key, store in self._stores.items():
             self._stores[key] = _write_block(store, src[key], b, off,
                                              stacked=self._stacked)
+
+    def read_block(self, bid: int) -> dict[str, Any]:
+        """One block's raw per-leaf content as HOST numpy arrays, keyed
+        by leaf keystr — the payload half of a cluster prefix-cache
+        publish (`serve/cluster_prefix.py`). Stacked pools return
+        ``[L, bs, ...]`` slivers, unscanned ``[bs, ...]``. Under TP the
+        read gathers the head-sharded store — logical shapes (and
+        bytes) are identical across ``n_model``, so published blobs are
+        content-equal regardless of the publisher's mesh."""
+        if bid not in self._refs:
+            raise ValueError(f"block {bid} is not allocated")
+        out = {}
+        for key, store in self._stores.items():
+            sliver = store[:, bid] if self._stacked else store[bid]
+            out[key] = np.asarray(jax.device_get(sliver))
+        return out
+
+    def write_raw_block(self, bid: int, arrays: dict[str, Any]) -> None:
+        """Inverse of `read_block`: install fetched raw slivers into
+        block ``bid``. Every store leaf must be present with its exact
+        per-block shape — a partial or mis-shaped payload raises before
+        any store is touched (a half-written block would poison every
+        later prefix hit on its chain)."""
+        if bid not in self._refs:
+            raise ValueError(f"block {bid} is not allocated")
+        staged = {}
+        for key, store in self._stores.items():
+            arr = arrays.get(key)
+            want = store.shape[:1] + store.shape[2:] if self._stacked \
+                else store.shape[1:]
+            if arr is None:
+                raise ValueError(f"write_raw_block missing leaf {key!r}")
+            if tuple(arr.shape) != tuple(want):
+                raise ValueError(
+                    f"write_raw_block leaf {key!r} shape {arr.shape} != "
+                    f"store block shape {want}")
+            staged[key] = jnp.asarray(arr, store.dtype)
+        for key, store in self._stores.items():
+            if self._stacked:
+                self._stores[key] = store.at[:, bid].set(staged[key])
+            else:
+                self._stores[key] = store.at[bid].set(staged[key])
 
     def kv_pages(self) -> dict[str, jnp.ndarray]:
         """Raw page stores by leaf name ({"cached_k", "cached_v"} plus

@@ -575,6 +575,11 @@ class DecodeServer:
         # optional per-node span recorder (utils/spans.py), set by the
         # serving layer after construction; None = tracing off, zero cost
         self.spans = None
+        # optional cluster prefix cache (serve/cluster_prefix.py), set by
+        # the serving layer after construction like `spans` — the engine
+        # layer stays free of store/transport dependencies. None = local-
+        # only radix caching, zero cost on the admission path.
+        self.cluster_prefix = None
         # cheap argument validation BEFORE any device allocation or
         # weight quantization: a bad prefix must fail in microseconds
         self.prefix = list(prefix) if prefix else None
@@ -1438,8 +1443,11 @@ class DecodeServer:
     def prefix_cache_stats(self) -> dict:
         """Radix prefix-cache gauges (only meaningful on kv_block_size
         pools): hit rate over admissions, prompt tokens whose prefill
-        was skipped, block-pool occupancy, tree churn counters."""
-        return {
+        was skipped, block-pool occupancy, tree churn counters, plus
+        the cluster prefix-cache counters (zeros when the cluster tier
+        is off, so dashboards see a stable gauge set)."""
+        cp = self.cluster_prefix
+        out = {
             "prefix_hit_rate": (self._pc_hits / self._pc_lookups
                                 if self._pc_lookups else 0.0),
             "lookups": self._pc_lookups,
@@ -1451,7 +1459,124 @@ class DecodeServer:
             "insert_skips": self._radix.insert_skips,
             "inserted_blocks": self._radix.inserted_blocks,
             "nodes": self._radix.num_nodes(),
+            "prefix_remote_hits": 0,
+            "prefix_published_chains": 0,
+            "prefix_warm_blocks": 0,
+            "prefix_fetch_bytes": 0,
         }
+        if cp is not None:
+            out.update(cp.stats())
+        return out
+
+    # -- cluster prefix cache (serve/cluster_prefix.py) -------------------
+
+    def _cluster_fetch(self, per_req: list, local: int, want: int) -> int:
+        """Probe the ring for a chain longer than the ``local`` radix
+        depth, fetch the missing depths [local, found) and graft them.
+        Returns new blocks grafted (0 = miss/failure — the admission
+        proceeds on its local hit)."""
+        cp = self.cluster_prefix
+        bs = self.kv_block_size
+        depth = cp.probe(per_req[:want * bs], start_depth=local)
+        if depth <= local:
+            return 0
+        fetched = cp.fetch(per_req, local, depth)
+        if not fetched:
+            return 0
+        wrote = self._radix.graft(per_req, fetched, local)
+        if wrote:
+            cp.remote_hits += 1
+        return wrote
+
+    def prefix_probe(self, tokens: list[int]) -> dict:
+        """`prefix_probe` verb: local radix depth vs the deepest
+        published depth for this prompt. Pure read (the lookup only
+        touches LRU stamps)."""
+        cp = self._require_cluster()
+        local = len(self._radix.lookup(list(tokens)))
+        remote = cp.probe(list(tokens))
+        return {"local_blocks": local, "remote_blocks": remote,
+                "namespace": cp.namespace,
+                "block_size": self.kv_block_size}
+
+    def prefix_warm(self, tokens: list[int] | None = None,
+                    tenant: str | None = None) -> dict:
+        """`prefix_fetch` verb: pull published chains into the radix
+        tree WITHOUT an admission — the warm-at-spawn primitive. With
+        ``tenant`` (and no tokens) the per-tenant SDFS warm index names
+        the prefixes to pull. Fetched blocks count as ``warm_blocks``;
+        grafting is naturally idempotent (already-present chunks are
+        reused), so a replayed warm converges."""
+        cp = self._require_cluster()
+        targets = []
+        if tokens is not None:
+            targets.append([int(t) for t in tokens])
+        elif tenant is not None:
+            targets = [e.get("tokens", []) for e in
+                       cp.tenant_entries(str(tenant))]
+        else:
+            raise ValueError("prefix_fetch needs tokens or tenant")
+        fetched_blocks = 0
+        for toks in targets:
+            want = len(toks) // self.kv_block_size
+            if want < 1:
+                continue
+            local = len(self._radix.lookup(toks))
+            if local >= want:
+                continue
+            depth = cp.probe(toks[:want * self.kv_block_size],
+                             start_depth=local)
+            if depth <= local:
+                continue
+            blobs = cp.fetch(toks, local, depth)
+            if blobs:
+                fetched_blocks += self._radix.graft(toks, blobs, local)
+        cp.warm_blocks += fetched_blocks
+        return {"fetched_blocks": fetched_blocks,
+                "targets": len(targets), "bytes": cp.fetch_bytes}
+
+    def prefix_publish(self, tokens: list[int] | None = None,
+                       tenant: str | None = None) -> dict:
+        """`prefix_publish` verb: push cached chains to the ring. With
+        ``tokens``, the longest local chain for that prompt; without,
+        every root-to-leaf path in the radix tree (min-hits policy
+        bypassed — an explicit publish is an operator decision)."""
+        cp = self._require_cluster()
+        chains = []
+        if tokens is not None:
+            chain = self._radix.lookup([int(t) for t in tokens])
+            if chain:
+                chains.append(chain)
+        else:
+            stack = [[nd] for nd in
+                     self._radix._root.children.values()]
+            while stack:
+                path = stack.pop()
+                kids = path[-1].children
+                if not kids:
+                    chains.append(path)
+                    continue
+                for nd in kids.values():
+                    stack.append(path + [nd])
+        published = blocks = 0
+        for chain in chains:
+            toks = [t for nd in chain for t in nd.chunk]
+            out = cp.publish(
+                toks, len(chain),
+                (lambda ch: lambda j: self._block_pool.read_block(
+                    ch[j].block))(chain),
+                tenant=tenant, force=True)
+            published += out["published"]
+            blocks += out["blocks"]
+        return {"published_blocks": published, "chains": len(chains),
+                "blocks": blocks}
+
+    def _require_cluster(self):
+        if self.cluster_prefix is None or self._radix is None:
+            raise ValueError("pool has no cluster prefix cache "
+                             "(serve with cluster_prefix= and "
+                             "kv_block_size > 0)")
+        return self.cluster_prefix
 
     # -- serving loop -----------------------------------------------------
 
@@ -1526,6 +1651,17 @@ class DecodeServer:
                 self._pc_lookups += 1
                 hit_chain = self._radix.lookup(per_req)
                 bs = self.kv_block_size
+                want = (suffix_true - 1) // bs   # usable depth in blocks
+                # cluster prefix cache: a local miss (or shorter local
+                # hit) probes the ring for a longer published chain and
+                # grafts ONLY the missing block suffix into the radix
+                # tree; the re-lookup below then extends the hit so the
+                # prefill covers just the remainder. Degrades to the
+                # local hit on any store/transport failure.
+                if (self.cluster_prefix is not None
+                        and len(hit_chain) < want):
+                    if self._cluster_fetch(per_req, len(hit_chain), want):
+                        hit_chain = self._radix.lookup(per_req)
                 hit = min(len(hit_chain) * bs,
                           ((suffix_true - 1) // bs) * bs)
             while True:
@@ -1702,6 +1838,17 @@ class DecodeServer:
                 self._radix.release(hit_chain)
             if chain:
                 self._held[req.id] = chain
+            cp = self.cluster_prefix
+            if (cp is not None and chain
+                    and hit // self.kv_block_size >= cp.publish_min_hits):
+                # publish the request's full chain: a local hit of at
+                # least `publish_min_hits` blocks proved the prompt head
+                # is shared (0 = publish every inserted chain). Content-
+                # addressed names make a replayed publish converge, and
+                # every failure degrades to a skip (cp.errors).
+                cp.publish(per_req, len(chain),
+                           lambda j: self._block_pool.read_block(
+                               chain[j].block))
         if self._paged:
             nb = hit // self.kv_block_size
             tab = np.zeros((self._max_chain,), np.int32)
@@ -1943,4 +2090,6 @@ class DecodeServer:
         for k in self._stats:
             self._stats[k] = 0
         self._pc_lookups = self._pc_hits = self._pc_tokens_saved = 0
+        if self.cluster_prefix is not None:
+            self.cluster_prefix.reset_counters()
         return warm_s

@@ -309,6 +309,16 @@ class ControlService:
                 # on a reload must not stop the live loop
                 from idunno_tpu.serve.gateway import AdmissionGateway
                 gw_spec = AdmissionGateway.validate_spec(gw_spec)
+            cp_spec = p.get("cluster_prefix") or None
+            if cp_spec is not None:
+                # cluster prefix cache (ISSUE 17) rides the journaled
+                # spec like the block-pool keys; it REQUIRES the radix
+                # tier (content is addressed per kv block)
+                if not int(p.get("kv_block_size", 0)):
+                    raise ValueError(
+                        "cluster_prefix needs kv_block_size > 0")
+                cp_spec = (dict(cp_spec) if isinstance(cp_spec, dict)
+                           else {"on": True})
             placeholder = _Starting()
             with self._reg_lock:
                 old = self._lm_loops.get(name)
@@ -379,6 +389,24 @@ class ControlService:
                     # scheduler's signal, serve/metrics.py) measures
                     # steady-state work, not a compile
                     server.warmup()
+                if cp_spec is not None:
+                    # attach AFTER warmup: the throwaway warm request
+                    # must not publish its chain to the ring. Replicas
+                    # of one group (and re-serves of one pool) derive
+                    # the SAME namespace from the same model/params/
+                    # prefix, so their published chains dedupe; an
+                    # explicit "namespace" key pins cross-pool sharing
+                    # or isolation by hand.
+                    from idunno_tpu.serve.cluster_prefix import (
+                        ClusterPrefixCache, pool_namespace)
+                    ns = cp_spec.get("namespace") or pool_namespace(
+                        server.model, server.params, server.prefix,
+                        server.quantize, server.kv_block_size,
+                        extra=str(p.get("model") or ""))
+                    server.cluster_prefix = ClusterPrefixCache(
+                        node.store, ns, server.kv_block_size,
+                        publish_min_hits=int(
+                            cp_spec.get("publish_min_hits", 1)))
                 gateway = None
                 if gw_spec is not None:
                     # QoS front door (serve/gateway.py): per-tenant
@@ -505,6 +533,22 @@ class ControlService:
             # the pool runs without a gateway)
             gw = self._lm_loop(p["name"]).gateway
             return {"qos": gw.stats() if gw is not None else None}
+        if verb in ("prefix_publish", "prefix_probe", "prefix_fetch"):
+            # cluster prefix cache (ISSUE 17): publish pushes cached
+            # chains to the SDFS ring, probe reports local-vs-published
+            # depth (pure read), fetch (the warm-at-spawn primitive)
+            # grafts published chains into the pool's radix tree. All
+            # three are fenced + scope-stamped like any pool verb (the
+            # _handle preamble) and idempotent by content addressing —
+            # contract rows in analysis/contracts.py.
+            loop = self._lm_loop(p["name"])
+            op = verb.split("_", 1)[1]
+            kw: dict = {}
+            if p.get("tokens") is not None:
+                kw["tokens"] = [int(t) for t in p["tokens"]]
+            if op != "probe" and p.get("tenant") is not None:
+                kw["tenant"] = str(p["tenant"])
+            return loop.prefix_op(op, **kw)
         if verb == "lm_stats":
             stats = self._lm_loop(p["name"]).stats()
             # surface pool gauges on the node's C8 metrics tracker so the
@@ -652,6 +696,12 @@ class ControlService:
                 # per-pool WAL (delta frames + full fallbacks) — the
                 # number the delta compaction is supposed to shrink
                 extra_g["pool_wal_bytes"] = fo.pool_wal_bytes()
+            lmgr = getattr(node, "lm_manager", None)
+            if lmgr is not None:
+                # ISSUE 17 satellite: journal rows compacted out of
+                # shipped per-pool WAL segments below the delivered
+                # low-water mark
+                extra_g["pool_wal_truncated"] = lmgr.wal_truncated
             # ISSUE 15: ownership-routing counters are always present in
             # the scrape (zero until the first redirect/handoff) so
             # dashboards can alert on them without a priming event
@@ -724,7 +774,8 @@ class ControlService:
 
     # pool-directed verbs that route by scope owner (ISSUE 15)
     _POOL_VERBS = ("lm_submit", "lm_poll", "lm_stats", "lm_stop",
-                   "lm_cancel", "lm_partial", "lm_qos", "lm_autoscale")
+                   "lm_cancel", "lm_partial", "lm_qos", "lm_autoscale",
+                   "prefix_publish", "prefix_probe", "prefix_fetch")
 
     def _forward_scope_owner(self, p: dict, name: str, owner: str) -> dict:
         """Owner-aware routing (ISSUE 15): this node does not hold the
@@ -881,6 +932,12 @@ class ControlService:
                 if p.get("policy"):
                     return mgr.autoscale_set(name, dict(p["policy"]))
                 return mgr.autoscale_get(name)
+            if verb in ("prefix_publish", "prefix_probe",
+                        "prefix_fetch"):
+                # managed pools: relay to the pool's node (or fan over a
+                # group's replicas) — prefix state lives on the serving
+                # node, the journal only knows the spec
+                return mgr.prefix_op(verb, name, p)
             return mgr.stop(name)
         if verb in ("train_status", "train_stop") and mgr.has_job(name):
             return (mgr.train_status(name) if verb == "train_status"

@@ -146,6 +146,10 @@ class LMPoolManager:
         # standby ACKed, so _replicate_pool can ship journal deltas and
         # fall back to a full entry on any gap (ISSUE 15)
         self._wal_shipped: dict[str, dict[str, Any]] = {}
+        # cumulative journal rows compacted out of shipped WAL segments
+        # below the delivered low-water mark (ISSUE 17 satellite;
+        # metrics_export: pool_wal_truncated)
+        self.wal_truncated = 0
         # the control loop; tick() runs from pump_once, so it inherits
         # the acting-master gate. clock/gauges_fn are injectable
         # (tests/test_autoscaler.py, chaos harness).
@@ -889,6 +893,57 @@ class LMPoolManager:
                 out["qos_error"] = str(e)
         return out
 
+    def prefix_op(self, verb: str, name: str,
+                  p: dict[str, Any]) -> dict[str, Any]:
+        """Relay a cluster-prefix verb (`prefix_publish`/`prefix_probe`/
+        `prefix_fetch`) to a managed pool's serving node — prefix state
+        lives in the pool's radix tree and SDFS memo, the journal only
+        knows the spec. For a replica GROUP, publish/fetch fan over
+        every active replica (counters summed — warming touches every
+        replica's local tree) while probe asks one live replica (the
+        published set is cluster-global, any replica sees it)."""
+        fwd: dict[str, Any] = {"verb": verb}
+        if p.get("tokens") is not None:
+            fwd["tokens"] = [int(t) for t in p["tokens"]]
+        if p.get("tenant") is not None:
+            fwd["tenant"] = str(p["tenant"])
+        with self._lock:
+            g = self._groups.get(name)
+            if g is None:
+                pool = self._pools.get(name)
+                if pool is None:
+                    raise ValueError(f"no managed pool {name!r}")
+                targets = [(name, pool["node"])]
+            else:
+                targets = [(r, self._pools[r]["node"])
+                           for r, m in sorted(g["replicas"].items())
+                           if m["state"] == "active"
+                           and r in self._pools]
+        targets = [(r, n) for r, n in targets if n is not None]
+        if not targets:
+            raise ValueError(f"{name!r}: no serving node for {verb}")
+        if verb == "prefix_probe" or len(targets) == 1:
+            rname, node = targets[0]
+            return self._call(node, dict(fwd, name=rname),
+                              scope=pool_scope(name))
+        merged: dict[str, Any] = {"replicas": 0}
+        for rname, node in targets:
+            try:
+                out = self._call(node, dict(fwd, name=rname),
+                                 scope=pool_scope(name))
+            except (TransportError, ValueError, OSError) as e:
+                merged.setdefault("errors", []).append(
+                    f"{rname}: {e}")
+                continue
+            merged["replicas"] += 1
+            for k, v in out.items():
+                if isinstance(v, (int, float)) and not isinstance(
+                        v, bool):
+                    merged[k] = merged.get(k, 0) + v
+                elif k not in merged:
+                    merged[k] = v
+        return merged
+
     def stop(self, name: str) -> dict[str, Any]:
         with self._lock:
             is_group = name in self._groups
@@ -1109,17 +1164,38 @@ class LMPoolManager:
         with self._lock:
             g = self._groups.get(name)
             stale = g is None
+            warm_tenants: list[str] = []
             if not stale:
                 g["replicas"][rname] = {"role": role, "state": "active",
                                         "t_drain": 0.0}
                 decision = self._record_decision_locked(
                     name, g, "spawn", replica=rname, role=role,
                     node=out.get("node"), **attrs)
+                if g["spec"].get("cluster_prefix"):
+                    warm_tenants = sorted(g["tenants"])
         if stale:
             self.stop(rname)   # group stopped mid-build: nothing serves
             return None
         self._replicate_scale(name, decision)
+        if warm_tenants and out.get("node") is not None:
+            self._warm_replica(name, rname, out["node"], warm_tenants)
         return decision
+
+    def _warm_replica(self, group: str, rname: str, node: str,
+                      tenants: list[str]) -> None:
+        """Warm-at-spawn (ISSUE 17): a fresh replica of a cluster-prefix
+        group fetches the published chains of the group's known tenants
+        before traffic lands on it, so its first request for a published
+        prefix prefills only the suffix. Best-effort — a warm failure
+        never fails the spawn (the replica just starts cold, exactly
+        like before this feature existed)."""
+        for tenant in tenants:
+            try:
+                self._call(node, {"verb": "prefix_fetch", "name": rname,
+                                  "tenant": tenant},
+                           scope=pool_scope(group))
+            except (TransportError, ValueError, OSError):
+                pass
 
     @staticmethod
     def _replica_index(rname: str) -> int:
@@ -2323,6 +2399,40 @@ class LMPoolManager:
             frame["idem"] = dict(cur.get("idem", {}))
         return frame
 
+    @staticmethod
+    def _truncate_wire(entry: dict[str, Any]) \
+            -> tuple[dict[str, Any], int]:
+        """Compact a wire entry below the delivered LOW-WATER MARK: the
+        contiguous run of rids from the bottom of the journal whose rows
+        are all journal-terminal AND delivered carries no recovery value
+        (an adopter neither resubmits terminal rows nor re-delivers
+        delivered ones — poll() will prune them on its next call anyway)
+        so the shipped WAL segment drops them, with their idem keys,
+        instead of re-shipping them on every mutation (ISSUE 17
+        satellite). Only the prefix below the first live/undelivered rid
+        truncates — the segment stays a contiguous journal tail, and the
+        `need_full` fallback stays correct across a truncated base: a
+        delta against the truncated base lists later truncations as
+        ``removed`` rows, and any base gap re-ships the (truncated) full
+        entry. Returns (entry, rows_truncated); the input is untouched
+        when nothing truncates."""
+        reqs = entry["requests"]
+        live = [int(rid) for rid, q in reqs.items()
+                if q["status"] in (_PENDING, _INFLIGHT)
+                or not q.get("delivered")]
+        lwm = min(live) if live else int(entry["next_rid"])
+        drop = {rid for rid in reqs if int(rid) < lwm}
+        if not drop:
+            return entry, 0
+        entry = dict(entry)
+        entry["requests"] = {rid: q for rid, q in reqs.items()
+                             if rid not in drop}
+        dropped = {int(rid) for rid in drop}
+        if entry.get("idem"):
+            entry["idem"] = {k: v for k, v in entry["idem"].items()
+                             if int(v) not in dropped}
+        return entry, len(drop)
+
     def _replicate_pool(self, name: str) -> None:
         """Push the pool's journal mutation to its scope standby's WAL
         segment (FailoverManager.wal_pool — the journal twin of the
@@ -2342,7 +2452,9 @@ class LMPoolManager:
             if p is None:
                 return
             p["wal_seq"] = int(p.get("wal_seq", 0)) + 1
-            entry = self._pool_wire(p)
+            entry, ncut = self._truncate_wire(self._pool_wire(p))
+            if ncut:
+                self.wal_truncated += ncut
             base = self._wal_shipped.get(name)
         frame = entry if base is None else self._pool_delta(base, entry)
         ack = fo.wal_pool(name, frame)

@@ -65,6 +65,15 @@ class LMServingLoop:
         self._snap_want = threading.Event()
         self._snap_done = threading.Event()
         self._snap: list[dict] = []
+        # cluster prefix-cache ops (publish/probe/fetch) mutate server
+        # state, so RPC threads marshal them to the loop thread exactly
+        # like snapshots; tenant notes ride a drained box
+        self._prefix_serial = threading.Lock()
+        self._prefix_want = threading.Event()
+        self._prefix_done = threading.Event()
+        self._prefix_req: tuple | None = None
+        self._prefix_out: object = None
+        self._note_box: list[tuple] = []      # (tokens, tenant)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"{name}-decode-loop")
         self._thread.start()
@@ -147,6 +156,9 @@ class LMServingLoop:
             # in which case it was errored there)
             if self._stop.is_set() and self.gateway.cancel(rid) is not None:
                 raise ValueError("serving pool is stopped")
+        # tenant attribution for cluster prefix publishes (no-op when
+        # the cluster tier is off)
+        self.note_tenant(tokens, tenant)
         self._wake.set()
         return rid
 
@@ -232,6 +244,38 @@ class LMServingLoop:
             self._cancel_box.append(sid)
         self._wake.set()
         return True
+
+    def prefix_op(self, op: str, timeout: float = 30.0, **kw) -> dict:
+        """Run a cluster prefix-cache operation ("publish" | "probe" |
+        "fetch") on the LOOP thread — the DecodeServer's radix tree and
+        block pool are loop-thread-owned, so RPC handlers must marshal
+        (same request/response-event shape as `snapshot`). Raises the
+        op's error on this thread; ValueError on timeout."""
+        if self.server.cluster_prefix is None:
+            raise ValueError("pool has no cluster prefix cache "
+                             "(serve with cluster_prefix=)")
+        with self._prefix_serial:
+            self._prefix_done.clear()
+            self._prefix_req = (op, kw)
+            self._prefix_want.set()
+            self._wake.set()
+            if not self._prefix_done.wait(timeout):
+                self._prefix_want.clear()
+                self._prefix_req = None
+                raise ValueError(f"prefix_{op} timed out after "
+                                 f"{timeout}s")
+            out = self._prefix_out
+        if isinstance(out, Exception):
+            raise ValueError(f"prefix_{op}: {out}") from out
+        return out
+
+    def note_tenant(self, tokens: list[int], tenant: str) -> None:
+        """Record (prompt head → tenant) for publish attribution; the
+        loop thread drains the box into the cluster cache."""
+        if self.server.cluster_prefix is None:
+            return
+        with self._lock:
+            self._note_box.append((list(tokens), str(tenant)))
 
     def snapshot(self, timeout: float = 2.0) -> list[dict]:
         """Progress of every live row (public ids): prompt + tokens
@@ -349,6 +393,39 @@ class LMServingLoop:
         for sid in batch:
             self.server.cancel(sid)
 
+    def _fulfill_prefix(self) -> None:
+        if not self._prefix_want.is_set():
+            return
+        req = self._prefix_req
+        if req is None:                 # waiter timed out and withdrew
+            self._prefix_want.clear()
+            return
+        op, kw = req
+        try:
+            if op == "publish":
+                out: object = self.server.prefix_publish(**kw)
+            elif op == "probe":
+                out = self.server.prefix_probe(**kw)
+            elif op == "fetch":
+                out = self.server.prefix_warm(**kw)
+            else:
+                out = ValueError(f"unknown prefix op {op!r}")
+        except Exception as e:  # noqa: BLE001 - waiter must not hang
+            out = e
+        self._prefix_req = None
+        self._prefix_out = out
+        self._prefix_want.clear()
+        self._prefix_done.set()
+
+    def _drain_notes(self) -> None:
+        cp = self.server.cluster_prefix
+        if cp is None:
+            return
+        with self._lock:
+            batch, self._note_box = self._note_box, []
+        for tokens, tenant in batch:
+            cp.note(tokens, tenant)
+
     def _fulfill_snapshot(self) -> None:
         if not self._snap_want.is_set():
             return
@@ -377,6 +454,7 @@ class LMServingLoop:
         while not self._stop.is_set():
             try:
                 self._drain_cancels()
+                self._drain_notes()
                 self._drain_inbox()
                 self._drain_gateway()
                 live = self.server.step()
@@ -386,6 +464,7 @@ class LMServingLoop:
                     if len(self._errors) < 100:   # bounded between drains
                         self._errors.append(f"{type(e).__name__}: {e}")
                 live, done = 0, []
+            self._fulfill_prefix()
             self._fulfill_snapshot()
             if done:
                 with self._lock:

@@ -128,6 +128,59 @@ class RadixPrefixCache:
             node = child
         return chain
 
+    def graft(self, tokens: list[int],
+              fetched: list[tuple[list[int], Any]],
+              start_depth: int) -> int:
+        """Splice cluster-fetched raw blocks (`serve/cluster_prefix.py`)
+        into the tree: ``fetched`` holds (chunk, leaf arrays) pairs for
+        consecutive depths starting at ``start_depth`` of ``tokens``.
+        Chunks already present are REUSED, not reallocated — grafting is
+        naturally idempotent, a duplicated fetch converges on the same
+        tree (the `prefix_fetch` contract anchor). Best-effort like
+        `insert`: stops when the pool is exhausted even after eviction.
+        Returns the number of NEW blocks written; nothing is acquired —
+        the caller re-runs `lookup` to pin the extended chain."""
+        stamp = self._tick()
+        node = self._root
+        chunks = list(self._chunks(tokens))
+        # pin the whole walked path (like `insert`): the alloc loop's
+        # eviction must never free a node of the chain being extended
+        pinned: list[_Node] = []
+        try:
+            for j in range(start_depth):
+                node = node.children.get(chunks[j])
+                if node is None:
+                    raise ValueError(
+                        f"graft start_depth {start_depth} deeper than "
+                        f"the local chain (missing chunk {j})")
+                self.pool.incref(node.block)
+                pinned.append(node)
+            wrote = 0
+            for i, (chunk, arrays) in enumerate(fetched):
+                chunk = tuple(int(t) for t in chunk)
+                if chunk != chunks[start_depth + i]:
+                    raise ValueError("graft chunk does not match the "
+                                     "prompt prefix at its depth")
+                child = node.children.get(chunk)
+                if child is None:
+                    bid = self._alloc_block()
+                    if bid is None:
+                        self.insert_skips += 1
+                        break
+                    self.pool.write_raw_block(bid, arrays)
+                    child = _Node(chunk, bid, node, stamp)
+                    node.children[chunk] = child
+                    self.inserted_blocks += 1
+                    wrote += 1
+                child.stamp = stamp
+                self.pool.incref(child.block)
+                pinned.append(child)
+                node = child
+            return wrote
+        finally:
+            for nd in pinned:
+                self.pool.decref(nd.block)
+
     def _alloc_block(self) -> int | None:
         while True:
             bid = self.pool.alloc()

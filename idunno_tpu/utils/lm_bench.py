@@ -850,6 +850,179 @@ def run_lm_prefix_bench(platform: str, device_kind: str, n_devices: int,
     return out
 
 
+class _LocalRing:
+    """In-process stand-in for `FileStoreService`'s client surface with
+    the semantics the cluster prefix cache leans on (monotone versions
+    past tombstones, typed StoreError misses) plus byte counters. The
+    suite measures the PREFILL COMPUTE a remote chain saves a replica —
+    store transport cost is a cluster property the chaos/cluster tests
+    own, not this single-process bench."""
+
+    def __init__(self):
+        from idunno_tpu.store.sdfs import StoreError
+        self._miss = StoreError
+        self.blobs: dict[str, tuple[bytes, int]] = {}
+        self.tombs: dict[str, int] = {}
+        self.bytes_put = 0
+        self.bytes_got = 0
+
+    def put_bytes(self, name, blob):
+        v = max(self.blobs.get(name, (b"", 0))[1],
+                self.tombs.get(name, 0)) + 1
+        self.blobs[name] = (bytes(blob), v)
+        self.bytes_put += len(blob)
+        return v
+
+    def get_bytes(self, name, version=None):
+        if name not in self.blobs:
+            raise self._miss(f"{name}: not found")
+        blob, v = self.blobs[name]
+        self.bytes_got += len(blob)
+        return blob, v
+
+    def stat(self, name):
+        if name not in self.blobs:
+            raise self._miss(f"{name}: not found")
+        return self.blobs[name][1], ("local",)
+
+    def delete(self, name):
+        if name in self.blobs:
+            self.tombs[name] = self.blobs.pop(name)[1]
+
+
+def run_lm_cluster_prefix_bench(platform: str, device_kind: str,
+                                n_devices: int, peak_bf16: float | None,
+                                *, deadline: float,
+                                compact: bool = False) -> dict:
+    """BENCH_SUITE=lm_cluster_prefix: what a PUBLISHED KV chain buys a
+    replica that never served the prompt family (ISSUE 17). One
+    publisher pool serves the shared-prefix workload and publishes its
+    block chains content-addressed into the ring; then the first-request
+    TTFT of three fresh replicas is measured on the SAME family:
+    ``baseline`` (no cluster tier — full-bucket prefill), ``cold``
+    (cluster tier on — the admission probes the ring, fetches the chain
+    and prefills only the suffix) and ``warmed`` (``prefix_warm`` runs
+    first, as the autoscaler does at spawn, so the fetch is off the
+    request's critical path). Headline is the warmed replica's drain
+    throughput; ``suffix_prefill_fraction`` — the share of prompt
+    tokens the remote hit did NOT prefill — is the structural win."""
+    from idunno_tpu.engine.serve_lm import DecodeServer
+    from idunno_tpu.models.transformer import TransformerLM
+    from idunno_tpu.serve.cluster_prefix import ClusterPrefixCache
+
+    cfg = lm_bench_config(platform)
+    tpu = platform == "tpu"
+    block = _env_int("BENCH_LM_KV_BLOCK", 16 if tpu else 4)
+    out: dict = {"config": {k: v for k, v in cfg.items()},
+                 "platform": platform, "device_kind": device_kind,
+                 "n_devices": n_devices, "kv_block_size": block}
+    dt = jnp.bfloat16
+    model = TransformerLM(vocab=cfg["vocab"], dim=cfg["dim"],
+                          depth=cfg["depth"], num_heads=cfg["heads"],
+                          causal=True, dtype=dt, param_dtype=dt)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    n_params, _ = _count_params(params)
+    out["n_params"] = n_params
+
+    prompts, shared_len, buckets = prefix_bench_workload(cfg, block)
+    max_new = min(cfg["decode_steps"] + 1,
+                  cfg["max_len"] - cfg["prompt_len"])
+    out["workload"] = {"n_requests": len(prompts),
+                       "shared_prefix_len": shared_len,
+                       "prompt_len": cfg["prompt_len"],
+                       "prompt_buckets": list(buckets),
+                       "max_new": max_new}
+    ring = _LocalRing()
+    per_chain = -(-cfg["prompt_len"] // block)
+    pool_kw = dict(slots=cfg["slots"], prompt_len=cfg["prompt_len"],
+                   max_len=cfg["max_len"], decode_steps=cfg["decode_steps"],
+                   prompt_buckets=buckets, kv_block_size=block,
+                   kv_cache_blocks=(cfg["slots"] + 1) * per_chain)
+
+    def replica(cluster: bool, salt: int = 0) -> DecodeServer:
+        srv = DecodeServer(model, params, **pool_kw)
+        if cluster:
+            srv.cluster_prefix = ClusterPrefixCache(
+                ring, "bench-cluster", block, publish_min_hits=0)
+        # pay every compile the timed region will hit on a DISJOINT
+        # prompt family (per-replica salted, so it can't collide with
+        # the workload's shared head OR another replica's published
+        # warm-up chain): cold full-bucket path first, then the radix-
+        # hit tail path — a remote graft prefills through the same
+        # spliced computation a local hit does, so both measured paths
+        # are warm after this
+        warm = [(t + i + 7 * salt) % cfg["vocab"] or 1
+                for i, t in enumerate(prompts[0])]
+        for _ in range(2):
+            srv.submit(warm, max_new=2)
+            srv.run_until_drained()
+        return srv
+
+    def first_request(srv, p) -> dict:
+        s0 = srv.stats()
+        t0 = time.perf_counter()
+        srv.submit(p, max_new=1)
+        srv.run_until_drained()
+        ttft = time.perf_counter() - t0
+        s1 = srv.stats()
+        return {"ttft_s": round(ttft, 4),
+                "prefill_tokens": (s1["prefill_tokens"]
+                                   - s0["prefill_tokens"])}
+
+    # publisher: serving the family publishes its chains into the ring
+    pub = replica(cluster=True)
+    for p in prompts:
+        pub.cluster_prefix.note(p, "bench")
+        pub.submit(p, max_new=max_new)
+    pub.run_until_drained()
+    pcs = pub.prefix_cache_stats()
+    out["publisher"] = {
+        "published_chains": pcs["prefix_published_chains"],
+        "ring_blobs": len(ring.blobs),
+        "ring_bytes": ring.bytes_put}
+
+    # three fresh replicas, same first request from the published family
+    out["baseline"] = first_request(replica(cluster=False, salt=1),
+                                    prompts[1])
+    cold = replica(cluster=True, salt=2)
+    out["cold"] = first_request(cold, prompts[2])
+    out["cold"].update({k: v for k, v in cold.prefix_cache_stats().items()
+                        if k.startswith("prefix_")})
+    warmed = replica(cluster=True, salt=3)
+    t0 = time.perf_counter()
+    wres = warmed.prefix_warm(tenant="bench")
+    warm_s = time.perf_counter() - t0
+    out["warmed"] = first_request(warmed, prompts[3])
+    out["warmed"].update(
+        warm_s=round(warm_s, 4),
+        warm_blocks=int(wres.get("fetched_blocks", 0)))
+    # the structural win: prompt tokens the remote hit did NOT prefill
+    # on the replica's first request (block-truncated, never negative)
+    out["suffix_prefill_fraction"] = round(
+        1.0 - out["warmed"]["prefill_tokens"] / cfg["prompt_len"], 3)
+    out["cold_suffix_prefill_fraction"] = round(
+        1.0 - out["cold"]["prefill_tokens"] / cfg["prompt_len"], 3)
+
+    # headline: drain throughput of the warmed replica over the family
+    s0 = warmed.stats()
+    t0 = time.perf_counter()
+    for p in prompts:
+        warmed.submit(p, max_new=max_new)
+    warmed.run_until_drained()
+    drain_s = time.perf_counter() - t0
+    s1 = warmed.stats()
+    gen = s1["tokens_generated"] - s0["tokens_generated"]
+    out["warmed"].update(
+        tokens_per_s=round(gen / drain_s, 1),
+        drain_s=round(drain_s, 3), tokens_generated=gen)
+    out["warmed"].update(
+        {k: v for k, v in warmed.prefix_cache_stats().items()
+         if k.startswith("prefix_")})
+    out["ring_bytes_fetched"] = ring.bytes_got
+    return out
+
+
 def lm_paged_grid(platform: str) -> list[tuple[int, int]]:
     """(slots, context) points for BENCH_SUITE=lm_paged. TPU measures the
     serving-relevant 16/32 slots x 1k/4k contexts; CPU proves the

@@ -452,3 +452,86 @@ def test_invariant_trip_snapshots_span_dump(tmp_path):
     assert any(s["name"] == "client.lm_submit" for s in dump["n3"])
     assert any(s["name"] == "lm.submit"
                and s["trace_id"] == root.trace_id for s in dump["n4"])
+
+def test_cluster_prefix_seeded_schedule_invariants(tmp_path):
+    """The full seeded fault surface with the cluster prefix cache on
+    (ISSUE 17): the shared-head workload publishes real KVC1 blobs to
+    the real SDFS ring and the fake tier's inline content checks
+    (wrong-token graft, double-prefill) feed the violations ledger.
+    Remote hits here depend on WHEN the schedule re-places the pool
+    relative to the last shared-head submission (recovery paces on the
+    watchdog, which runs in real time during converge) — the directed
+    test below proves the remote hit deterministically, so this one
+    asserts only the published chain and a clean ledger."""
+    out = run_seeded_schedule(11, str(tmp_path), steps=40,
+                              cluster_prefix=True)
+    assert out["lmp_acked"] >= 1
+    assert out["prefix_published"] >= 3      # the 3-block shared head
+
+
+def test_cluster_prefix_survives_serving_node_death(tmp_path):
+    """ISSUE 17 directed schedule: publish the shared head, kill the
+    serving node (its radix tree dies with it), and prove the re-placed
+    pool re-derives the chain from the ring — probe shows local 0 /
+    remote 3, a submission-or-warm under drop chaos fetches without ever
+    grafting a wrong token or double-prefilling (inline content checks
+    land in c.violations), and the clean-net warm completes the head."""
+    c = ChaosCluster(828, str(tmp_path), cluster_prefix=True)
+    c.pump_work()        # replication cycle: the pool spec rides the WAL
+    for client in ("n1", "n2"):
+        c.op_lm_prefix(client)
+        c.pump_membership(waves=1)
+        c.pump_work()
+        c.record_fences()
+    # the head's 3 blocks are published AND locally cached on the node
+    probe = c._client_control("n3", {
+        "verb": "prefix_probe", "name": c.LM_POOL,
+        "tokens": list(c.PREFIX_HEAD)})
+    assert probe["remote_blocks"] == 3
+    assert probe["local_blocks"] == 3
+    owner0 = c._pool_owner(c.LM_POOL)
+    with c.managers[owner0]._lock:
+        node0 = c.managers[owner0]._pools[c.LM_POOL]["node"]
+    # kill the serving node: peer-detected death + scope adoption +
+    # recovery lm_serve need ~15 pump rounds
+    c.op_isolate(node0)
+    for _ in range(15):
+        c.pump_membership(waves=1)
+        c.pump_work()
+        c.record_fences()
+    owner1 = c._pool_owner(c.LM_POOL)
+    with c.managers[owner1]._lock:
+        node1 = c.managers[owner1]._pools[c.LM_POOL]["node"]
+    assert node1 != node0, "pool never re-placed off the dead node"
+    # the rebuilt node's radix tree is EMPTY; the ring still has the head
+    probe = c._client_control("n3", {
+        "verb": "prefix_probe", "name": c.LM_POOL,
+        "tokens": list(c.PREFIX_HEAD)})
+    assert probe["local_blocks"] == 0, "tree should have died with node0"
+    assert probe["remote_blocks"] == 3, "published chain lost from ring"
+    # death-mid-fetch shape: drop chaos on every link while the fresh
+    # node fetches — a partial fetch must degrade (shorter hit, more
+    # prefill), NEVER corrupt; inline checks would append violations
+    c.net.set_chaos(drop=0.25, seed=99)
+    c.op_lm_prefix("n3")
+    c.pump_membership(waves=1)
+    c.pump_work()
+    c.record_fences()
+    c.net.clear_chaos()
+    c.net.flush_held()
+    # clean-net warm from the tenant's published set completes the head
+    c._client_control("n3", {"verb": "prefix_fetch",
+                             "name": c.LM_POOL, "tenant": "default"})
+    probe = c._client_control("n3", {
+        "verb": "prefix_probe", "name": c.LM_POOL,
+        "tokens": list(c.PREFIX_HEAD)})
+    assert probe["local_blocks"] == 3, "warm did not complete the head"
+    c.converge()
+    summary = c.check_invariants()
+    assert not c.violations
+    # the head reached the rebuilt node from the RING, one way or the
+    # other: an admission remote hit (counted per admission) and/or warm
+    # blocks — the local==3 probe above already proved it arrived
+    assert (summary.get("prefix_remote_hits", 0) >= 1
+            or summary.get("prefix_warmed", 0) >= 1)
+    assert summary["lmp_acked"] >= 2

@@ -10,6 +10,7 @@ import pytest
 
 from idunno_tpu.utils.lm_bench import (lm_bench_config,
                                         prefix_bench_workload, run_lm_bench,
+                                        run_lm_cluster_prefix_bench,
                                         run_lm_prefix_bench, spec_max_new,
                                         spec_rounds)
 
@@ -137,6 +138,38 @@ def test_prefix_suite_record_shape_and_saves_prefill(tiny_env):
     pc = on["prefix_cache"]
     assert pc["prefix_hit_rate"] > 0 and pc["cached_tokens_saved"] > 0
     assert "prefix_cache" not in off
+
+
+def test_cluster_prefix_suite_record_shape(tiny_env):
+    """BENCH_SUITE=lm_cluster_prefix (`run_lm_cluster_prefix_bench`): the
+    warmed replica's first request must structurally prefill ONLY the
+    unpublished suffix (the acceptance bar for warm-at-spawn: positive
+    suffix fraction, warm blocks actually fetched, remote hit counted on
+    the cold replica) — not just emit TTFT numbers."""
+    rec = run_lm_cluster_prefix_bench("cpu", "cpu", 1, None,
+                                      deadline=time.perf_counter() + 600,
+                                      compact=False)
+    for k in ("config", "kv_block_size", "workload", "publisher",
+              "baseline", "cold", "warmed"):
+        assert k in rec, f"missing {k}"
+    assert rec["publisher"]["published_chains"] > 0
+    assert rec["publisher"]["ring_blobs"] > 0
+    # cold replica: the admission itself probed + fetched the chain
+    assert rec["cold"]["prefix_remote_hits"] >= 1
+    assert rec["cold"]["prefix_fetch_bytes"] > 0
+    assert rec["cold"]["prefill_tokens"] \
+        < rec["baseline"]["prefill_tokens"]
+    # warmed replica: blocks arrived BEFORE the first request, which
+    # then prefills only the suffix without a remote round-trip
+    assert rec["warmed"]["warm_blocks"] > 0
+    assert rec["warmed"]["prefix_remote_hits"] == 0
+    assert rec["warmed"]["prefill_tokens"] \
+        < rec["baseline"]["prefill_tokens"]
+    assert rec["suffix_prefill_fraction"] > 0
+    assert rec["cold_suffix_prefill_fraction"] > 0
+    assert rec["warmed"]["tokens_per_s"] > 0
+    assert rec["warmed"]["ttft_s"] > 0 and rec["baseline"]["ttft_s"] > 0
+    assert rec["ring_bytes_fetched"] > 0
 
 
 def test_prefix_workload_shape(tiny_env):

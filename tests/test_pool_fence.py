@@ -138,6 +138,122 @@ def test_manager_fences_one_scope_only(tmp_path):
     assert c.members["n0"].is_acting_master
 
 
+def test_truncate_wire_compacts_delivered_prefix():
+    """Unit contract of the shipped-segment truncation (ISSUE 17
+    satellite): only the contiguous rid prefix whose rows are ALL
+    journal-terminal and delivered drops — with its idem keys — and the
+    input entry is never mutated."""
+    from idunno_tpu.serve.lm_manager import LMPoolManager
+    entry = {"next_rid": 5, "wal_seq": 9,
+             "idem": {"c:1": 1, "c:2": 2, "c:3": 3, "c:4": 4},
+             "requests": {
+                 "1": {"status": "done", "delivered": True},
+                 "2": {"status": "failed", "delivered": True},
+                 "3": {"status": "pending", "delivered": False},
+                 "4": {"status": "done", "delivered": True}}}
+    out, ncut = LMPoolManager._truncate_wire(entry)
+    assert ncut == 2
+    # rid 4 is delivered but sits ABOVE the live rid 3: it stays, so the
+    # segment remains a contiguous journal tail
+    assert sorted(out["requests"]) == ["3", "4"]
+    assert sorted(out["idem"].values()) == [3, 4]
+    assert out["next_rid"] == 5 and out["wal_seq"] == 9
+    assert sorted(entry["requests"]) == ["1", "2", "3", "4"]  # untouched
+    assert sorted(entry["idem"].values()) == [1, 2, 3, 4]
+    # a terminal-but-undelivered row still has recovery value (an adopter
+    # must not re-decode it, and owes the client its delivery): no cut,
+    # and the same object comes back
+    e2 = {"next_rid": 3, "idem": {},
+          "requests": {"1": {"status": "done", "delivered": False},
+                       "2": {"status": "done", "delivered": True}}}
+    same, n2 = LMPoolManager._truncate_wire(e2)
+    assert n2 == 0 and same is e2
+    # an all-delivered journal compacts to empty with the low-water mark
+    # at next_rid — the rid counter itself always survives
+    e3 = {"next_rid": 3, "idem": {"k": 2},
+          "requests": {"1": {"status": "done", "delivered": True},
+                       "2": {"status": "cancelled", "delivered": True}}}
+    out3, n3 = LMPoolManager._truncate_wire(e3)
+    assert n3 == 2 and out3["requests"] == {} and out3["idem"] == {}
+    assert out3["next_rid"] == 3
+
+
+def test_pool_wal_segment_truncates_below_delivered_lwm(tmp_path):
+    """End-to-end regression for the delivered low-water-mark truncation:
+    once a journal row is terminal AND delivered, the next shipped WAL
+    segment drops it (and its idem key) while the live journal keeps it
+    until poll's deferred prune — and a standby that lost its base still
+    recovers via the need_full full-entry fallback, now truncated too."""
+    c = ChaosCluster(43, str(tmp_path))
+    out1 = c._client_control("n2", {"verb": "lm_submit", "name": c.LM_POOL,
+                                    "prompt": [1, 2, 3], "max_new": 4,
+                                    "seed": 1}, idem="n2:t1")
+    rid1 = int(out1["id"])
+    # ownership claims may not have gossiped yet this early: find the
+    # journal holder directly
+    owner = next(h for h, m in c.managers.items()
+                 if m.has_pool(c.LM_POOL))
+    mgr = c.managers[owner]
+    for _ in range(20):
+        c.pump_work()
+        with mgr._lock:
+            if mgr._pools[c.LM_POOL]["requests"][rid1]["status"] == "done":
+                break
+    # first poll delivers (pruning is deferred to the NEXT poll)
+    polled = c._client_control("n2", {"verb": "lm_poll",
+                                      "name": c.LM_POOL})
+    assert any(int(q["id"]) == rid1 for q in polled["completions"])
+    before = mgr.wal_truncated
+
+    def standby_entry():
+        ent = None
+        for fo in c.failovers.values():
+            w = fo._pool_wal.get(c.LM_POOL)
+            if w and (ent is None
+                      or int(w["entry"]["wal_seq"])
+                      > int(ent["wal_seq"])):
+                ent = w["entry"]
+        assert ent is not None
+        return ent
+
+    # the next mutation ships a segment truncated below the LWM: the
+    # delivered row and its idem key are gone from the standby's copy...
+    out2 = c._client_control("n2", {"verb": "lm_submit", "name": c.LM_POOL,
+                                    "prompt": [4, 5, 6], "max_new": 4,
+                                    "seed": 2}, idem="n2:t2")
+    rid2 = int(out2["id"])
+    entry = standby_entry()
+    assert str(rid1) not in entry["requests"]
+    assert str(rid2) in entry["requests"]
+    assert "n2:t1" not in entry.get("idem", {})
+    assert entry["idem"]["n2:t2"] == rid2
+    assert int(entry["next_rid"]) > rid1        # counter never truncates
+    assert mgr.wal_truncated > before
+    # ...while the owner's LIVE journal still holds the delivered row
+    # until the next poll prunes it
+    with mgr._lock:
+        assert rid1 in mgr._pools[c.LM_POOL]["requests"]
+    # need_full stays correct across the truncated base: wipe the
+    # standby's held segment so the owner's next delta frame has no base
+    # to merge into — the NACK makes it re-ship the (truncated) full entry
+    for fo in c.failovers.values():
+        fo._pool_wal.pop(c.LM_POOL, None)
+    c._client_control("n2", {"verb": "lm_submit", "name": c.LM_POOL,
+                             "prompt": [7, 8, 9], "max_new": 4,
+                             "seed": 3}, idem="n2:t3")
+    entry = standby_entry()
+    assert str(rid1) not in entry["requests"]
+    assert entry["idem"]["n2:t3"] in [int(r) for r in entry["requests"]]
+    # the truncated entry adopts cleanly on a fresh manager (newest-wins)
+    dst = next(m for h, m in c.managers.items() if h != owner)
+    assert dst.apply_pool_wal(
+        {c.LM_POOL: {"entry": dict(entry,
+                                   wal_seq=int(entry["wal_seq"]) + 50)}}) == 1
+    with dst._lock:
+        assert rid1 not in dst._pools[c.LM_POOL]["requests"]
+        assert dst._pools[c.LM_POOL]["next_rid"] == int(entry["next_rid"])
+
+
 def test_pool_wal_mirrors_and_applies_by_seq(tmp_path):
     """The per-pool WAL write-ahead lands on the standby with the pool's
     wal_seq high-water; apply keeps the newest entry and ignores stale

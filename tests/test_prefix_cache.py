@@ -575,3 +575,320 @@ def test_paged_tp_hit_depths_token_exact(lm, eight_devices):
         assert sampled == ref_sampled, \
             f"TP paged sampled stream forked at hit depth {hit}"
     assert srv.prefix_cache_stats()["hits"] >= 3
+
+# -- cluster-wide prefix cache over the SDFS ring (ISSUE 17) ----------------
+
+from idunno_tpu.serve.cluster_prefix import ClusterPrefixCache  # noqa: E402
+from idunno_tpu.store.kv_chain import (  # noqa: E402
+    MAGIC, chain_names, decode_block, encode_block)
+from idunno_tpu.store.sdfs import StoreError  # noqa: E402
+
+
+class FakeRing:
+    """In-memory stand-in for `FileStoreService`'s client surface with
+    the two semantics the subsystem leans on: monotone versions that
+    bump PAST a tombstone on republish, and typed StoreError misses."""
+
+    def __init__(self):
+        self.blobs: dict[str, tuple[bytes, int]] = {}
+        self.tombs: dict[str, int] = {}
+
+    def put_bytes(self, name, blob):
+        v = max(self.blobs.get(name, (b"", 0))[1],
+                self.tombs.get(name, 0)) + 1
+        self.blobs[name] = (bytes(blob), v)
+        return v
+
+    def get_bytes(self, name, version=None):
+        if name not in self.blobs:
+            raise StoreError(f"{name}: not found")
+        return self.blobs[name]
+
+    def stat(self, name):
+        if name not in self.blobs:
+            raise StoreError(f"{name}: not found")
+        return self.blobs[name][1], ("n0",)
+
+    def delete(self, name):
+        if name in self.blobs:
+            self.tombs[name] = self.blobs.pop(name)[1]
+
+
+def cluster_pair(model, params, ring, ns="ns-test", **kw):
+    """Publisher + cold consumer sharing one ring and namespace — the
+    two-replica shape every cluster test reduces to. The cluster cache
+    is attached the way `serve/control.py` attaches it post-warmup."""
+    spec = dict(slots=2, prompt_len=8, max_len=24, kv_block_size=BS,
+                kv_cache_blocks=16)
+    spec.update(kw)
+    out = []
+    for _ in range(2):
+        srv = DecodeServer(model, params, **spec)
+        srv.cluster_prefix = ClusterPrefixCache(ring, ns, BS,
+                                                publish_min_hits=0)
+        out.append(srv)
+    return out
+
+
+def test_kv_chain_codec_roundtrip():
+    arrays = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+              "b": np.asarray([[1, -2]], np.int8),
+              "c": np.asarray(jnp.ones((2, 2), jnp.bfloat16))}
+    meta = {"tokens": [5, 7], "depth": 0, "namespace": "ns",
+            "block_size": 2}
+    blob = encode_block(meta, arrays)
+    assert blob[:4] == MAGIC
+    got_meta, got = decode_block(blob, expect_tokens=[5, 7])
+    assert got_meta["depth"] == 0
+    for k, arr in arrays.items():
+        np.testing.assert_array_equal(got[k], np.asarray(arr))
+        assert got[k].dtype == np.asarray(arr).dtype, k
+    # the correctness guard: embedded tokens must match the expected
+    # chunk, and a non-KVC1 payload is refused outright
+    with pytest.raises(ValueError, match="token mismatch"):
+        decode_block(blob, expect_tokens=[5, 8])
+    with pytest.raises(ValueError, match="magic"):
+        decode_block(b"XXXX" + blob[4:])
+    # bit-stable encoding: identical content → identical bytes
+    assert encode_block(meta, arrays) == blob
+
+
+def test_chain_names_prefix_and_namespace_properties():
+    names = chain_names("ns", [1, 2, 3, 4], 2)
+    assert len(names) == 2
+    # depth-j name commits to chunks 0..j: extending the prompt keeps
+    # the shallower names (the dedupe property), the partial tail token
+    # contributes nothing
+    assert chain_names("ns", [1, 2, 3, 4, 9], 2) == names
+    assert chain_names("ns", [1, 2, 3, 4, 5, 6], 2)[:2] == names
+    # different namespace or different head → fully disjoint names
+    assert not set(chain_names("other", [1, 2, 3, 4], 2)) & set(names)
+    assert chain_names("ns", [9, 2, 3, 4], 2)[1] != names[1]
+
+
+def test_graft_contract(lm):
+    """`RadixPrefixCache.graft`: inserts fetched blocks contiguously at
+    start_depth, reuses chunks already present (idempotent replays),
+    and refuses both a missing walk chunk and a chunk/prompt mismatch
+    (the double-prefill guards)."""
+    model, params = lm
+    cache = row_cache_for(model, params, [1, 2, 3, 4])
+    src = KVBlockPool(model, num_blocks=2, block_size=BS)
+    bids = [src.alloc(), src.alloc()]
+    for j, bid in enumerate(bids):
+        src.write_block(bid, cache, j * BS)
+    fetched = [([1, 2], src.read_block(bids[0])),
+               ([3, 4], src.read_block(bids[1]))]
+    pool = KVBlockPool(model, num_blocks=4, block_size=BS)
+    tree = RadixPrefixCache(pool)
+    assert tree.graft([1, 2, 3, 4], fetched, 0) == 2
+    hit = tree.lookup([1, 2, 3, 4])
+    assert [nd.chunk for nd in hit] == [(1, 2), (3, 4)]
+    # the grafted KV is byte-identical to the source pool's blocks
+    got = kv_leaves(pool.gather([nd.block for nd in hit]))
+    src_leaves = kv_leaves(cache)
+    for key, leaf in got.items():
+        np.testing.assert_array_equal(
+            np.asarray(leaf), np.asarray(src_leaves[key][:, :2 * BS]))
+    # graft leaves the chain UNPINNED (refcount 0): the admission path
+    # re-runs lookup and acquires it itself
+    assert all(pool.refcount(nd.block) == 0 for nd in hit)
+    assert tree.graft([1, 2, 3, 4], fetched, 0) == 0, \
+        "re-graft of present chunks must reuse, not duplicate"
+    with pytest.raises(ValueError, match="missing"):
+        tree.graft([9, 9, 3, 4], fetched[1:], 1)
+    with pytest.raises(ValueError, match="does not match"):
+        tree.graft([1, 2, 9, 9], fetched[1:], 1)
+
+
+@pytest.mark.parametrize("kernel", [None, "xla", "pallas"])
+@pytest.mark.parametrize("kind", ["mha", "gqa"])
+def test_cluster_remote_hit_token_exact(lm, kind, kernel):
+    """The tentpole exactness matrix: a cold consumer replica extends
+    its (empty or shorter) local hit with the publisher's ring chain at
+    EVERY hit depth, staying token-exact vs `generate` — for MHA and
+    GQA pools, gathered and both paged kernels."""
+    if kind == "gqa":
+        model = TransformerLM(vocab=VOCAB, dim=32, depth=2, num_heads=4,
+                              num_kv_heads=2)
+        params = model.init(jax.random.PRNGKey(1),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+    else:
+        model, params = lm
+    ring = FakeRing()
+    kw = {"paged_kernel": kernel} if kernel else {}
+    pub, sub = cluster_pair(model, params, ring, **kw)
+    prompts = hit_depth_prompts(np.random.default_rng(3))
+    for prompt, _ in prompts:        # publisher inserts + publishes
+        rid = pub.submit(prompt, max_new=6)
+        done = {c.id: c for c in pub.run_until_drained()}
+        assert done[rid].tokens == expected(model, params, prompt, 6)
+    assert pub.cluster_prefix.published_blocks >= 4
+    # consumer drives the same depths: prompt 0 is local-NONE (whole
+    # chain from the ring), prompt 1 is local-SHORTER (2 local blocks,
+    # ring extends to 3), prompts 2-3 are full local hits
+    for i, (prompt, hit) in enumerate(prompts):
+        rid = sub.submit(prompt, max_new=6)
+        done = {c.id: c for c in sub.run_until_drained()}
+        assert done[rid].tokens == expected(model, params, prompt, 6), \
+            f"{kind}/{kernel}: remote hit diverged at matrix row {i} " \
+            f"(expected local hit depth {hit})"
+    st = sub.prefix_cache_stats()
+    assert st["prefix_remote_hits"] == 2, \
+        "rows 0 (local-none) and 1 (local-shorter) must remote-hit"
+    assert st["prefix_fetch_bytes"] > 0
+    assert st["hits"] >= 3
+
+
+def test_cluster_tp_remote_hit_token_exact(lm, eight_devices):
+    """The matrix's n_model=2 column: the consumer's block stores shard
+    KV heads over the model axis, and grafted ring blocks must land
+    sharded AND token-exact at every depth."""
+    model, params = lm
+    ring = FakeRing()
+    pub, sub = cluster_pair(model, params, ring, paged_kernel="xla",
+                            n_model=2)
+    assert sub.n_model == 2
+    prompts = hit_depth_prompts(np.random.default_rng(3))
+    for prompt, _ in prompts:
+        rid = pub.submit(prompt, max_new=6)
+        done = {c.id: c for c in pub.run_until_drained()}
+        assert done[rid].tokens == expected(model, params, prompt, 6)
+    for i, (prompt, hit) in enumerate(prompts):
+        rid = sub.submit(prompt, max_new=6)
+        done = {c.id: c for c in sub.run_until_drained()}
+        assert done[rid].tokens == expected(model, params, prompt, 6), \
+            f"TP remote hit diverged at matrix row {i} (local {hit})"
+    assert sub.prefix_cache_stats()["prefix_remote_hits"] == 2
+
+
+def test_cluster_int8_static_prefix_remote_hit(lm):
+    """int8 caches add per-block k_scale/v_scale leaves to every blob,
+    and a pool-level static prefix shifts chains to absolute positions
+    AFTER it — both must survive the encode/ship/graft trip."""
+    model = TransformerLM(vocab=VOCAB, dim=32, depth=2, num_heads=4,
+                          kv_cache_dtype="int8")
+    params = model.init(jax.random.PRNGKey(2),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    ring = FakeRing()
+    pub, sub = cluster_pair(model, params, ring, prefix=[20, 21, 22],
+                            max_len=32)
+    prompts = hit_depth_prompts(np.random.default_rng(5))
+    for prompt, _ in prompts:
+        rid = pub.submit(prompt, max_new=5)
+        done = {c.id: c for c in pub.run_until_drained()}
+        assert done[rid].tokens == expected(model, params,
+                                            [20, 21, 22] + prompt, 5)
+    for i, (prompt, _) in enumerate(prompts):
+        rid = sub.submit(prompt, max_new=5)
+        done = {c.id: c for c in sub.run_until_drained()}
+        assert done[rid].tokens == expected(model, params,
+                                            [20, 21, 22] + prompt, 5), \
+            f"int8+prefix remote hit diverged at matrix row {i}"
+    assert sub.prefix_cache_stats()["prefix_remote_hits"] == 2
+
+
+def test_cluster_remote_hit_prefills_only_suffix(lm):
+    """The acceptance claim, structurally: a remote hit moves the
+    consumer's prefill into a SMALLER prompt bucket — only the suffix
+    is recomputed (visible in `prefill_tokens`, same oracle as
+    `test_prompt_bucket_shrinks_after_hit`)."""
+    model, params = lm
+    ring = FakeRing()
+    pub, sub = cluster_pair(model, params, ring,
+                            prompt_buckets=(2, 4, 8))
+    p = [4, 9, 14, 19, 24, 29, 34, 39]
+    pub.submit(p, max_new=2)
+    pub.run_until_drained()
+    rid = sub.submit(p, max_new=2)
+    done = {c.id: c for c in sub.run_until_drained()}
+    assert done[rid].tokens == expected(model, params, p, 2)
+    assert sub.stats()["prefill_tokens"] == 2, \
+        "remote 6-token hit must drop the cold 8-bucket to the 2-bucket"
+    assert sub.prefix_cache_stats()["prefix_remote_hits"] == 1
+
+
+def test_cluster_warm_then_first_request_suffix_only(lm):
+    """Warm-at-spawn: `prefix_warm(tenant=...)` pulls the tenant's
+    published set off the warm index into a FRESH replica, whose very
+    first request then prefills only the suffix."""
+    model, params = lm
+    ring = FakeRing()
+    pub, sub = cluster_pair(model, params, ring,
+                            prompt_buckets=(2, 4, 8))
+    p = [4, 9, 14, 19, 24, 29, 34, 39]
+    pub.cluster_prefix.note(p, "acme")     # serve/lm_pool.py notes at submit
+    pub.submit(p, max_new=2)
+    pub.run_until_drained()
+    out = sub.prefix_warm(tenant="acme")
+    assert out["fetched_blocks"] == 4, \
+        "warm must pull the tenant's whole published chain"
+    st = sub.prefix_cache_stats()
+    assert st["prefix_warm_blocks"] == 4
+    assert st["prefix_remote_hits"] == 0, "warm is not an admission hit"
+    rid = sub.submit(p, max_new=2)
+    done = {c.id: c for c in sub.run_until_drained()}
+    assert done[rid].tokens == expected(model, params, p, 2)
+    assert sub.stats()["prefill_tokens"] == 2, \
+        "warmed replica's FIRST request must prefill only the suffix"
+    # probe surfaces both views
+    probe = sub.prefix_probe(p)
+    assert probe["remote_blocks"] == 4 and probe["local_blocks"] >= 3
+
+
+def test_cluster_evict_tombstone_and_force_republish(lm):
+    """Eviction is an SDFS tombstone; a FORCED republish (the explicit
+    `prefix_publish` verb) bumps versions past it even though the
+    publisher's own memo cannot see another pool's eviction, and a
+    fresh consumer remote-hits the republished chain token-exactly."""
+    model, params = lm
+    ring = FakeRing()
+    pub, sub = cluster_pair(model, params, ring)
+    p = [4, 9, 14, 19, 24, 29, 34, 39]
+    pub.submit(p, max_new=2)
+    pub.run_until_drained()
+    names = pub.cluster_prefix.names(p)
+    v0 = {n: ring.stat(n)[0] for n in names}
+    # another pool evicts the chain cluster-wide
+    evictor = ClusterPrefixCache(ring, "ns-test", BS)
+    assert evictor.evict(p) == 4
+    for n in names:
+        with pytest.raises(StoreError):
+            ring.stat(n)
+    fresh = ClusterPrefixCache(ring, "ns-test", BS)
+    assert fresh.probe(p) == 0, "tombstoned chain must probe as a miss"
+    # the publisher still holds the chain locally: the explicit verb
+    # republishes (force bypasses only the MEMO, not the ring stat)
+    out = pub.prefix_publish(tokens=p)
+    assert out["published_blocks"] == 4
+    for n in names:
+        assert ring.stat(n)[0] > v0[n], "republish must outrank tombstone"
+    rid = sub.submit(p, max_new=2)
+    done = {c.id: c for c in sub.run_until_drained()}
+    assert done[rid].tokens == expected(model, params, p, 2)
+    assert sub.prefix_cache_stats()["prefix_remote_hits"] == 1
+
+
+def test_cluster_miss_degrades_never_fails(lm):
+    """Failure policy: a ring that errors on every call must degrade
+    every admission to its local hit — exact tokens, errors counted,
+    serving never raises."""
+
+    class BrokenRing:
+        def put_bytes(self, *a):
+            raise OSError("ring down")
+        get_bytes = stat = delete = put_bytes
+
+    model, params = lm
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24,
+                       kv_block_size=BS, kv_cache_blocks=16)
+    srv.cluster_prefix = ClusterPrefixCache(BrokenRing(), "ns", BS,
+                                            publish_min_hits=0)
+    for prompt, _ in hit_depth_prompts(np.random.default_rng(3)):
+        rid = srv.submit(prompt, max_new=6)
+        done = {c.id: c for c in srv.run_until_drained()}
+        assert done[rid].tokens == expected(model, params, prompt, 6)
+    st = srv.prefix_cache_stats()
+    assert st["prefix_remote_hits"] == 0
+    assert srv.cluster_prefix.errors > 0
+    assert st["hits"] >= 3, "local radix hits must be untouched"

@@ -404,7 +404,11 @@ def test_two_node_cluster_collects_lm_trace(tmp_path):
                             jnp.zeros((1, 8), jnp.int32))["params"]
         save_lm(nodes["n0"].store, "tlm", model, params)
         _call(nodes["n0"], {"verb": "lm_serve", "name": "tlm", "slots": 2,
-                            "prompt_len": 4, "max_len": 16})
+                            "prompt_len": 4, "max_len": 16,
+                            # block pool on: the prefix-cache gauge set
+                            # (incl. the ISSUE 17 cluster counters) joins
+                            # the scrape below
+                            "kv_block_size": 2})
 
         root = nodes["n1"].spans.start("client.lm_submit",
                                        attrs={"pool": "tlm"})
@@ -492,6 +496,15 @@ def test_two_node_cluster_collects_lm_trace(tmp_path):
         assert 'idunno_gauge{node="n0",name="pool_wal_bytes"}' in text
         assert 'name="scope_owner_redirects"' in text
         assert 'name="scope_owner_moves"' in text
+        # ISSUE 17: the cluster prefix-cache gauges ride the lm_stats
+        # gauge plane (zero-valued while the cluster tier is off, but
+        # always named on a kv_block_size pool)...
+        for g in ("prefix_remote_hits", "prefix_published_chains",
+                  "prefix_warm_blocks", "prefix_fetch_bytes"):
+            assert f'name="{g}"' in text, g
+        # ...and the shipped-WAL compaction counter scrapes
+        # unconditionally beside the ISSUE 15 byte gauge
+        assert 'idunno_gauge{node="n0",name="pool_wal_truncated"}' in text
         remote = _call(nodes["n0"], {"verb": "metrics_export",
                                      "host": "n1"})["text"]
         assert 'node="n1"' in remote

@@ -69,6 +69,14 @@ STEPS = [
      {"BENCH_SUITE": "lm_prefix", "BENCH_TIME_BUDGET_S": "600"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_lm_prefix.json"),
+    # ISSUE 17: cluster-wide prefix cache — first-request TTFT of a
+    # baseline vs cold-cluster vs warm-at-spawn replica over published
+    # KV chains; the suffix-only prefill fraction has only been measured
+    # on the CPU mesh
+    ("cluster_prefix_suite",
+     {"BENCH_SUITE": "lm_cluster_prefix", "BENCH_TIME_BUDGET_S": "600"},
+     [sys.executable, "bench.py"],
+     "BENCH_LAST_GOOD_lm_cluster_prefix.json"),
     # ISSUE 7: paged decode through the block table vs the gathered
     # baseline at serving contexts — the serving-level half of the
     # earn-it evidence (the kernel-level grid rides in flash_sweep)

@@ -46,9 +46,14 @@ def main() -> int:
     # certified at 50-100 hosts with `--hosts 50`; default stays 5 so
     # the fast soak keeps its historical runtime
     ap.add_argument("--hosts", type=int, default=5)
-    # second concurrent managed pool from schedule 2 on (0 disables):
+    # cluster prefix cache for schedule 2 (0 disables): the shared-head
+    # workload publishes real KVC1 blobs to the real SDFS ring, with
+    # inline wrong-token / double-prefill checks on every fetch
+    # (ISSUE 17; single-feature seed, replayable in isolation)
+    ap.add_argument("--cluster-prefix", type=int, default=1)
+    # second concurrent managed pool from schedule 3 on (0 disables):
     # per-pool fence scopes + cross-pool isolation under the fault
-    # surface (schedules 0/1 keep their single-feature seeds replayable)
+    # surface (schedules 0-2 keep their single-feature seeds replayable)
     ap.add_argument("--multi-pool", type=int, default=1)
     # lint preflight on by default: a wall-clock/rng draw in a chaos-
     # reachable module makes every printed seed unreplayable, so soaking
@@ -82,7 +87,9 @@ def main() -> int:
     owner_moves_total = 0
     scope_owners: dict[str, str] = {}
     work = {"cnn_acked": 0, "lm_acked": 0, "lmb_acked": 0,
-            "sdfs_acked": 0, "spans_recorded": 0}
+            "lmp_acked": 0, "sdfs_acked": 0, "spans_recorded": 0,
+            "prefix_remote_hits": 0, "prefix_published": 0,
+            "prefix_warmed": 0}
     for i in range(args.schedules):
         seed = args.seed0 + i
         try:
@@ -101,9 +108,13 @@ def main() -> int:
                     # (ISSUE 11) — separate from schedule 0 so each
                     # feature's faults replay in isolation by seed
                     autoscale=bool(args.autoscale) and i == 1,
-                    # schedules 2+ run TWO concurrent managed pools
+                    # third schedule runs the cluster prefix cache
+                    # (ISSUE 17): ring-published KV chains fetched back
+                    # under the fault surface, content-checked inline
+                    cluster_prefix=bool(args.cluster_prefix) and i == 2,
+                    # schedules 3+ run TWO concurrent managed pools
                     # (ISSUE 14): per-pool fences + cross-pool isolation
-                    multi_pool=bool(args.multi_pool) and i >= 2,
+                    multi_pool=bool(args.multi_pool) and i >= 3,
                     n_hosts=args.hosts)
         except Exception as e:  # noqa: BLE001 - invariant trip is data
             rec = {"seed": seed, "error":
