@@ -44,11 +44,11 @@ REFERENCE_IMAGES_PER_S = 400 / 9.0   # ≈44.4, whole reference cluster
 BENCH_SUITE = os.environ.get("BENCH_SUITE", "cnn")
 if BENCH_SUITE not in ("cnn", "lm", "lm_prefix", "lm_cluster_prefix",
                        "lm_slots", "lm_paged", "lm_tp", "lm_gateway",
-                       "lm_autoscale", "train"):
+                       "lm_autoscale", "lm_distserve", "train"):
     raise SystemExit(
         f"BENCH_SUITE={BENCH_SUITE!r}: want "
         "cnn|lm|lm_prefix|lm_cluster_prefix|lm_slots|lm_paged|lm_tp|"
-        "lm_gateway|lm_autoscale|train")
+        "lm_gateway|lm_autoscale|lm_distserve|train")
 # BENCH_MODEL selects the measured network: resnet18 (headline, matches the
 # reference's "resnet"), resnet50 (bottleneck — ~4x the FLOPs/image, the
 # MXU-utilisation probe), alexnet (the other half of the reference's
@@ -72,6 +72,7 @@ METRIC = {"cnn": f"{BENCH_MODEL}_imagenet_inference_throughput",
           "lm_tp": "lm_tp_decode_throughput",
           "lm_gateway": "lm_gateway_goodput",
           "lm_autoscale": "lm_autoscale_scaleout_goodput",
+          "lm_distserve": "lm_distserve_handoff_throughput",
           "train": "lm_train_throughput"}[BENCH_SUITE]
 
 # The TPU sits behind a tunnel that is intermittently down; a successful TPU
@@ -92,6 +93,8 @@ _LAST_GOOD = os.path.join(
      else "BENCH_LAST_GOOD_lm_gateway.json" if BENCH_SUITE == "lm_gateway"
      else "BENCH_LAST_GOOD_lm_autoscale.json"
      if BENCH_SUITE == "lm_autoscale"
+     else "BENCH_LAST_GOOD_lm_distserve.json"
+     if BENCH_SUITE == "lm_distserve"
      else "BENCH_LAST_GOOD_train.json" if BENCH_SUITE == "train"
      else f"BENCH_LAST_GOOD_{BENCH_MODEL}.json"))
 # the compact LM sub-record captured during a default cnn run caches here
@@ -814,6 +817,20 @@ def run_lm_autoscale_suite(devices) -> None:
                       "lm autoscale measurement failed", compact=False)
 
 
+def run_lm_distserve_suite(devices) -> None:
+    """BENCH_SUITE=lm_distserve: what shipping prefilled KV blocks off
+    the decode path buys (ISSUE 18) — one scripted long-prompt-arrival
+    workload against three arms: colocated, whole-request role split,
+    and true handoff (prefill replica exports the block chain, decode
+    replica grafts it and prefills only the sub-block suffix). Headline
+    is the handoff arm's throughput; the decode-interference p95
+    inter-token comparison and the predictive scale-ahead forecast lead
+    ride in details."""
+    from idunno_tpu.utils.lm_bench import run_lm_distserve_bench
+    _run_record_suite(devices, run_lm_distserve_bench, "handoff",
+                      "lm distserve measurement failed", compact=False)
+
+
 def run_train_suite(devices) -> None:
     """BENCH_SUITE=train: LM + CNN train-step throughput (trained
     tokens/sec; accum/fsdp/cnn points in details)."""
@@ -876,6 +893,8 @@ def main() -> None:
             run_lm_gateway_suite(devices)
         elif BENCH_SUITE == "lm_autoscale":
             run_lm_autoscale_suite(devices)
+        elif BENCH_SUITE == "lm_distserve":
+            run_lm_distserve_suite(devices)
         elif BENCH_SUITE == "train":
             run_train_suite(devices)
         else:

@@ -209,6 +209,20 @@ IDEM_VERBS = (
         why="fetch grafts content-addressed chunks into the radix tree; "
             "chunks already present are reused not reallocated, so a "
             "duplicated fetch converges on the same tree and pool state"),
+    IdemVerb("kv_handoff", "natural", anchors=(
+        ("idunno_tpu/serve/control.py",
+         "ControlService._dispatch", "kv_handoff"),
+        # adopt decodes each KVC1 blob against the expected token chunk
+        # (wrong-content blobs are refused, not grafted) and grafts via
+        # the radix tree, which reuses chunks it already holds
+        ("idunno_tpu/engine/serve_lm.py",
+         "DecodeServer.handoff_adopt", "expect_tokens"),
+        ("idunno_tpu/serve/prefix_cache.py",
+         "RadixPrefixCache.graft", "children"),),
+        why="a replayed ship re-probes the decode replica's radix depth "
+            "and adopt grafts content-verified chunks that dedupe against "
+            "blocks already held, so duplicated handoffs converge on the "
+            "same block-pool state and the journaled request decodes once"),
 )
 
 GUARDED = (
@@ -242,6 +256,11 @@ RETRY_SAFE = (
               verbs=("lm_submit", "train_start", "lm_serve"),
               why="harness client path mirrors real clients: mutating "
                   "verbs carry idem keys threaded by the workload"),
+    RetrySite("idunno_tpu/serve/lm_manager.py",
+              "LMPoolManager._handoff_ship", verbs=("kv_handoff",),
+              why="kv_handoff is naturally idempotent: a replayed ship "
+                  "re-probes the decode depth and adopt grafts dedupe "
+                  "against blocks already held, converging on one state"),
 )
 
 

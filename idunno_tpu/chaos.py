@@ -139,7 +139,7 @@ class ChaosControl:
 
     _POOL_VERBS = ("lm_submit", "lm_poll", "lm_stats", "lm_qos",
                    "lm_autoscale", "prefix_publish", "prefix_probe",
-                   "prefix_fetch")
+                   "prefix_fetch", "kv_handoff")
 
     def __init__(self, host: str, membership: MembershipService,
                  lm_manager: LMPoolManager, store=None,
@@ -266,6 +266,10 @@ class ChaosControl:
                     # same relay as serve/control.py:_route_cluster —
                     # prefix state lives on the serving node
                     return mgr.prefix_op(verb, name, p)
+                if verb == "kv_handoff":
+                    # same relay as serve/control.py:_route_cluster —
+                    # block state lives on the serving replicas
+                    return mgr.kv_handoff(name, p)
                 return {"stats": mgr.stats(name)}
         # -- node-local fake LM tier --
         if verb == "lm_serve":
@@ -284,9 +288,14 @@ class ChaosControl:
                                  "chunk": int(p.get("prefill_chunk")
                                               or 0),
                                  "n_model": int(p.get("n_model") or 1),
-                                 "cp": None, "bs": 0, "tree": set(),
+                                 "cp": None,
+                                 "bs": int(p.get("kv_block_size") or 0),
+                                 "tree": set(),
                                  "remote_hits": 0, "published": 0,
-                                 "warmed": 0}
+                                 "warmed": 0,
+                                 # DistServe handoff gauges (ISSUE 18)
+                                 "shipped": 0, "adopted": 0,
+                                 "fallbacks": 0}
             if p.get("cluster_prefix") and self.store is not None:
                 # ISSUE 17: the fake tier runs the REAL
                 # ClusterPrefixCache against the real SDFS ring; only
@@ -355,6 +364,8 @@ class ChaosControl:
             return {"qos": None}
         if verb in ("prefix_publish", "prefix_probe", "prefix_fetch"):
             return self._prefix_verb(verb, p)
+        if verb == "kv_handoff":
+            return self._handoff_verb(p)
         raise ValueError(f"unknown control verb {verb!r}")
 
     # -- fake-tier cluster prefix cache (ISSUE 17) -------------------------
@@ -497,6 +508,112 @@ class ChaosControl:
         loop["warmed"] += fetched
         return {"fetched_blocks": fetched, "targets": len(targets)}
 
+    # -- fake-tier DistServe KV handoff (ISSUE 18) -------------------------
+
+    def _handoff_verb(self, p: dict) -> dict:
+        """Node-local handlers mirroring serve/control.py:_kv_handoff
+        over the fake tier's radix tree, with the REAL KVC1 wire codec
+        (store/kv_chain.py): a ship encodes the prefill replica's blocks,
+        pushes them point-to-point to the decode node, and the adopt side
+        decodes with ``expect_tokens`` + checks content against the pure
+        ``chunk_content`` — a mismatch is a wrong-token graft, recorded
+        as an invariant violation exactly like the prefix-cache path."""
+        import numpy as np
+
+        from idunno_tpu.store.kv_chain import decode_block, encode_block
+        name = p["name"]
+        loop = self._loops.get(name)
+        if loop is None:
+            raise ValueError(f"no lm_serve pool for {name!r}; "
+                             "call lm_serve first")
+        bs = int(loop.get("bs") or 0)
+        if bs <= 0:
+            raise ValueError(f"pool {name!r} has no KV block tier "
+                             "(serve with kv_block_size > 0)")
+        op = p.get("op")
+        toks = [int(t) for t in p.get("tokens") or []]
+        chunks = self._chunks(toks, bs)
+        # admission cap mirror: >= 1 token must remain to prefill
+        want = max(0, (len(toks) - 1) // bs)
+        tree = loop["tree"]
+        if op == "probe":
+            return {"depth": self._tree_depth(tree, chunks, want),
+                    "want": want, "block_size": bs}
+        if op == "adopt":
+            start = int(p.get("start_depth") or 0)
+            wrote = 0
+            nbytes = 0
+            for j, blob_s in enumerate(p.get("blobs") or []):
+                d = start + j
+                blob = blob_s.encode("latin-1")
+                nbytes += len(blob)
+                _, arrays = decode_block(blob,
+                                         expect_tokens=list(chunks[d]))
+                wantkv = chunk_content(list(chunks[d]))["kv"]
+                if not np.array_equal(np.asarray(arrays.get("kv")),
+                                      wantkv):
+                    self.violations.append(
+                        f"{self.host}/{name}: wrong-token KV content "
+                        f"adopted at depth {d} for chunk {chunks[d]} "
+                        f"(handoff corruption)")
+                    raise ValueError("handoff blob content mismatch")
+                tree.add(tuple(chunks[:d + 1]))
+                wrote += 1
+            loop["adopted"] += wrote
+            return {"adopted": wrote, "wrote": wrote,
+                    "depth": start + wrote, "bytes": nbytes}
+        if op == "ship":
+            target_host = p["target_host"]
+            target_name = p["target_name"]
+            # model the prefill leg: this replica fills its own blocks
+            for d in range(1, want + 1):
+                tree.add(tuple(chunks[:d]))
+
+            def rcall(fwd: dict) -> dict:
+                out = self.mgr.transport.call(
+                    target_host, "control",
+                    Message(MessageType.INFERENCE, self.host,
+                            dict(fwd, local=True,
+                                 epoch=list(self.membership.epoch
+                                            .view()))),
+                    timeout=0.5)
+                if out is None:
+                    raise TransportError(
+                        f"kv_handoff: {target_host} gave no reply",
+                        reason="timeout")
+                observe_payload(self.membership.epoch, out.payload)
+                if out.type is MessageType.ERROR:
+                    raise ValueError(
+                        str((out.payload or {}).get("error", "")))
+                return dict(out.payload or {})
+
+            probe = rcall({"verb": "kv_handoff", "op": "probe",
+                           "name": target_name, "tokens": toks})
+            depth = int(probe.get("depth") or 0)
+            if depth >= want:
+                # delta-only ship: the decode replica already holds the
+                # full chain (a replayed ship after a lost ACK)
+                return {"shipped": 0, "bytes": 0, "depth": depth,
+                        "already": True}
+            blobs = []
+            for d in range(depth, want):
+                blob = encode_block(
+                    {"tokens": list(chunks[d]), "depth": d,
+                     "block_size": bs},
+                    chunk_content(list(chunks[d])))
+                blobs.append(blob.decode("latin-1"))
+            out = rcall({"verb": "kv_handoff", "op": "adopt",
+                         "name": target_name, "tokens": toks,
+                         "blobs": blobs, "start_depth": depth})
+            loop["shipped"] += int(out.get("wrote") or 0)
+            return {"shipped": int(out.get("wrote") or 0),
+                    "bytes": int(out.get("bytes") or 0),
+                    "depth": int(out.get("depth") or 0)}
+        if op == "fallback":
+            loop["fallbacks"] += 1
+            return {"fallback": True}
+        raise ValueError(f"unknown kv_handoff op {op!r}")
+
 
 class ChaosCluster:
     """A 5-host in-process cluster (coordinator n0, standby n1) with every
@@ -506,11 +623,13 @@ class ChaosCluster:
     LM_POOL = "chaos-lm"
     LM_POOL_B = "chaos-lmB"
     LM_GROUP = "chaos-grp"
+    LM_GROUP_D = "chaos-dsg"
 
     def __init__(self, seed: int, data_dir: str, n_hosts: int = 5,
                  prefill_chunk: int = 0, n_model: int = 1,
                  autoscale: bool = False, multi_pool: bool = False,
-                 cluster_prefix: bool = False) -> None:
+                 cluster_prefix: bool = False,
+                 distserve: bool = False) -> None:
         self.seed = seed
         self.prefill_chunk = prefill_chunk
         self.n_model = n_model
@@ -524,6 +643,11 @@ class ChaosCluster:
         # for the same reason (prefix submissions draw extra rng, and the
         # real store traffic the cache generates draws chaos rng)
         self.cluster_prefix = cluster_prefix
+        # ISSUE 18: a role-split replica group (prefill + decode) with a
+        # KV block pool, so long-prompt submissions route in DistServe
+        # handoff mode (manager ships real KVC1 blobs between the fake
+        # loops) — flag-gated: submissions AND ship RPCs draw chaos rng
+        self.distserve = distserve
         # created before the host loop: the controls hold a reference so
         # the fake tier's inline content checks (wrong-token graft,
         # double-prefill) land in the same invariant ledger
@@ -627,6 +751,7 @@ class ChaosCluster:
         self.lm_attempted: list[dict] = []
         self.grp_acked: list[dict] = []      # group-routed lm submissions
         self.lmp_acked: list[dict] = []      # shared-head prefix workload
+        self.lmh_acked: list[dict] = []      # distserve handoff workload
         # (name, version, blob, holders-at-ack): the holder set feeds the
         # ring-RF invariant — a death must not shrink it below min(RF, |set|)
         self.sdfs_acked: list[tuple[str, int, bytes, frozenset]] = []
@@ -670,9 +795,29 @@ class ChaosCluster:
                               "dwell_s": 1.0, "drain_window_s": 1.0,
                               "max_replicas": 3}})
             assert gout.get("group") or gout.get("already"), gout
+        if distserve:
+            # a role-split group with a KV block pool: prefill-heavy
+            # prompts (>= 4 tokens) route in handoff mode. The policy is
+            # DISABLED so the autoscaler never retires the role pair
+            # mid-schedule — the handoff path itself is what is under
+            # test, not scaling.
+            dout = self._client_control("n2", {
+                "verb": "lm_serve", "placement": "auto",
+                "name": self.LM_GROUP_D, "prompt_len": 8, "max_len": 64,
+                "slots": 4, "kv_block_size": 2,
+                "autoscale": {"enabled": False,
+                              "prefill_len_threshold": 4,
+                              "max_replicas": 3}})
+            assert dout.get("group") or dout.get("already"), dout
+            owner = next(h for h in self.cfg.hosts
+                         if self.LM_GROUP_D in self.managers[h]._groups)
+            sd = self.managers[owner].group_spawn(self.LM_GROUP_D,
+                                                  role="prefill")
+            assert sd is not None, "distserve prefill spawn failed"
         names = ([self.LM_POOL]
                  + ([self.LM_POOL_B] if multi_pool else [])
-                 + ([self.LM_GROUP] if autoscale else []))
+                 + ([self.LM_GROUP] if autoscale else [])
+                 + ([self.LM_GROUP_D] if distserve else []))
         full = set(self.cfg.hosts)
         self.expected_owners = {
             pool_scope(n): place_scope(pool_scope(n), self.cfg.hosts, full)
@@ -895,6 +1040,33 @@ class ChaosCluster:
         self.lmp_acked.append({"serial": s, "rid": int(out["id"]),
                                "prompt": prompt, "seed": s, "max_new": 4})
 
+    def op_lm_handoff(self, client: str) -> None:
+        """A LONG-prompt submission to the role-split group (ISSUE 18):
+        7 tokens crosses the prefill_len_threshold (4), so the manager
+        routes it in handoff mode — the prefill replica fills + ships 3
+        KV blocks to the tenant-sticky decode replica before the request
+        forwards there. Tokens stay serial-unique, so the submission
+        rides the same exactness ledger; a ship that dies mid-flight
+        must fall back or replay, never lose or double the request."""
+        self._serial += 1
+        s = self._serial
+        prompt = [s % 251, (s * 7) % 251, (s * 13) % 251,
+                  (s * 17) % 251, (s * 19) % 251, (s * 23) % 251,
+                  (s * 29) % 251]
+        self.lm_attempted.append({"serial": s, "prompt": prompt,
+                                  "seed": s, "max_new": 4,
+                                  "pool": self.LM_GROUP_D})
+        try:
+            out = self._client_control(
+                client, {"verb": "lm_submit", "name": self.LM_GROUP_D,
+                         "prompt": prompt, "max_new": 4, "seed": s,
+                         "tenant": f"t{s % 3}"},
+                idem=f"{client}:{s}:h")
+        except (TransportError, RuntimeError):
+            return
+        self.lmh_acked.append({"serial": s, "hrid": int(out["id"]),
+                               "prompt": prompt, "seed": s, "max_new": 4})
+
     def _scripted_gauges(self, mgr: LMPoolManager, name: str) -> dict:
         """Deterministic stand-in for `group_gauges`: scripted p95
         pressure (one number for the whole group), real journal backlog
@@ -999,6 +1171,8 @@ class ChaosCluster:
                 self.op_lm_b(client)
             elif self.cluster_prefix and self.rng.random() < 0.5:
                 self.op_lm_prefix(client)
+            elif self.distserve and self.rng.random() < 0.5:
+                self.op_lm_handoff(client)
             else:
                 self.op_lm(client)
         elif r < 0.58:
@@ -1107,15 +1281,17 @@ class ChaosCluster:
                 for rid, r in pool["requests"].items():
                     if r["status"] in ("pending", "inflight"):
                         out.append(f"{tag} rid {rid} {r['status']}")
-        mgr = self.managers[self._pool_owner(self.LM_GROUP)]
-        with mgr._lock:
-            g = mgr._groups.get(self.LM_GROUP)
-            if g is not None:
+        for gname in (self.LM_GROUP, self.LM_GROUP_D):
+            mgr = self.managers[self._pool_owner(gname)]
+            with mgr._lock:
+                g = mgr._groups.get(gname)
+                if g is None:
+                    continue
                 replicas = list(g["replicas"])
                 placed = [r for r in replicas
                           if (mgr._pools.get(r) or {}).get("node")]
                 if not placed:
-                    out.append("group has no placed replica")
+                    out.append(f"group {gname} has no placed replica")
                 for r in replicas:
                     rpool = mgr._pools.get(r)
                     if rpool is None:
@@ -1148,7 +1324,8 @@ class ChaosCluster:
         got = []
         names = ([self.LM_POOL]
                  + ([self.LM_POOL_B] if self.multi_pool else [])
-                 + ([self.LM_GROUP] if self.autoscale else []))
+                 + ([self.LM_GROUP] if self.autoscale else [])
+                 + ([self.LM_GROUP_D] if self.distserve else []))
         for _ in range(3):
             for name in list(names):
                 try:
@@ -1327,6 +1504,47 @@ class ChaosCluster:
                                           for x in loops),
                 "prefix_published": sum(x["published"] for x in loops),
                 "prefix_warmed": sum(x["warmed"] for x in loops)}
+        # DistServe handoff (ISSUE 18): every handed-off request reached
+        # a TERMINAL handoff state (adopted or fallback) by convergence —
+        # a request stuck "prefilling"/"shipping" would mean the replay
+        # machinery lost a ship edge. Content corruption landed in
+        # self.violations (asserted empty above) via the adopt-side
+        # KVC1 expect_tokens + chunk_content checks.
+        ds_summary: dict = {}
+        if self.distserve:
+            mgr = self.managers[self._pool_owner(self.LM_GROUP_D)]
+            with mgr._lock:
+                g = mgr._groups.get(self.LM_GROUP_D)
+                assert g is not None, "distserve group lost from journal"
+                roles = {m["role"] for m in g["replicas"].values()}
+                rc = dict(g["route_counts"])
+                states: dict[str, int] = {}
+                for r in list(g["replicas"]):
+                    rpool = mgr._pools.get(r)
+                    if rpool is None:
+                        continue
+                    for rid, q in rpool["requests"].items():
+                        hop = q.get("handoff")
+                        if not hop:
+                            continue
+                        st = hop.get("state")
+                        states[st] = states.get(st, 0) + 1
+                        assert st in ("adopted", "fallback"), \
+                            f"handoff {r}:{rid} non-terminal at " \
+                            f"convergence: {st!r}"
+            assert {"prefill", "decode"} <= roles, \
+                f"distserve group lost its role split: {sorted(roles)}"
+            shipped = sum(x["shipped"] for ctl in self.controls.values()
+                          for x in ctl._loops.values())
+            adopted = sum(x["adopted"] for ctl in self.controls.values()
+                          for x in ctl._loops.values())
+            ds_summary = {
+                "lmh_acked": len(self.lmh_acked),
+                "handoff_routed": rc.get("handoff", 0),
+                "handoff_adopted": states.get("adopted", 0),
+                "handoff_fallback": states.get("fallback", 0),
+                "handoff_blocks_shipped": shipped,
+                "handoff_blocks_adopted": adopted}
         pool_epochs: dict[str, int] = {}
         for scope, e in self.scope_owners:
             pool_epochs[scope] = max(pool_epochs.get(scope, 0), e)
@@ -1351,7 +1569,7 @@ class ChaosCluster:
                 "owner_moves": owner_moves,
                 "hosts": len(self.cfg.hosts),
                 "final_master": self.final_master(),
-                **grp_summary, **prefix_summary}
+                **grp_summary, **prefix_summary, **ds_summary}
 
 
 def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
@@ -1361,7 +1579,8 @@ def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
                         autoscale: bool = False,
                         multi_pool: bool = False,
                         n_hosts: int = 5,
-                        cluster_prefix: bool = False) -> dict:
+                        cluster_prefix: bool = False,
+                        distserve: bool = False) -> dict:
     """One full seeded chaos run: schedule -> converge -> invariants.
     Returns the invariant summary plus convergence time.
     ``prefill_chunk`` rides the managed pool's lm_serve spec (ISSUE 7):
@@ -1378,12 +1597,18 @@ def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
     ``cluster_prefix`` serves the first pool with the cluster prefix
     cache on (ISSUE 17): a shared-head workload publishes/remote-hits
     real KVC1 blobs on the real SDFS ring, with inline wrong-token /
-    double-prefill checks feeding the violations ledger."""
+    double-prefill checks feeding the violations ledger.
+    ``distserve`` serves a role-split replica group with a KV block pool
+    (ISSUE 18): long-prompt submissions route in handoff mode — the
+    manager journals prefilling→shipping→adopted edges and ships real
+    KVC1 blobs between the fake loops; deaths mid-handoff must replay
+    the ship or fall back, never lose or double the request."""
     c = ChaosCluster(seed, data_dir, n_hosts=n_hosts,
                      prefill_chunk=prefill_chunk,
                      n_model=n_model, autoscale=autoscale,
                      multi_pool=multi_pool,
-                     cluster_prefix=cluster_prefix)
+                     cluster_prefix=cluster_prefix,
+                     distserve=distserve)
     try:
         c.run_schedule(steps=steps,
                        chaos=chaos if chaos is not None

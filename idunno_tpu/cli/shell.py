@@ -679,6 +679,17 @@ class Shell:
                         f"fetched={pc['prefix_fetch_bytes']}B")
             return out
 
+        def handoff_line(stats: dict) -> str:
+            # DistServe handoff (ISSUE 18): only worth a line once a
+            # ship has moved bytes or a fallback fired
+            if not any(stats.get(k) for k in ("kv_handoff_requests",
+                                              "kv_handoff_bytes",
+                                              "kv_handoff_fallbacks")):
+                return ""
+            return (f"\n  kv_handoff: ships={stats['kv_handoff_requests']} "
+                    f"bytes={stats['kv_handoff_bytes']} "
+                    f"fallbacks={stats['kv_handoff_fallbacks']}")
+
         def gateway_line(stats: dict) -> str:
             gw = stats.get("gateway")
             if not gw:
@@ -710,14 +721,15 @@ class Shell:
             return (head + f" | live={p['live']}/{p['slots']} "
                     f"completed={p['completed']} "
                     f"tokens_generated={p['tokens_generated']}"
-                    + config_line(p) + prefix_line(p) + gateway_line(p))
+                    + config_line(p) + prefix_line(p) + handoff_line(p)
+                    + gateway_line(p))
         return (f"{args[0]}: live={s['live']}/{s['slots']} "
                 f"queued={s['queued']} inbox={s['inbox']} "
                 f"unpolled={s['unpolled']} admitted={s['admitted']} "
                 f"completed={s['completed']} "
                 f"tokens_generated={s['tokens_generated']} "
                 f"dispatches={s['dispatches']}" + config_line(s)
-                + prefix_line(s) + gateway_line(s))
+                + prefix_line(s) + handoff_line(s) + gateway_line(s))
 
     def cmd_lm_qos(self, args: list[str]) -> str:
         if len(args) != 1:
@@ -740,6 +752,12 @@ class Shell:
                     f"max={pol.get('max_replicas')} "
                     f"dwell={pol.get('dwell_s')}s "
                     f"enabled={pol.get('enabled')})"]
+            fc = grp.get("forecast") or {}
+            if fc.get("predicted_rate") or fc.get("predictive_spawns"):
+                rows.append(
+                    f"  forecast: predicted_rate="
+                    f"{fc['predicted_rate']:.2f}/s "
+                    f"predictive_spawns={fc['predictive_spawns']}")
             for r, m in sorted(grp.get("replicas", {}).items()):
                 rows.append(f"  replica {r}: role={m.get('role')} "
                             f"state={m.get('state')}")

@@ -890,7 +890,13 @@ class DecodeServer:
                        # exists to shrink (bench comparison counter)
                        "prefill_tokens": 0,
                        # paged/chunked win counters (gauges via lm_stats)
-                       "prefill_chunks": 0, "kv_gather_bytes_saved": 0}
+                       "prefill_chunks": 0, "kv_gather_bytes_saved": 0,
+                       # DistServe handoff counters (ISSUE 18): exports
+                       # shipped from this pool / KVC1 bytes encoded or
+                       # adopted / ships that fell back to decode-side
+                       # prefill (gauges via lm_stats)
+                       "kv_handoff_requests": 0, "kv_handoff_bytes": 0,
+                       "kv_handoff_fallbacks": 0}
         # prefix-cache counters (zero-cost when the cache is off)
         self._pc_lookups = self._pc_hits = self._pc_tokens_saved = 0
         # flips True at the first decode dispatch and NEVER resets (the
@@ -1577,6 +1583,202 @@ class DecodeServer:
                              "(serve with cluster_prefix= and "
                              "kv_block_size > 0)")
         return self.cluster_prefix
+
+    # -- kv handoff (DistServe prefill→decode ship, ISSUE 18) -------------
+    #
+    # A prefill-role replica fills the block-aligned head of a long
+    # prompt, encodes the populated blocks as KVC1 blobs, and the decode
+    # replica grafts them into its own radix tree — point-to-point over
+    # the transport, no SDFS round-trip. The handoff state machine
+    # (prefilling → shipping → adopted, with fallback) lives in
+    # `serve/lm_manager.py`; these three verbs are its pool-local legs
+    # and are gated only on the radix tier (kv_block_size > 0), NOT the
+    # cluster prefix cache — handoff is transport-direct by design.
+
+    def _require_handoff(self) -> None:
+        if self._radix is None:
+            raise ValueError("pool has no KV block tier "
+                             "(serve with kv_block_size > 0)")
+
+    def handoff_probe(self, tokens: list[int]) -> dict:
+        """`kv_handoff` probe leg: the local radix depth for ``tokens``
+        plus the pool's block geometry, so a prefill replica ships only
+        the block suffix this replica doesn't already hold (delta-only
+        ship — prefix-cache hits compose). Pure read (the lookup only
+        touches LRU stamps)."""
+        self._require_handoff()
+        toks = [int(t) for t in tokens]
+        bs = self.kv_block_size
+        return {"depth": len(self._radix.lookup(toks)),
+                "want": max(0, (len(toks) - 1) // bs),
+                "block_size": bs}
+
+    def _prefill_head(self, head: list[int], hit_chain: list) -> list:
+        """Prefill the missing block-aligned suffix of ``head`` (the
+        handoff export's fill leg) and insert the chain — `_admit`'s
+        non-chunked prefill branches with the block head in place of the
+        full prompt, so paged/gathered/prefix pools all fill through
+        their own machinery. Returns the ACQUIRED chain for ``head``
+        (caller releases)."""
+        pl = len(self.prefix) if self.prefix else 0
+        bs = self.kv_block_size
+        hit = len(hit_chain) * bs
+        head_true = len(head)
+        while True:
+            rest = head_true - hit
+            bucket = next(
+                (b for b in self.prompt_buckets
+                 if b >= rest and pl + hit + b <= self.max_len), None)
+            if bucket is not None:
+                break
+            if hit <= 0:
+                raise ValueError(
+                    f"no prompt bucket fits a {head_true}-token "
+                    "handoff head")
+            hit -= bs
+        hit_chain = hit_chain[:hit // bs]
+        if hit_chain:
+            self._radix.acquire(hit_chain)
+        try:
+            suffix = np.zeros((1, bucket), np.int32)
+            suffix[0, :head_true - hit] = head[hit:]
+            self._stats["prefill_tokens"] += bucket
+            if self._paged and hit:
+                tab = np.asarray([[nd.block for nd in hit_chain]],
+                                 np.int32)
+                row_cache, _ = _prefill_suffix_paged(
+                    self._prefill_model, self.params, self._prefix_cache,
+                    jnp.asarray(suffix), jnp.int32(head_true - hit),
+                    pl + hit, bucket, jnp.asarray(tab),
+                    jnp.asarray([hit], np.int32),
+                    self._block_pool.kv_pages(), start=pl,
+                    kernel=self.paged_kernel,
+                    interpret=self._paged_interpret)
+            elif hit:
+                gathered = self._block_pool.gather(
+                    [nd.block for nd in hit_chain])
+                pre = (concat_kv_prefix(
+                    self._prefix_cache, gathered,
+                    token_axis=2 if self._scan else 1)
+                    if self.prefix else gathered)
+                row_cache, _ = _prefill_suffix(
+                    self._prefill_model, self.params, pre,
+                    jnp.asarray(suffix), jnp.int32(head_true - hit),
+                    pl + hit, bucket)
+            elif self.prefix:
+                row_cache, _ = _prefill_suffix(
+                    self._prefill_model, self.params, self._prefix_cache,
+                    jnp.asarray(suffix), jnp.int32(head_true), pl, bucket)
+            else:
+                row_cache, _ = _prefill(
+                    self._prefill_model, self.params, jnp.asarray(suffix),
+                    jnp.int32(head_true), bucket)
+            return self._radix.insert(head, row_cache, pl)
+        finally:
+            if hit_chain:
+                self._radix.release(hit_chain)
+
+    def handoff_export(self, tokens: list[int], from_depth: int = 0,
+                       trace: tuple | None = None) -> dict:
+        """`kv_handoff` export leg (prefill replica): ensure the radix
+        tree holds the full usable block chain for ``tokens`` —
+        prefilling the missing block-aligned region if needed — then
+        encode depths [``from_depth``, want) as KVC1 blobs. ``want``
+        always leaves ≥ 1 suffix token for the decode side's own
+        admission prefill (the same cap `_admit` applies), so the first
+        generated token's logits are computed there, token-exactly."""
+        self._require_handoff()
+        toks = [int(t) for t in tokens]
+        bs = self.kv_block_size
+        want = max(0, (len(toks) - 1) // bs)
+        from_depth = max(0, int(from_depth))
+        if want <= from_depth:
+            return {"blobs": [], "depth": from_depth, "blocks": 0,
+                    "bytes": 0, "block_size": bs}
+        from idunno_tpu.store.kv_chain import encode_block
+        t0 = (self.spans.clock()
+              if self.spans is not None and trace else None)
+        head = toks[:want * bs]
+        chain = self._radix.lookup(head)
+        if len(chain) < want:
+            chain = self._prefill_head(head, chain)
+        else:
+            self._radix.acquire(chain)
+        try:
+            if len(chain) < want:
+                raise ValueError(
+                    f"handoff export covered {len(chain)} of {want} "
+                    "blocks (block pool exhausted; ship refused)")
+            blobs, nbytes = [], 0
+            for j in range(from_depth, want):
+                chunk = head[j * bs:(j + 1) * bs]
+                blob = encode_block(
+                    {"tokens": chunk, "depth": j, "block_size": bs},
+                    self._block_pool.read_block(chain[j].block))
+                blobs.append(blob)
+                nbytes += len(blob)
+        finally:
+            self._radix.release(chain)
+        self._stats["kv_handoff_requests"] += 1
+        self._stats["kv_handoff_bytes"] += nbytes
+        if t0 is not None:
+            self.spans.record(
+                "lm.handoff_export", trace=trace[0], parent=trace[1],
+                t_start=t0, attrs={"blocks": want - from_depth,
+                                   "from_depth": from_depth,
+                                   "bytes": nbytes})
+        return {"blobs": blobs, "depth": from_depth,
+                "blocks": want - from_depth, "bytes": nbytes,
+                "block_size": bs}
+
+    def handoff_adopt(self, tokens: list[int], blobs: list[bytes],
+                      start_depth: int = 0,
+                      trace: tuple | None = None) -> dict:
+        """`kv_handoff` adopt leg (decode replica): decode each KVC1
+        blob against the expected token chunk — ``expect_tokens=`` makes
+        a stale/wrong-content blob a typed refusal, never a graft — and
+        splice the verified blocks via `RadixPrefixCache.graft`, which
+        REUSES chunks already held. A duplicated/replayed adopt therefore
+        converges on the same block-pool state, and the next admission's
+        radix lookup turns the shipped range into a prefix hit: zero
+        re-prefill for shipped blocks, structurally."""
+        self._require_handoff()
+        toks = [int(t) for t in tokens]
+        bs = self.kv_block_size
+        start_depth = max(0, int(start_depth))
+        t0 = (self.spans.clock()
+              if self.spans is not None and trace else None)
+        from idunno_tpu.store.kv_chain import decode_block
+        fetched, nbytes = [], 0
+        for i, blob in enumerate(blobs):
+            j = start_depth + i
+            chunk = toks[j * bs:(j + 1) * bs]
+            if len(chunk) < bs:
+                raise ValueError(
+                    f"handoff blob at depth {j} extends past the "
+                    "prompt's full blocks")
+            _, arrays = decode_block(blob, expect_tokens=chunk)
+            fetched.append((chunk, arrays))
+            nbytes += len(blob)
+        wrote = self._radix.graft(toks, fetched, start_depth)
+        self._stats["kv_handoff_bytes"] += nbytes
+        depth = len(self._radix.lookup(toks))
+        if t0 is not None:
+            self.spans.record(
+                "lm.handoff_adopt", trace=trace[0], parent=trace[1],
+                t_start=t0, attrs={"blocks": len(fetched), "wrote": wrote,
+                                   "start_depth": start_depth,
+                                   "bytes": nbytes, "depth": depth})
+        return {"adopted": len(fetched), "wrote": wrote,
+                "depth": depth, "bytes": nbytes}
+
+    def handoff_fallback(self) -> dict:
+        """Count a ship that degraded to decode-side prefill (the
+        manager's fallback transition); the request itself is unharmed —
+        it forwards through the normal path and re-prefills there."""
+        self._require_handoff()
+        self._stats["kv_handoff_fallbacks"] += 1
+        return {"fallbacks": self._stats["kv_handoff_fallbacks"]}
 
     # -- serving loop -----------------------------------------------------
 

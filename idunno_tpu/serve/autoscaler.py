@@ -69,6 +69,18 @@ class AutoscalePolicy:
     prefill_share: float = 0.25
     # rebalance when max-min WFQ debt across decode replicas exceeds it
     rebalance_debt: float = 2.0
+    # predictive scale-AHEAD (ISSUE 18; Clockwork-style provisioning,
+    # Gujarati et al., OSDI 2020): forecast horizon in seconds (0
+    # disables). A Holt (level+trend) forecast of the group's admission
+    # arrival rate — fed from the gateway's cumulative per-class
+    # ``admitted`` counters via group_gauges — spawns BEFORE the p95
+    # breach when the predicted rate at the horizon exceeds serving
+    # capacity (active replicas x predict_capacity_rps), and suppresses
+    # scale-in while a breach is forecast (never below reactive).
+    predict_horizon_s: float = 0.0
+    predict_alpha: float = 0.5          # level smoothing (EWMA weight)
+    predict_beta: float = 0.3           # trend smoothing
+    predict_capacity_rps: float = 1.0   # per-replica sustainable req/s
     enabled: bool = True
 
     def __post_init__(self) -> None:
@@ -88,6 +100,15 @@ class AutoscalePolicy:
             raise ValueError("autoscale: prefill_share must be in [0, 1]")
         if self.rebalance_debt <= 0:
             raise ValueError("autoscale: rebalance_debt must be > 0")
+        if self.predict_horizon_s < 0:
+            raise ValueError("autoscale: predict_horizon_s must be >= 0")
+        if not 0.0 < self.predict_alpha <= 1.0 \
+                or not 0.0 < self.predict_beta <= 1.0:
+            raise ValueError("autoscale: predict smoothing factors must "
+                             "be in (0, 1]")
+        if self.predict_capacity_rps <= 0:
+            raise ValueError("autoscale: predict_capacity_rps must "
+                             "be > 0")
 
     @classmethod
     def keys(cls) -> frozenset:
@@ -146,6 +167,12 @@ class Autoscaler:
         self.manager = manager
         self.clock = clock
         self.gauges_fn: Optional[Callable[[str], Dict[str, Any]]] = None
+        # Holt forecast memory per group (ISSUE 18): soft derived state —
+        # the DECISIONS it produces journal/replicate like any other;
+        # after failover the forecast reseeds from live counters in one
+        # sample interval. {group: {t, admitted, level, trend,
+        # predicted, spawns}}
+        self._forecast: Dict[str, Dict[str, Any]] = {}
 
     # -- signal helpers ---------------------------------------------------
 
@@ -159,6 +186,64 @@ class Autoscaler:
     @staticmethod
     def _backlog(gauges: Dict[str, Any]) -> int:
         return sum(int(g.get("backlog", 0)) for g in gauges.values())
+
+    # -- predictive scale-ahead (ISSUE 18) --------------------------------
+
+    def forecast_view(self, name: str) -> Dict[str, Any]:
+        """Forecast gauges for ``lm_qos`` group status / shell display."""
+        st = self._forecast.get(name)
+        if st is None:
+            return {"predicted_rate": 0.0, "predictive_spawns": 0}
+        return {"predicted_rate": round(float(st["predicted"]), 4),
+                "predictive_spawns": int(st["spawns"])}
+
+    def _forecast_update(self, name: str, policy: AutoscalePolicy,
+                         gauges: Dict[str, Any], now: float) -> float:
+        """Advance the group's Holt (level+trend) arrival-rate forecast
+        one sample and return the predicted rate at the horizon.
+
+        The signal is the sum of the gateway's cumulative per-class
+        ``admitted`` counters across replicas (group_gauges): the
+        discrete rate between ticks feeds ``level' = a*inst +
+        (1-a)*level``, ``trend' = b*(level'-level)/dt + (1-b)*trend``,
+        and the horizon estimate is ``level' + trend'*horizon`` —
+        trend-following, so a ramp crosses the capacity threshold
+        BEFORE the queue-wait p95 breaches. Deterministic: runs on the
+        injected clock, and a counter regression (group rebuilt,
+        replica set changed under failover) reseeds instead of
+        producing a negative rate."""
+        if policy.predict_horizon_s <= 0:
+            self._forecast.pop(name, None)
+            return 0.0
+        admitted = 0
+        for g in gauges.values():
+            adm = g.get("admitted") or {}
+            admitted += sum(int(v) for v in adm.values())
+        st = self._forecast.get(name)
+        if st is None or admitted < st["admitted"]:
+            self._forecast[name] = {"t": now, "admitted": admitted,
+                                    "level": None, "trend": 0.0,
+                                    "predicted": 0.0, "spawns": 0}
+            return 0.0
+        dt = now - st["t"]
+        if dt <= 0:
+            return float(st["predicted"])
+        inst = (admitted - st["admitted"]) / dt
+        if st["level"] is None:
+            # Holt initialization: the first rate sample seeds the level
+            # outright with zero trend — one sample carries no slope, and
+            # deriving one against the zero seed made any first arrival
+            # after a (re)seed look like a steep ramp, spawning on noise
+            level, trend = inst, 0.0
+        else:
+            a, b = policy.predict_alpha, policy.predict_beta
+            level = a * inst + (1 - a) * st["level"]
+            trend = (b * ((level - st["level"]) / dt)
+                     + (1 - b) * st["trend"])
+        predicted = max(0.0, level + trend * policy.predict_horizon_s)
+        st.update(t=now, admitted=admitted, level=level, trend=trend,
+                  predicted=predicted)
+        return predicted
 
     # -- the loop ---------------------------------------------------------
 
@@ -212,6 +297,7 @@ class Autoscaler:
         gauges = {r: g for r, g in gauges.items() if r in active}
         p95 = self._p95(gauges)
         backlog = self._backlog(gauges)
+        pred = self._forecast_update(name, policy, gauges, now)
 
         # 2. scale OUT on SLO breach
         if p95 > policy.deadline_slack_s and len(active) < policy.max_replicas:
@@ -228,9 +314,32 @@ class Autoscaler:
                 out.append(d)
             return out
 
+        # 2b. predictive scale-AHEAD (ISSUE 18): the forecast arrival
+        #     rate at the horizon exceeds what the active replicas can
+        #     sustain — spawn BEFORE the reactive breach. Journaled
+        #     exactly like a reactive spawn, tagged predictive.
+        if (pred > len(active) * policy.predict_capacity_rps
+                and len(active) < policy.max_replicas):
+            d = self.manager.group_spawn(
+                name, role="decode", predictive=True,
+                predicted_rate=round(pred, 4), p95=round(p95, 4))
+            if d:
+                self._forecast[name]["spawns"] += 1
+                metrics = getattr(getattr(self.manager, "service", None),
+                                  "metrics", None)
+                if metrics is not None:
+                    metrics.record_counter("predictive_spawns")
+                out.append(d)
+            return out
+
         # 3. scale IN at underload: idle group, or p95 well under slack.
         #    (The gateway's wait window is cumulative, so "no backlog"
-        #    is the reliable idle signal once traffic stops.)
+        #    is the reliable idle signal once traffic stops.) Suppressed
+        #    while the forecast predicts the SMALLER replica set would
+        #    breach — predictive never drops below what reactive keeps.
+        if pred > (len(active) - 1) * policy.predict_capacity_rps \
+                and policy.predict_horizon_s > 0:
+            return out
         low = (backlog == 0
                or p95 < policy.scale_in_frac * policy.deadline_slack_s)
         if low and len(active) > policy.min_replicas:

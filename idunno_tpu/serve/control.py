@@ -43,7 +43,7 @@ from idunno_tpu.comm.transport import TransportError
 from idunno_tpu.membership.epoch import (ScopeOwnerRedirect, check_payload,
                                          check_scoped, observe_payload,
                                          place_scope, pool_scope)
-from idunno_tpu.utils.spans import trace_from_payload
+from idunno_tpu.utils.spans import stamp_trace, trace_from_payload
 from idunno_tpu.utils.types import MessageType
 
 if TYPE_CHECKING:                                    # pragma: no cover
@@ -549,6 +549,11 @@ class ControlService:
             if op != "probe" and p.get("tenant") is not None:
                 kw["tenant"] = str(p["tenant"])
             return loop.prefix_op(op, **kw)
+        if verb == "kv_handoff":
+            # DistServe prefill→decode block handoff (ISSUE 18): fenced +
+            # scope-stamped by the _handle preamble like every pool verb,
+            # idempotent by radix-graft reuse — contracts.py row
+            return self._kv_handoff(p)
         if verb == "lm_stats":
             stats = self._lm_loop(p["name"]).stats()
             # surface pool gauges on the node's C8 metrics tracker so the
@@ -568,7 +573,14 @@ class ControlService:
                     pc,
                     kv_gather_bytes_saved=stats.get(
                         "kv_gather_bytes_saved", 0),
-                    prefill_chunks=stats.get("prefill_chunks", 0))
+                    prefill_chunks=stats.get("prefill_chunks", 0),
+                    # DistServe handoff gauges (ISSUE 18): ships from /
+                    # KVC1 bytes through / ships abandoned on this pool
+                    kv_handoff_requests=stats.get(
+                        "kv_handoff_requests", 0),
+                    kv_handoff_bytes=stats.get("kv_handoff_bytes", 0),
+                    kv_handoff_fallbacks=stats.get(
+                        "kv_handoff_fallbacks", 0))
             node.metrics.record_lm_gauges(p["name"], gauges)
             gw = stats.get("gateway")
             if gw is not None:
@@ -707,7 +719,10 @@ class ControlService:
             # dashboards can alert on them without a priming event
             extra_c = dict(retry_counters())
             cc = node.metrics.counters()
-            for k in ("scope_owner_redirects", "scope_owner_moves"):
+            # ISSUE 18: handoff-fallback and predictive-spawn counters
+            # join the always-present set (zero until the first event)
+            for k in ("scope_owner_redirects", "scope_owner_moves",
+                      "kv_handoff_fallbacks", "predictive_spawns"):
                 extra_c.setdefault(k, cc.get(k, 0))
             return {"text": node.metrics.prometheus_text(
                 node.host, extra_counters=extra_c,
@@ -772,10 +787,124 @@ class ControlService:
         merged.sort(key=lambda s: (s.get("t_start", 0.0), s["span_id"]))
         return {"trace_id": tid, "spans": merged, "nodes": nodes}
 
+    def _kv_handoff(self, p: dict) -> dict:
+        """DistServe KV-block handoff (ISSUE 18). Node-local ops ("probe"
+        | "export" | "adopt" | "fallback") marshal onto the named pool's
+        loop thread; op="ship" ORCHESTRATES from the prefill pool's node:
+        probe the decode target for its already-held depth, export only
+        the missing block suffix as KVC1 blobs (`store/kv_chain.py`
+        codec), and push them point-to-point to the target's adopt — no
+        SDFS round-trip on the critical path. KVC1 blobs ride the RPC
+        payload as latin-1 strings (the `put_bytes` idiom). Any failure
+        after the ship starts bumps the fallback counter on THIS pool and
+        re-raises: the caller (lm_manager._handoff_ship) falls back to
+        decode-side prefill — a handoff is only ever an optimization,
+        never a correctness dependency. Idempotent end to end: export
+        reads cached blocks, adopt grafts with reuse-on-existing
+        semantics, so a replayed ship converges on the same tree."""
+        node = self.node
+        op = p.get("op", "")
+        loop = self._lm_loop(p["name"])
+        toks = ([int(t) for t in p["tokens"]]
+                if p.get("tokens") is not None else None)
+        spans = getattr(node, "spans", None)
+        tctx = trace_from_payload(p)
+        tr = tctx if spans is not None else None
+        if op == "probe":
+            return loop.handoff_op("probe", tokens=toks)
+        if op == "export":
+            out = loop.handoff_op("export", tokens=toks,
+                                  from_depth=int(p.get("from_depth", 0)),
+                                  trace=tr)
+            out["blobs"] = [b.decode("latin-1") for b in out["blobs"]]
+            return out
+        if op == "adopt":
+            return loop.handoff_op(
+                "adopt", tokens=toks,
+                blobs=[b.encode("latin-1") for b in p["blobs"]],
+                start_depth=int(p.get("start_depth", 0)), trace=tr)
+        if op == "fallback":
+            return loop.handoff_op("fallback")
+        if op != "ship":
+            raise ValueError(f"unknown kv_handoff op {op!r}")
+        target_host = p["target_host"]
+        target_name = p.get("target_name") or p["name"]
+        if target_host == node.host and target_name == p["name"]:
+            raise ValueError("kv_handoff ship: target is the source pool")
+        sp = None
+        if spans is not None:
+            sp = spans.start("lm.handoff",
+                             trace=tctx[0] if tctx else None,
+                             parent=tctx[1] if tctx else None,
+                             attrs={"pool": p["name"],
+                                    "target": target_host,
+                                    "target_pool": target_name})
+        ctx = sp.ctx if sp is not None else None
+
+        def _call(payload: dict) -> dict:
+            # child hops chain under the ship span and carry this node's
+            # fence view (the stamp checker's send-site rule)
+            stamp_trace(payload, ctx)
+            payload["epoch"] = list(node.membership.epoch.view())
+            out = node.transport.call(
+                target_host, SERVICE,
+                Message(MessageType.INFERENCE, node.host, payload),
+                timeout=float(p.get("timeout", 30.0)))
+            if out is None:
+                raise TransportError(
+                    f"kv_handoff: {target_host} gave no reply",
+                    reason="timeout")
+            observe_payload(node.membership.epoch, out.payload)
+            if out.type is not MessageType.ACK:
+                raise ValueError(str(
+                    (out.payload or {}).get("error", "kv_handoff failed")))
+            return dict(out.payload or {})
+
+        try:
+            probe = _call({"verb": "kv_handoff", "op": "probe",
+                           "name": target_name, "tokens": list(toks),
+                           "local": True})
+            depth = int(probe["depth"])
+            export = loop.handoff_op("export", tokens=toks,
+                                     from_depth=depth, trace=ctx)
+            if export["blocks"] == 0:
+                # the target already holds every shippable block — the
+                # delta is empty, decode admits with a pure local hit
+                if sp is not None:
+                    spans.finish(sp, blocks=0, bytes=0, held_depth=depth)
+                return {"shipped": 0, "bytes": 0, "depth": depth,
+                        "already": True}
+            adopt = _call({
+                "verb": "kv_handoff", "op": "adopt", "name": target_name,
+                "tokens": list(toks),
+                "blobs": [b.decode("latin-1") for b in export["blobs"]],
+                "start_depth": depth, "local": True})
+        except Exception:
+            # count the abandoned ship on the PREFILL pool (its blocks
+            # were exported for nothing) and on the node tracker for
+            # metrics_export; the request itself survives via the
+            # caller's decode-side-prefill fallback
+            try:
+                loop.handoff_op("fallback")
+            except Exception:  # noqa: BLE001 - counter must not mask
+                pass
+            node.metrics.record_counter("kv_handoff_fallbacks")
+            if sp is not None:
+                spans.finish(sp, error=True)
+            raise
+        if sp is not None:
+            spans.finish(sp, blocks=export["blocks"],
+                         bytes=export["bytes"],
+                         adopted_depth=adopt.get("depth"))
+        return {"shipped": export["blocks"], "bytes": export["bytes"],
+                "depth": depth, "adopted": adopt.get("adopted", 0),
+                "target_depth": adopt.get("depth")}
+
     # pool-directed verbs that route by scope owner (ISSUE 15)
     _POOL_VERBS = ("lm_submit", "lm_poll", "lm_stats", "lm_stop",
                    "lm_cancel", "lm_partial", "lm_qos", "lm_autoscale",
-                   "prefix_publish", "prefix_probe", "prefix_fetch")
+                   "prefix_publish", "prefix_probe", "prefix_fetch",
+                   "kv_handoff")
 
     def _forward_scope_owner(self, p: dict, name: str, owner: str) -> dict:
         """Owner-aware routing (ISSUE 15): this node does not hold the
@@ -922,10 +1051,15 @@ class ControlService:
                     # (Prometheus metrics_export + chaos snapshots)
                     states = [m.get("state") for m
                               in grp.get("replicas", {}).values()]
+                    fc = grp.get("forecast") or {}
                     self.node.metrics.record_autoscale_gauges(name, {
                         "replicas": len(states),
                         "draining": states.count("draining"),
-                        "decisions_total": grp.get("decisions_total", 0)})
+                        "decisions_total": grp.get("decisions_total", 0),
+                        # predictive scale-ahead view (ISSUE 18)
+                        "predicted_rate": fc.get("predicted_rate", 0.0),
+                        "predictive_spawns": fc.get(
+                            "predictive_spawns", 0)})
                 return out
             if verb == "lm_autoscale":
                 # policy get/set for a replica group (serve/autoscaler.py)
@@ -938,6 +1072,11 @@ class ControlService:
                 # group's replicas) — prefix state lives on the serving
                 # node, the journal only knows the spec
                 return mgr.prefix_op(verb, name, p)
+            if verb == "kv_handoff":
+                # managed pools: relay to the pool's serving node — a
+                # ship must orchestrate FROM the prefill replica's own
+                # host (its loop owns the exported blocks)
+                return mgr.kv_handoff(name, p)
             return mgr.stop(name)
         if verb in ("train_status", "train_stop") and mgr.has_job(name):
             return (mgr.train_status(name) if verb == "train_status"

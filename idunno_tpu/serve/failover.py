@@ -232,7 +232,15 @@ class FailoverManager:
         transition the pool's owner just journaled survives an immediate
         death without waiting for the periodic full snapshot — and so
         scoped adoption can replay exactly this pool's segment while
-        other pools' state is untouched. The target is the POOL SCOPE's
+        other pools' state is untouched. DistServe handoff edges
+        (ISSUE 18) ride these same frames on BOTH endpoints: the decode
+        pool's ``req["handoff"]`` state machine (prefilling → shipping →
+        adopted | fallback) rides its request rows, and the prefill
+        pool's ``handoffs`` ledger rides the scalar ``fields`` of a
+        delta — so an adopter that replays a pool WAL sees any
+        non-terminal handoff and re-ships or falls back
+        (serve/lm_manager.py:_handoff_ship), never loses the request.
+        The target is the POOL SCOPE's
         own standby successor, and the gate is holding the journal (the
         manager only replicates pools it owns), not cluster mastership
         (ISSUE 15). Returns the standby's ACK payload (which may carry

@@ -44,6 +44,7 @@ import time
 from typing import Any
 
 from idunno_tpu.comm.message import Message
+from idunno_tpu.comm.retry import call_with_retry
 from idunno_tpu.comm.transport import Transport, TransportError
 from idunno_tpu.config import ClusterConfig
 from idunno_tpu.membership.epoch import (StaleEpoch, StaleScope, place_scope,
@@ -408,6 +409,12 @@ class LMPoolManager:
                      "done_total": 0, "failed_total": 0,
                      "cancelled_total": 0,
                      "shed_total": 0, "expired_total": 0,
+                     # DistServe ledger (ISSUE 18): handoffs this pool
+                     # PREFILLED for other pools' requests, keyed
+                     # "{decode_pool}:{rid}" → state. Journaled so the
+                     # ship edge is write-ahead in BOTH pools' WALs
+                     # (the decode side rides its request row)
+                     "handoffs": {},
                      "node_errors": [],
                      # measured service samples feeding the
                      # heterogeneous fair share: (seconds from
@@ -473,11 +480,17 @@ class LMPoolManager:
                tenant: str = "default", priority: str = "interactive",
                deadline_ms: float | None = None,
                idem_key: str | None = None,
-               trace: tuple | None = None) -> int:
+               trace: tuple | None = None,
+               handoff_from: str | None = None) -> int:
         """Journal a request (seed pinned NOW — replay after any failure
         must be token-exact even for sampled requests), then forward it to
         the pool's node. Forward failures leave it pending; the pump
         retries/relocates.
+
+        ``handoff_from`` (DistServe, ISSUE 18) names a PREFILL replica
+        that should fill the prompt's KV blocks and ship them to this
+        pool's node before the forward — the journal entry carries the
+        handoff state machine so a replay re-ships or falls back.
 
         QoS fields travel with the journal entry: the pool node's gateway
         decides admission at forward time, and a gateway shed comes back
@@ -548,6 +561,13 @@ class LMPoolManager:
                    # (readmit) — the client was told it was in, recovery
                    # must not shed it
                    "admitted": False,
+                   # DistServe state machine (ISSUE 18): prefilling →
+                   # shipping → adopted, any failure → fallback (decode-
+                   # side prefill). Journaled + replicated with the row.
+                   "handoff": ({"from": str(handoff_from),
+                                "state": "prefilling",
+                                "shipped": 0, "bytes": 0}
+                               if handoff_from is not None else None),
                    "status": _PENDING, "node_id": None,
                    "tokens": None, "prompt_len": None, "delivered": False,
                    "t_forwarded": None, "attempts": 0,
@@ -557,6 +577,8 @@ class LMPoolManager:
                 pool["idem"][idem_key] = rid
             node = pool["node"]
         if node is not None:
+            if req.get("handoff"):
+                self._handoff_ship(name, node, rid, req)
             self._forward(name, node, rid, req)
         # write-ahead the booking (and the forward's inflight/admitted
         # commit) to the standby's per-pool WAL segment: an adoption right
@@ -944,6 +966,151 @@ class LMPoolManager:
                     merged[k] = v
         return merged
 
+    # -- DistServe KV handoff (ISSUE 18) -----------------------------------
+
+    def kv_handoff(self, name: str, p: dict[str, Any]) -> dict[str, Any]:
+        """Relay a client-initiated ``kv_handoff`` verb to a managed
+        pool's serving node — like ``prefix_op``, the block/radix state
+        lives on the node, the journal only knows the spec. A replica
+        GROUP resolves to its first active replica (any replica can probe
+        or ship; the manager's own routed handoffs pick replicas via
+        ``_route_group_locked``, this path is the debugging/ops surface).
+        A ship must orchestrate FROM the prefill replica's own host: its
+        loop owns the exported blocks."""
+        with self._lock:
+            g = self._groups.get(name)
+            if g is None:
+                pool = self._pools.get(name)
+                if pool is None:
+                    raise ValueError(f"no managed pool {name!r}")
+                targets = [(name, pool["node"])]
+            else:
+                targets = [(r, self._pools[r]["node"])
+                           for r, m in sorted(g["replicas"].items())
+                           if m["state"] == "active" and r in self._pools]
+        targets = [(r, n) for r, n in targets if n is not None]
+        if not targets:
+            raise ValueError(f"{name!r}: no serving node for kv_handoff")
+        rname, node = targets[0]
+        fwd = {k: v for k, v in p.items()
+               if k in ("verb", "op", "tokens", "blobs", "from_depth",
+                        "start_depth", "target_host", "target_name",
+                        "timeout")}
+        return self._call(node, dict(fwd, name=rname),
+                          scope=pool_scope(name))
+
+    def _handoff_ship(self, name: str, node: str, rid: int,
+                      req: dict[str, Any]) -> None:
+        """DistServe handoff leg: have the journaled PREFILL replica fill
+        the prompt's KV blocks and ship them point-to-point to the decode
+        pool's node BEFORE the request forwards there — the decode
+        admission then hits the grafted radix chain and prefills only the
+        trailing remainder (zero re-prefill for shipped blocks).
+
+        State machine, write-ahead at every edge in BOTH pools' WALs:
+
+            prefilling → shipping → adopted      (happy path)
+                                  ↘ fallback     (any failure — decode-
+                                                  side prefill, request
+                                                  untouched)
+
+        Death semantics: a manager death at prefilling/shipping replays
+        the ship from the adopted journal (the pump re-runs this for
+        pending rows with a non-terminal handoff state — safe because
+        kv_handoff is naturally idempotent: re-probe + dedup grafts). A
+        PREFILL-replica death fails the ship RPC after retries →
+        fallback. A DECODE-replica death orphans the pool; re-placement
+        resets adopted → prefilling (`_orphan_pool_locked`: the new node
+        holds no blocks) and the recovery re-ships to the new node. The
+        handoff is an optimization layered UNDER the journal: it never
+        completes, fails, or doubles a request by itself."""
+        hop = req.get("handoff") or {}
+        pre_rname = hop.get("from")
+        key = f"{name}:{rid}"
+        with self._lock:
+            pool = self._pools.get(name)
+            live = pool["requests"].get(rid) if pool else None
+            lhop = (live or {}).get("handoff")
+            if (live is None or live["status"] != _PENDING
+                    or not lhop
+                    or lhop.get("state") in ("adopted", "fallback")):
+                return
+            pre = self._pools.get(pre_rname)
+            pre_node = pre["node"] if pre is not None else None
+            lhop["state"] = "shipping"
+            if pre is not None:
+                ledger = pre.setdefault("handoffs", {})
+                ledger[key] = "shipping"
+                # bounded ledger: oldest entries age out first (terminal
+                # states carry no replay value; live ones are re-entered
+                # by the pump from the decode side anyway)
+                while len(ledger) > 128:
+                    del ledger[next(iter(ledger))]
+        if pre_node is None or pre_node == node:
+            # prefill replica unplaced/gone, or colocated with the target
+            # (same node serves both loops: its blocks are already local
+            # only in the prefill POOL's tree, not the decode pool's — a
+            # self-ship over loopback still works, but a colocated pair
+            # means the role split degenerated; just prefill in place)
+            self._handoff_done(name, rid, pre_rname, "fallback")
+            return
+        # write-ahead the SHIPPING edge to both scopes' WAL segments
+        # before the RPC: an adopter replays the ship, never wonders
+        # whether it ran (idempotent either way)
+        self._replicate_pool(name)
+        if pre_rname != name:
+            self._replicate_pool(pre_rname)
+        payload = {"verb": "kv_handoff", "op": "ship", "name": pre_rname,
+                   "target_host": node, "target_name": name,
+                   "tokens": list(req["prompt"])}
+        sp = None
+        tr = req.get("trace")
+        if self.spans is not None and tr:
+            sp = self.spans.start(
+                "lm.handoff_ship", trace=tr[0], parent=tr[1],
+                attrs={"pool": name, "rid": rid, "prefill": pre_rname,
+                       "node": pre_node})
+            stamp_trace(payload, sp.ctx)
+        try:
+            out = call_with_retry(
+                lambda: self._call(pre_node, payload,
+                                   scope=pool_scope(pre_rname)))
+        except (TransportError, OSError, ValueError) as e:
+            if sp is not None:
+                self.spans.finish(sp, error=str(e)[:120], fallback=True)
+            if self.service is not None:
+                self.service.metrics.record_counter("kv_handoff_fallbacks")
+            self._handoff_done(name, rid, pre_rname, "fallback")
+            return
+        if sp is not None:
+            self.spans.finish(sp, shipped=int(out.get("shipped", 0)),
+                              bytes=int(out.get("bytes", 0)))
+        self._handoff_done(name, rid, pre_rname, "adopted",
+                           shipped=int(out.get("shipped", 0)),
+                           nbytes=int(out.get("bytes", 0)))
+
+    def _handoff_done(self, name: str, rid: int, pre_rname: str | None,
+                      state: str, shipped: int = 0,
+                      nbytes: int = 0) -> None:
+        """Commit a terminal handoff edge to both journals + WALs."""
+        key = f"{name}:{rid}"
+        with self._lock:
+            pool = self._pools.get(name)
+            live = pool["requests"].get(rid) if pool else None
+            hop = (live or {}).get("handoff")
+            if hop is not None:
+                hop["state"] = state
+                hop["shipped"] = int(shipped)
+                hop["bytes"] = int(nbytes)
+            pre = (self._pools.get(pre_rname)
+                   if pre_rname is not None else None)
+            if pre is not None and key in pre.get("handoffs", {}):
+                pre["handoffs"][key] = state
+        if pool is not None:
+            self._replicate_pool(name)
+        if pre is not None and pre_rname != name:
+            self._replicate_pool(pre_rname)
+
     def stop(self, name: str) -> dict[str, Any]:
         with self._lock:
             is_group = name in self._groups
@@ -1113,8 +1280,10 @@ class LMPoolManager:
                 "idem": {}, "decisions": [], "next_seq": 0,
                 "t_last_decision": 0.0,
                 # prefill-heavy admission fraction since group creation:
-                # feeds the autoscaler's role-split spawn choice
-                "route_counts": {"total": 0, "prefill": 0}}
+                # feeds the autoscaler's role-split spawn choice.
+                # "handoff" counts the prefill-heavy subset served in
+                # DistServe handoff mode (ISSUE 18)
+                "route_counts": {"total": 0, "prefill": 0, "handoff": 0}}
         self._claim_scope(pool_scope(name))
         spawned = []
         for _ in range(policy.min_replicas):
@@ -1300,12 +1469,25 @@ class LMPoolManager:
         return decision
 
     def _route_group_locked(self, g: dict[str, Any], prompt_len: int,
-                            tenant: str) -> str:
-        """Replica for a new admission: prefill-heavy prompts (length >=
-        prefill_len_threshold — serve/admission.py:is_prefill_heavy) go
-        to the prefill replica when one is active; everything else is
-        tenant-sticky on decode replicas, new tenants landing on the
-        least-WFQ-debt one."""
+                            tenant: str) -> tuple[str, str | None]:
+        """Replica for a new admission, as ``(target, handoff_from)``.
+
+        Prefill-heavy prompts (length >= prefill_len_threshold —
+        serve/admission.py:is_prefill_heavy) with an active prefill
+        replica go one of two ways (ISSUE 18):
+
+        - **handoff mode** (the group also has an active DECODE replica
+          and the spec carries a KV block pool): the request is routed
+          to its tenant-sticky decode replica, and ``handoff_from``
+          names the prefill replica that will fill + ship the KV blocks
+          there first (``_handoff_ship``) — true DistServe, the decode
+          replica never pays the prefill.
+        - **whole-request mode** (no block pool, or prefill-only
+          group): the prefill replica serves the request end to end,
+          the pre-ISSUE-18 behavior.
+
+        Everything else is tenant-sticky on decode replicas, new tenants
+        landing on the least-WFQ-debt one."""
         from idunno_tpu.serve.admission import is_prefill_heavy
         policy = AutoscalePolicy.from_wire(g["policy"])
         active = sorted((r for r, m in g["replicas"].items()
@@ -1323,21 +1505,32 @@ class LMPoolManager:
                 f"group {g['spec'].get('name')!r} has no placed "
                 "replica yet; still starting; retry shortly")
         g["route_counts"]["total"] += 1
+        decode = [r for r in active
+                  if g["replicas"][r]["role"] == "decode"] or active
+
+        def sticky() -> str:
+            assigned = g["tenants"].get(tenant)
+            if assigned in decode:
+                return assigned
+            debts = self._group_debts_locked(g, decode)
+            target = min(decode, key=lambda r: (debts[r], r))
+            g["tenants"][tenant] = target
+            return target
+
         if is_prefill_heavy(prompt_len, policy.prefill_len_threshold):
             g["route_counts"]["prefill"] += 1
             pre = [r for r in active
                    if g["replicas"][r]["role"] == "prefill"]
+            has_decode = any(g["replicas"][r]["role"] == "decode"
+                             for r in active)
+            if pre and has_decode \
+                    and int(g["spec"].get("kv_block_size") or 0) > 0:
+                g["route_counts"]["handoff"] = (
+                    g["route_counts"].get("handoff", 0) + 1)
+                return sticky(), pre[0]
             if pre:
-                return pre[0]
-        decode = [r for r in active
-                  if g["replicas"][r]["role"] == "decode"] or active
-        assigned = g["tenants"].get(tenant)
-        if assigned in decode:
-            return assigned
-        debts = self._group_debts_locked(g, decode)
-        target = min(decode, key=lambda r: (debts[r], r))
-        g["tenants"][tenant] = target
-        return target
+                return pre[0], None
+        return sticky(), None
 
     def _group_submit(self, name: str, prompt: list[int], max_new: int,
                       *, temperature: float, top_p: float, top_k: int,
@@ -1359,7 +1552,8 @@ class LMPoolManager:
                 prior = g["idem"].get(idem_key)
                 if prior is not None:
                     return int(prior)
-            rname = self._route_group_locked(g, len(prompt), str(tenant))
+            rname, pre_rname = self._route_group_locked(
+                g, len(prompt), str(tenant))
             grid = g["next_grid"]
             g["next_grid"] += 1
             if idem_key is not None:
@@ -1372,7 +1566,8 @@ class LMPoolManager:
                 frequency_penalty=frequency_penalty, stop=stop,
                 seed=seed if seed is not None else grid,
                 tenant=tenant, priority=priority,
-                deadline_ms=deadline_ms, idem_key=None, trace=trace)
+                deadline_ms=deadline_ms, idem_key=None, trace=trace,
+                handoff_from=pre_rname)
         except BaseException:
             with self._lock:
                 g2 = self._groups.get(name)
@@ -1514,6 +1709,9 @@ class LMPoolManager:
                 "decisions": [dict(d) for d in g["decisions"][-10:]],
                 "decisions_total": g["next_seq"]}
             replicas = sorted(g["replicas"], key=self._replica_index)
+        # forecast gauges (ISSUE 18): the predictive scale-ahead's view
+        # of this group — predicted arrival rate + spawns it triggered
+        group_block["forecast"] = self.autoscaler.forecast_view(name)
         out: dict[str, Any] = {"group": group_block, "replicas": {}}
         for r in replicas:
             try:
@@ -1613,6 +1811,7 @@ class LMPoolManager:
         out: dict[str, Any] = {}
         for r, node, backlog in targets:
             p95, n = 0.0, 0
+            admitted: dict[str, int] = {}
             if node is not None:
                 try:
                     qos = self._call(
@@ -1620,11 +1819,17 @@ class LMPoolManager:
                         timeout=10.0).get("qos")
                 except (TransportError, ValueError, OSError):
                     qos = None
-                w = (((qos or {}).get("classes") or {})
-                     .get("interactive") or {}).get("queue_wait_s") or {}
+                classes = (qos or {}).get("classes") or {}
+                w = (classes.get("interactive") or {}).get(
+                    "queue_wait_s") or {}
                 p95 = float(w.get("p95", 0.0))
                 n = int(w.get("n", 0))
-            out[r] = {"interactive_p95": p95, "n": n, "backlog": backlog}
+                # cumulative per-class admissions: the predictive
+                # scale-ahead's arrival-rate signal (ISSUE 18)
+                admitted = {c: int((cls or {}).get("admitted", 0))
+                            for c, cls in classes.items()}
+            out[r] = {"interactive_p95": p95, "n": n,
+                      "backlog": backlog, "admitted": admitted}
         return out
 
     def _ensure_group_replicas(self) -> None:
@@ -1854,6 +2059,12 @@ class LMPoolManager:
                 self._recover_pool(name)
                 continue
             for rid, req in pending:
+                ho = req.get("handoff")
+                if ho and ho.get("state") in ("prefilling", "shipping"):
+                    # replay-or-fallback: a death (ours or a peer's) mid-
+                    # handoff left the journaled state non-terminal — the
+                    # ship is idempotent, re-run it before the forward
+                    self._handoff_ship(name, node, rid, req)
                 self._forward(name, node, rid, req)
             self._drain(name, node)
         for name, node in jobs:
@@ -2228,6 +2439,14 @@ class LMPoolManager:
                 # the recovery rebuild's recompile must not eat into its
                 # per-request suspicion budget (ADVICE r3)
                 req["attempts"] = 0
+            # a handoff adopted INTO the dead node is gone with it: the
+            # re-placed pool holds no blocks, so re-enter the state
+            # machine (the recovery re-ships to the new node; fallback
+            # rows stay terminal — the prefill side already failed once)
+            hop = req.get("handoff")
+            if (hop and req["status"] == _PENDING
+                    and hop.get("state") in ("shipping", "adopted")):
+                hop["state"] = "prefilling"
 
     def _recover_pool(self, name: str) -> None:
         """Re-establish an orphaned pool on a survivor and resubmit every
@@ -2277,6 +2496,9 @@ class LMPoolManager:
                 self._stop_stale_loop(node, name)
                 return
             for rid, req in pending:
+                ho = req.get("handoff")
+                if ho and ho.get("state") in ("prefilling", "shipping"):
+                    self._handoff_ship(name, node, rid, req)
                 self._forward(name, node, rid, req)
         finally:
             with self._lock:
@@ -2339,6 +2561,7 @@ class LMPoolManager:
                 "slots_now": p["slots_now"],
                 "slots_cap": p["slots_cap"],
                 "idem": dict(p.get("idem", {})),
+                "handoffs": dict(p.get("handoffs", {})),
                 "requests": {str(rid): dict(r) for rid, r
                              in p["requests"].items()}}
 
@@ -2365,6 +2588,8 @@ class LMPoolManager:
                 "t_last_resize": 0.0,
                 "idem": {k: int(v) for k, v
                          in p.get("idem", {}).items()},
+                "handoffs": {str(k): str(v) for k, v
+                             in p.get("handoffs", {}).items()},
                 # defaults first: a snapshot from an older master may
                 # predate the watchdog/measurement fields
                 "requests": {int(rid): {"t_forwarded": None,
@@ -2375,6 +2600,7 @@ class LMPoolManager:
                                         "priority": "interactive",
                                         "deadline_ms": None,
                                         "admitted": False,
+                                        "handoff": None,
                                         "trace": None, **dict(r)}
                              for rid, r in p["requests"].items()}}
 

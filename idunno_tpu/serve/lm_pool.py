@@ -269,6 +269,32 @@ class LMServingLoop:
             raise ValueError(f"prefix_{op}: {out}") from out
         return out
 
+    def handoff_op(self, op: str, timeout: float = 30.0, **kw) -> dict:
+        """Run a DistServe KV-handoff operation ("probe" | "export" |
+        "adopt" | "fallback") on the LOOP thread — handoff export/adopt
+        walk the radix tree and block pool, which are loop-thread-owned,
+        so RPC handlers marshal exactly like `prefix_op` (the two op
+        families share the serialized request/response channel). Gated
+        on the block tier, NOT the cluster prefix cache: a handoff is
+        point-to-point and needs no SDFS ring."""
+        if self.server._radix is None:
+            raise ValueError("pool has no KV block tier "
+                             "(serve with kv_block_size > 0)")
+        with self._prefix_serial:
+            self._prefix_done.clear()
+            self._prefix_req = (f"handoff_{op}", kw)
+            self._prefix_want.set()
+            self._wake.set()
+            if not self._prefix_done.wait(timeout):
+                self._prefix_want.clear()
+                self._prefix_req = None
+                raise ValueError(f"kv_handoff {op} timed out after "
+                                 f"{timeout}s")
+            out = self._prefix_out
+        if isinstance(out, Exception):
+            raise ValueError(f"kv_handoff {op}: {out}") from out
+        return out
+
     def note_tenant(self, tokens: list[int], tenant: str) -> None:
         """Record (prompt head → tenant) for publish attribution; the
         loop thread drains the box into the cluster cache."""
@@ -408,6 +434,14 @@ class LMServingLoop:
                 out = self.server.prefix_probe(**kw)
             elif op == "fetch":
                 out = self.server.prefix_warm(**kw)
+            elif op == "handoff_probe":
+                out = self.server.handoff_probe(**kw)
+            elif op == "handoff_export":
+                out = self.server.handoff_export(**kw)
+            elif op == "handoff_adopt":
+                out = self.server.handoff_adopt(**kw)
+            elif op == "handoff_fallback":
+                out = self.server.handoff_fallback(**kw)
             else:
                 out = ValueError(f"unknown prefix op {op!r}")
         except Exception as e:  # noqa: BLE001 - waiter must not hang

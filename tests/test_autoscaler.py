@@ -366,6 +366,128 @@ def test_group_decisions_are_epoch_stamped():
         assert d["seq"] >= 0 and "t" in d
 
 
+# -- predictive scale-ahead (ISSUE 18) ------------------------------------
+
+def scripted_admitted(mgr, admitted, p95=0.0, backlog=0):
+    """Gauges with the gateway's cumulative admitted counter; ``admitted``
+    is a 1-element list so tests can script the arrival process."""
+    def fn(name):
+        with mgr._lock:
+            g = mgr._groups[name]
+            return {r: {"interactive_p95": p95, "n": 8,
+                        "backlog": backlog,
+                        "admitted": {"interactive": admitted[0]}}
+                    for r, meta in g["replicas"].items()
+                    if meta["state"] == "active"}
+    mgr.autoscaler.gauges_fn = fn
+
+
+PREDICT = {"deadline_slack_s": 10.0, "dwell_s": 1.0, "max_replicas": 3,
+           "predict_horizon_s": 6.0, "predict_capacity_rps": 1.0}
+
+
+def test_predict_policy_fields_validate_and_roundtrip():
+    p = AutoscalePolicy(predict_horizon_s=6.0, predict_alpha=0.4,
+                        predict_beta=0.2, predict_capacity_rps=2.0)
+    assert AutoscalePolicy.from_wire(p.to_wire()) == p
+    with pytest.raises(ValueError, match="predict_horizon_s"):
+        AutoscalePolicy(predict_horizon_s=-1.0)
+    with pytest.raises(ValueError, match="smoothing"):
+        AutoscalePolicy(predict_alpha=0.0)
+    with pytest.raises(ValueError, match="predict_capacity_rps"):
+        AutoscalePolicy(predict_capacity_rps=0.0)
+
+
+def test_ramp_spawns_before_reactive_breach():
+    m, transport, clk = make_mgr(PREDICT)
+    adm = [0]
+    # p95 stays FAR below the reactive slack the whole time: only the
+    # trend-following forecast can justify the spawn
+    scripted_admitted(m, adm, p95=0.1)
+    clk[0] = 10.0
+    assert m.autoscaler.tick() == []          # seeds the filter
+    clk[0], adm[0] = 12.0, 1                  # 0.5 req/s: under capacity
+    assert m.autoscaler.tick() == []
+    clk[0], adm[0] = 14.0, 4                  # accelerating ramp
+    out = m.autoscaler.tick()
+    assert [d["action"] for d in out] == ["spawn"]
+    assert out[0]["predictive"] is True
+    # level = .5*1.5+.5*.5 = 1.0; trend = .3*(.5/2) = .075; +6 s horizon
+    assert out[0]["predicted_rate"] == pytest.approx(1.45)
+    assert any(p.get("name") == "grp@r1" for _, p in transport.serves())
+    view = m.autoscaler.forecast_view("grp")
+    assert view["predicted_rate"] == pytest.approx(1.45)
+    assert view["predictive_spawns"] == 1
+
+
+def test_cold_start_single_sample_never_spawns():
+    # Holt init: the FIRST rate sample seeds the level with zero trend —
+    # a lone sub-capacity arrival batch after (re)seed must not look
+    # like a ramp (deriving a trend against the zero seed used to)
+    m, _, clk = make_mgr(PREDICT)
+    adm = [0]
+    scripted_admitted(m, adm)
+    clk[0] = 10.0
+    m.autoscaler.tick()
+    clk[0], adm[0] = 12.0, 2                  # exactly capacity: 1 req/s
+    assert m.autoscaler.tick() == []
+    assert m.autoscaler.forecast_view("grp")["predicted_rate"] \
+        == pytest.approx(1.0)
+
+
+def test_decay_lifts_scale_in_suppression_never_below_reactive():
+    m, _, clk = make_mgr(PREDICT)
+    m.group_spawn("grp")                      # two active replicas
+    adm = [0]
+    # seed under load (backlog up, p95 in the keep band) so the seeding
+    # tick itself takes no scale-in decision
+    scripted_admitted(m, adm, p95=3.0, backlog=2)
+    clk[0] = 10.0
+    assert m.autoscaler.tick() == []
+    # burst: each replica reports admitted=2 → 2 req/s across the group,
+    # exactly the two actives' capacity (no third spawn) but more than
+    # ONE replica could sustain
+    clk[0], adm[0] = 12.0, 2
+    scripted_admitted(m, adm, backlog=0)
+    # idle by every reactive signal (backlog 0, p95 0) — but the
+    # forecast says one replica could not hold it: scale-in suppressed
+    assert m.autoscaler.tick() == []
+    assert len([r for r, meta in m._groups["grp"]["replicas"].items()
+                if meta["state"] == "active"]) == 2
+    clk[0] = 14.0                             # burst over: rate 0
+    out = m.autoscaler.tick()                 # pred decays under 1.0
+    assert [d["action"] for d in out] == ["retire_start"]
+
+
+def test_counter_regression_reseeds_instead_of_spawning():
+    m, _, clk = make_mgr(PREDICT)
+    adm = [0]
+    scripted_admitted(m, adm)
+    clk[0] = 10.0
+    m.autoscaler.tick()
+    clk[0], adm[0] = 12.0, 1                  # 0.5 req/s: level seeds
+    m.autoscaler.tick()
+    # failover rebuilt the gateway: cumulative counter went BACKWARD
+    clk[0], adm[0] = 14.0, 0
+    assert m.autoscaler.tick() == []          # reseed, no negative rate
+    assert m.autoscaler.forecast_view("grp") \
+        == {"predicted_rate": 0.0, "predictive_spawns": 0}
+
+
+def test_horizon_zero_disables_and_clears_forecast_state():
+    m, _, clk = make_mgr({"deadline_slack_s": 10.0, "dwell_s": 1.0,
+                          "max_replicas": 3})
+    adm = [0]
+    scripted_admitted(m, adm)
+    clk[0] = 10.0
+    m.autoscaler._forecast["grp"] = {"t": 0.0, "admitted": 0,
+                                     "level": 9.0, "trend": 9.0,
+                                     "predicted": 99.0, "spawns": 0}
+    m.autoscaler.tick()
+    # horizon 0 (the default): stale state dropped, pure reactive loop
+    assert "grp" not in m.autoscaler._forecast
+
+
 # -- group client surface -------------------------------------------------
 
 def test_group_submit_poll_cancel_roundtrip():

@@ -535,3 +535,176 @@ def test_cluster_prefix_survives_serving_node_death(tmp_path):
     assert (summary.get("prefix_remote_hits", 0) >= 1
             or summary.get("prefix_warmed", 0) >= 1)
     assert summary["lmp_acked"] >= 2
+
+
+# -- DistServe KV handoff (ISSUE 18) --------------------------------------
+
+
+def _dsg_view(c):
+    """(owner, {role: replica}, {replica: node}) for the distserve group,
+    read from whichever manager holds its journal right now — the
+    claimed owner in a survivor's gossiped view wins over a deposed
+    holder's stale journal."""
+    from idunno_tpu.membership.epoch import pool_scope
+    claim = c.members["n0"].owners.owner(pool_scope(c.LM_GROUP_D))
+    hosts = (([claim] if claim else [])
+             + [h for h in c.cfg.hosts if h != claim])
+    owner = next(h for h in hosts
+                 if c.LM_GROUP_D in c.managers[h]._groups)
+    mgr = c.managers[owner]
+    with mgr._lock:
+        g = mgr._groups[c.LM_GROUP_D]
+        roles = {m["role"]: r for r, m in g["replicas"].items()}
+        nodes = {r: (mgr._pools.get(r) or {}).get("node")
+                 for r in g["replicas"]}
+    return owner, roles, nodes
+
+
+def _dsg_handoff_states(c):
+    """{rid: handoff state} over every replica pool of the group."""
+    owner, _, _ = _dsg_view(c)
+    mgr = c.managers[owner]
+    out = {}
+    with mgr._lock:
+        g = mgr._groups[c.LM_GROUP_D]
+        for r in g["replicas"]:
+            pool = mgr._pools.get(r)
+            if pool is None:
+                continue
+            for rid, q in pool["requests"].items():
+                hop = q.get("handoff")
+                if hop:
+                    out[(r, rid)] = hop["state"]
+    return out
+
+
+def test_distserve_seeded_schedule_invariants(tmp_path):
+    """The full seeded fault surface with the role-split handoff group on
+    (ISSUE 18): long-prompt submissions route in handoff mode, the
+    manager journals prefilling→shipping→adopted edges and ships real
+    KVC1 blobs between the fake loops. Exactly-once delivery and
+    terminal handoff states are asserted inside check_invariants; this
+    seed is known to exercise real ships, not just fallbacks."""
+    out = run_seeded_schedule(1, str(tmp_path), steps=40, distserve=True)
+    assert out["lmh_acked"] >= 1
+    assert out["handoff_routed"] >= 1
+    assert out["handoff_blocks_shipped"] >= 3     # at least one real ship
+
+
+def test_distserve_lost_ship_ack_replays_delta_only(tmp_path):
+    """A ship whose reply is lost (handler RAN — the decode node holds
+    the blocks — but the manager saw a timeout) must replay, and the
+    replay's probe must see the full chain and ship NOTHING (delta-only:
+    the dedupe that makes kv_handoff naturally idempotent). The request
+    reaches exactly one terminal state either way."""
+    c = ChaosCluster(901, str(tmp_path), distserve=True)
+    owner, roles, nodes = _dsg_view(c)
+    pre_node = nodes[roles["prefill"]]
+    dec_node = nodes[roles["decode"]]
+    assert pre_node != dec_node, "placement colocated; seed unusable"
+    # the ship RPC is owner -> prefill node: lose its reply once
+    c.net.lose_next_reply(owner, pre_node)
+    c.op_lm_handoff("n2")
+    states = _dsg_handoff_states(c)
+    assert list(states.values()) == ["adopted"], states
+    # the handler ran exactly once worth of adopts: 3 blocks, not 6
+    dec_loop = c.controls[dec_node]._loops[roles["decode"]]
+    assert dec_loop["adopted"] == 3, dec_loop["adopted"]
+    c.converge()
+    summary = c.check_invariants()
+    assert summary["lmh_acked"] == 1
+    assert summary["handoff_blocks_adopted"] == 3
+
+
+def test_distserve_prefill_unreachable_falls_back(tmp_path):
+    """Death-of-prefill-endpoint mid-handoff: the prefill node cannot
+    reach the decode node, so the ship's adopt RPC dies after retries →
+    the manager journals the FALLBACK edge (decode-side prefill) and the
+    request still completes exactly once after heal — never lost, never
+    doubled, no blocks grafted on the decode side."""
+    c = ChaosCluster(902, str(tmp_path), distserve=True)
+    owner, roles, nodes = _dsg_view(c)
+    pre_node = nodes[roles["prefill"]]
+    dec_node = nodes[roles["decode"]]
+    assert pre_node != dec_node, "placement colocated; seed unusable"
+    c.net.partition(pre_node, dec_node)
+    c.op_lm_handoff("n2")
+    states = _dsg_handoff_states(c)
+    assert list(states.values()) == ["fallback"], states
+    dec_loop = c.controls[dec_node]._loops[roles["decode"]]
+    assert dec_loop["adopted"] == 0, "fallback must not graft blocks"
+    c.converge()
+    summary = c.check_invariants()
+    assert summary["lmh_acked"] == 1
+    # delivered exactly once through the decode-side prefill path
+    assert summary["lm_delivered"] >= 1
+
+
+def test_distserve_death_of_prefill_node_mid_schedule(tmp_path):
+    """Kill the host serving the PREFILL replica (which here also owns
+    the group's journal — the harder variant: scope adoption + pool
+    re-placement + handoff replay all ride the same death). A post-death
+    handoff submission must still reach exactly one terminal state on
+    the adopted journal."""
+    c = ChaosCluster(903, str(tmp_path), distserve=True)
+    # claims need ~3 gossip waves to reach every node BEFORE the death,
+    # or the survivors have no scope to adopt; one work pump ships WALs
+    c.pump_membership(waves=3)
+    c.pump_work()
+    owner0, roles0, nodes0 = _dsg_view(c)
+    pre_node = nodes0[roles0["prefill"]]
+    assert pre_node == owner0, "seed expectation: prefill colocated " \
+        "with the journal owner (the harder death)"
+    c.op_isolate(pre_node)
+    # peer-detected death + scope adoption + re-place: ~15 pump rounds
+    for _ in range(15):
+        c.pump_membership(waves=1)
+        c.pump_work()
+        c.record_fences()
+    owner1, roles1, nodes1 = _dsg_view(c)
+    assert owner1 != owner0, "scope never adopted off the dead owner"
+    assert all(n != pre_node for n in nodes1.values() if n), nodes1
+    c.op_lm_handoff("n2")
+    for _ in range(3):
+        c.pump_membership(waves=1)
+        c.pump_work()
+        c.record_fences()
+    states = _dsg_handoff_states(c)
+    assert states and all(s in ("adopted", "fallback")
+                          for s in states.values()), states
+    c.converge()
+    summary = c.check_invariants()
+    assert summary["lmh_acked"] == 1
+
+
+def test_distserve_death_of_decode_node_mid_handoff(tmp_path):
+    """Kill the decode node AFTER the blocks were shipped and adopted but
+    BEFORE the completion is delivered: re-placement resets the journaled
+    handoff state (the new node holds no blocks), recovery re-ships to
+    the new node, and the request completes exactly once — the shipped
+    chain dies with the node, the request does not."""
+    c = ChaosCluster(904, str(tmp_path), distserve=True)
+    c.pump_work()
+    owner, roles, nodes = _dsg_view(c)
+    pre_node = nodes[roles["prefill"]]
+    dec_node = nodes[roles["decode"]]
+    assert pre_node != dec_node, "placement colocated; seed unusable"
+    c.op_lm_handoff("n2")
+    states = _dsg_handoff_states(c)
+    assert list(states.values()) == ["adopted"], states
+    # completion is parked on dec_node's loop, undelivered: kill it now
+    c.op_isolate(dec_node)
+    for _ in range(15):
+        c.pump_membership(waves=1)
+        c.pump_work()
+        c.record_fences()
+    owner1, roles1, nodes1 = _dsg_view(c)
+    new_dec = nodes1[roles1["decode"]]
+    assert new_dec != dec_node, "decode pool never re-placed"
+    c.converge()
+    summary = c.check_invariants()
+    assert summary["lmh_acked"] == 1
+    # the ledger proves exactly-once even though two loops completed the
+    # request (only the re-placed node's journal delivers)
+    states = _dsg_handoff_states(c)
+    assert all(s in ("adopted", "fallback") for s in states.values())

@@ -365,3 +365,62 @@ def test_traced_expiry_waterfall_is_fake_clock_exact(lm):
         assert rows["lm.expire"]["attrs"]["reason"] == "expired"
     finally:
         loop.stop()
+
+
+def test_handoff_waterfall_is_fake_clock_exact(lm):
+    """ISSUE 18: the DistServe handoff hops span under the client context
+    on the same injected clock — export on the prefill replica at the
+    +100 ms we advanced, adopt on the decode replica at +350 ms, every
+    waterfall offset exact fake-clock arithmetic and every span attr
+    equal to the verb's own return values."""
+    from idunno_tpu.engine.serve_lm import DecodeServer
+    from idunno_tpu.utils.spans import SpanStore
+    from tools.trace_export import waterfall
+
+    model, params = lm
+    clk = FakeClock(300.0)
+    spans_p = SpanStore("pf0", clock=clk)
+    spans_d = SpanStore("dc0", clock=clk)
+    kw = dict(slots=2, prompt_len=8, max_len=24,
+              kv_block_size=2, kv_cache_blocks=16)
+    pre = DecodeServer(model, params, **kw)
+    dec = DecodeServer(model, params, **kw)
+    pre.spans, dec.spans = spans_p, spans_d
+
+    prompt = [7, 3, 9, 4, 11, 2, 6, 5]
+    root = spans_p.start("client.kv_handoff")
+    clk.advance(0.1)
+    exp = pre.handoff_export(prompt, from_depth=0, trace=root.ctx)
+    clk.advance(0.25)
+    got = dec.handoff_adopt(prompt, exp["blobs"], 0, trace=root.ctx)
+    clk.advance(0.05)
+    spans_p.finish(root)
+
+    raw = (spans_p.dump(trace_id=root.trace_id)
+           + spans_d.dump(trace_id=root.trace_id))
+    by_name = {s["name"]: s for s in raw}
+    assert set(by_name) == {"client.kv_handoff", "lm.handoff_export",
+                            "lm.handoff_adopt"}
+    ship = by_name["lm.handoff_export"]
+    graft = by_name["lm.handoff_adopt"]
+    assert ship["parent"] == root.span_id and ship["node"] == "pf0"
+    assert graft["parent"] == root.span_id and graft["node"] == "dc0"
+    # attrs mirror the verbs' own return values, field for field
+    assert exp["blocks"] == 3 and exp["bytes"] > 0
+    assert ship["attrs"] == {"blocks": exp["blocks"], "from_depth": 0,
+                             "bytes": exp["bytes"]}
+    assert graft["attrs"] == {"blocks": got["adopted"],
+                              "wrote": got["wrote"], "start_depth": 0,
+                              "bytes": got["bytes"],
+                              "depth": got["depth"]}
+    assert got["depth"] == exp["blocks"], "whole shipped chain grafted"
+
+    wf = waterfall(root.trace_id, raw)
+    rows = {r["name"]: r for r in wf["rows"]}
+    assert rows["lm.handoff_export"]["offset_ms"] == 100.0
+    assert rows["lm.handoff_export"]["ms"] == 0.0
+    assert rows["lm.handoff_adopt"]["offset_ms"] == 350.0
+    assert rows["lm.handoff_adopt"]["ms"] == 0.0
+    assert rows["client.kv_handoff"]["ms"] == 400.0
+    assert wf["duration_ms"] == 400.0
+    assert wf["nodes"] == ["dc0", "pf0"]

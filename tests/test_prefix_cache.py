@@ -892,3 +892,143 @@ def test_cluster_miss_degrades_never_fails(lm):
     assert st["prefix_remote_hits"] == 0
     assert srv.cluster_prefix.errors > 0
     assert st["hits"] >= 3, "local radix hits must be untouched"
+
+
+# -- DistServe KV-block handoff, prefill → decode (ISSUE 18) ----------------
+
+
+def handoff_pair(model, params, **kw):
+    """Prefill replica + decode replica, transport-direct (no ring): the
+    two-pool shape `serve/lm_manager.py:_handoff_ship` drives via the
+    `kv_handoff` verb."""
+    spec = dict(slots=2, prompt_len=8, max_len=24, kv_block_size=BS,
+                kv_cache_blocks=16)
+    spec.update(kw)
+    return DecodeServer(model, params, **spec), \
+        DecodeServer(model, params, **spec)
+
+
+def ship(pre, dec, prompt):
+    """One probe→export→adopt round trip, the manager's ship leg."""
+    d0 = dec.handoff_probe(prompt)["depth"]
+    exp = pre.handoff_export(prompt, from_depth=d0)
+    return dec.handoff_adopt(prompt, exp["blobs"], start_depth=d0), exp
+
+
+@pytest.mark.parametrize("kernel", [None, "xla", "pallas"])
+@pytest.mark.parametrize("kind", ["mha", "gqa"])
+def test_handoff_token_exact_matrix(lm, kind, kernel):
+    """The ISSUE 18 exactness matrix: a prompt prefilled on one replica
+    and shipped block-by-block to another must decode token-for-token
+    like `generate` — at every local hit depth (cold, partial-block,
+    multi-block, full resubmit), for MHA and GQA pools, gathered and
+    both paged kernels. The full-resubmit row doubles as the delta-ship
+    proof: the probe reports the chain present, so the export ships
+    ZERO blobs and no bytes move."""
+    if kind == "gqa":
+        model = TransformerLM(vocab=VOCAB, dim=32, depth=2, num_heads=4,
+                              num_kv_heads=2)
+        params = model.init(jax.random.PRNGKey(1),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+    else:
+        model, params = lm
+    kw = {"paged_kernel": kernel} if kernel else {}
+    pre, dec = handoff_pair(model, params, **kw)
+    prompts = hit_depth_prompts(np.random.default_rng(3))
+    shipped_bytes = 0
+    for i, (prompt, _) in enumerate(prompts):
+        adopt, exp = ship(pre, dec, prompt)
+        shipped_bytes += exp["bytes"]
+        if i < 2:   # cold chain / divergent tail: blocks move
+            assert exp["blocks"] > 0 and adopt["wrote"] > 0, i
+        else:       # rows 2-3 share their whole usable head with row 0:
+            # the probe sees it held and the export ships NOTHING
+            assert exp["blocks"] == 0 and exp["bytes"] == 0, \
+                "delta-only ship: a held chain must ship nothing"
+        assert adopt["depth"] >= (len(prompt) - 1) // BS
+        rid = dec.submit(prompt, max_new=6)
+        done = {c.id: c for c in dec.run_until_drained()}
+        assert done[rid].tokens == expected(model, params, prompt, 6), \
+            f"{kind}/{kernel}: handed-off request diverged at row {i}"
+    assert dec.stats()["kv_handoff_bytes"] == shipped_bytes
+    # the gauge counts SHIPS: the two zero-delta exports are free
+    assert pre.stats()["kv_handoff_requests"] == 2
+    assert pre.stats()["tokens_generated"] == 0, \
+        "the prefill replica must never decode a shipped request"
+
+
+def test_handoff_zero_reprefill_for_shipped_blocks(lm):
+    """The acceptance claim, structurally: after the adopt, the decode
+    replica's admission prefills ONLY the sub-block suffix — the same
+    bucket-drop oracle as the cluster cache — and a replayed adopt
+    converges (writes nothing new) instead of doubling blocks."""
+    model, params = lm
+    pre, dec = handoff_pair(model, params, prompt_buckets=(2, 4, 8))
+    p = [4, 9, 14, 19, 24, 29, 34, 39]
+    adopt, exp = ship(pre, dec, p)
+    assert adopt["wrote"] == 3 and adopt["depth"] == 3
+    # replay (duplicated ship after a mid-handoff death): same state
+    adopt2 = dec.handoff_adopt(p, exp["blobs"], start_depth=exp["depth"])
+    assert adopt2["wrote"] == 0 and adopt2["depth"] == 3
+    rid = dec.submit(p, max_new=2)
+    done = {c.id: c for c in dec.run_until_drained()}
+    assert done[rid].tokens == expected(model, params, p, 2)
+    assert dec.stats()["prefill_tokens"] == 2, \
+        "shipped 6-token head must drop the cold 8-bucket to the 2-bucket"
+    # the prefill side paid exactly one full-head fill for the ship
+    assert pre.stats()["kv_handoff_requests"] == 1
+    assert pre.stats()["prefill_tokens"] > 0
+
+
+def test_handoff_int8_static_prefix_token_exact(lm):
+    """int8 block scales and a pool-level static prefix ride the same
+    KVC1 encode/graft trip the cluster cache proved — handoff must
+    compose with both."""
+    model = TransformerLM(vocab=VOCAB, dim=32, depth=2, num_heads=4,
+                          kv_cache_dtype="int8")
+    params = model.init(jax.random.PRNGKey(2),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    pre, dec = handoff_pair(model, params, prefix=[20, 21, 22],
+                            max_len=32)
+    for i, (prompt, _) in enumerate(
+            hit_depth_prompts(np.random.default_rng(5))):
+        ship(pre, dec, prompt)
+        rid = dec.submit(prompt, max_new=5)
+        done = {c.id: c for c in dec.run_until_drained()}
+        assert done[rid].tokens == expected(
+            model, params, [20, 21, 22] + prompt, 5), \
+            f"int8+prefix handoff diverged at row {i}"
+
+
+def test_handoff_tp_token_exact(lm, eight_devices):
+    """The matrix's n_model=2 column: exported blobs come off a
+    model-sharded block pool and graft into another — exact at every
+    depth."""
+    model, params = lm
+    pre, dec = handoff_pair(model, params, paged_kernel="xla", n_model=2)
+    assert dec.n_model == 2
+    for i, (prompt, _) in enumerate(
+            hit_depth_prompts(np.random.default_rng(3))):
+        ship(pre, dec, prompt)
+        rid = dec.submit(prompt, max_new=6)
+        done = {c.id: c for c in dec.run_until_drained()}
+        assert done[rid].tokens == expected(model, params, prompt, 6), \
+            f"TP handoff diverged at matrix row {i}"
+
+
+def test_handoff_validation_and_fallback_counter(lm):
+    model, params = lm
+    srv = DecodeServer(model, params, slots=2, prompt_len=8, max_len=24)
+    with pytest.raises(ValueError, match="KV block tier"):
+        srv.handoff_probe([1, 2, 3])
+    pre, dec = handoff_pair(model, params)
+    p = [4, 9, 14, 19, 24, 29, 34, 39]
+    exp = pre.handoff_export(p)
+    # a blob claiming a depth past the prompt's full blocks is refused
+    with pytest.raises(ValueError, match="full blocks"):
+        dec.handoff_adopt(p, exp["blobs"], start_depth=4)
+    # wrong-prompt adoption: the KVC1 token guard refuses the graft
+    with pytest.raises(ValueError, match="token mismatch"):
+        dec.handoff_adopt([9] * 8, exp["blobs"], start_depth=0)
+    assert dec.handoff_fallback()["fallbacks"] == 1
+    assert dec.stats()["kv_handoff_fallbacks"] == 1

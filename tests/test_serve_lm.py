@@ -1112,6 +1112,44 @@ def test_stats_reports_serving_config(lm):
     assert cfg["quantize"] == "none"
 
 
+def test_handoff_lands_mid_serve_all_streams_exact(lm):
+    """DistServe composing with live traffic (ISSUE 18): a long prompt
+    prefilled on a SEPARATE replica ships its block chain into a decode
+    server whose slots are mid-flight on other work — the graft happens
+    between steps, the long admits through the radix hit, and every
+    stream (prior rows, the handed-off long, later arrivals) stays
+    token-exact vs `generate`."""
+    model, params = lm
+    rng = np.random.default_rng(11)
+    kw = dict(slots=2, prompt_len=8, max_len=24, kv_block_size=2,
+              kv_cache_blocks=16)
+    pre = DecodeServer(model, params, **kw)
+    dec = DecodeServer(model, params, **kw)
+    ids = {}
+    for n, m in [(3, 6), (5, 4)]:
+        p = [int(t) for t in rng.integers(0, VOCAB, size=n)]
+        ids[dec.submit(p, max_new=m)] = (p, m)
+    for _ in range(2):
+        dec.step()                            # rows decoding mid-flight
+    long_p = [int(t) for t in rng.integers(0, VOCAB, size=8)]
+    d0 = dec.handoff_probe(long_p)["depth"]
+    exp = pre.handoff_export(long_p, from_depth=d0)
+    adopt = dec.handoff_adopt(long_p, exp["blobs"], start_depth=d0)
+    assert adopt["depth"] == 3 and exp["bytes"] > 0
+    ids[dec.submit(long_p, max_new=6)] = (long_p, 6)
+    p_late = [int(t) for t in rng.integers(0, VOCAB, size=4)]
+    ids[dec.submit(p_late, max_new=5)] = (p_late, 5)
+    done = {c.id: c for c in dec.run_until_drained()}
+    assert set(done) == set(ids)
+    for rid, (p, m) in ids.items():
+        assert done[rid].tokens == expected(model, params, p, m), \
+            f"request {rid} diverged after a mid-serve handoff graft"
+    # gauge surface: the ship is visible on both endpoints' lm_stats
+    assert pre.stats()["kv_handoff_requests"] == 1
+    assert dec.stats()["kv_handoff_bytes"] == exp["bytes"]
+    assert dec.stats()["kv_handoff_fallbacks"] == 0
+
+
 def test_cancel_queued_request(lm):
     """A cancel that lands while the request is still queued drops it
     before admission: its completion carries only the prompt and the

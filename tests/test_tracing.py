@@ -505,9 +505,64 @@ def test_two_node_cluster_collects_lm_trace(tmp_path):
         # ...and the shipped-WAL compaction counter scrapes
         # unconditionally beside the ISSUE 15 byte gauge
         assert 'idunno_gauge{node="n0",name="pool_wal_truncated"}' in text
+        # ISSUE 18: the DistServe handoff gauges ride the same lm_stats
+        # plane (zero-valued until the first ship, but always named on a
+        # kv_block_size pool), and the fallback + predictive-spawn
+        # counters scrape unconditionally
+        for g in ("kv_handoff_requests", "kv_handoff_bytes",
+                  "kv_handoff_fallbacks"):
+            assert f'name="{g}"' in text, g
+        assert 'idunno_events_total{node="n0",name="kv_handoff_fallbacks"}' \
+            in text
+        assert 'idunno_events_total{node="n0",name="predictive_spawns"}' \
+            in text
         remote = _call(nodes["n0"], {"verb": "metrics_export",
                                      "host": "n1"})["text"]
         assert 'node="n1"' in remote
+
+        # ISSUE 18: the kv_handoff verb's op="ship" orchestration on the
+        # REAL control plane (chaos.py mirrors this handler node-locally,
+        # so this is where the production probe→export→adopt RPC chain
+        # actually executes): serve a decode-side pool on n1 off the same
+        # stored model, ship tlm's block chain into it point-to-point,
+        # and collect the handoff trace across both nodes.
+        _call(nodes["n1"], {"verb": "lm_serve", "name": "tlm2",
+                            "model": "tlm", "slots": 2, "prompt_len": 4,
+                            "max_len": 16, "kv_block_size": 2})
+        hroot = nodes["n0"].spans.start("client.kv_handoff")
+        shipped = _call(nodes["n0"], {
+            "verb": "kv_handoff", "op": "ship", "name": "tlm",
+            "target_host": "n1", "target_name": "tlm2",
+            "tokens": [1, 2, 3, 4],
+            "trace": [hroot.trace_id, hroot.span_id]})
+        nodes["n0"].spans.finish(hroot)
+        assert shipped["shipped"] == 1 and shipped["bytes"] > 0
+        # a replayed ship converges: the probe sees the chain held, the
+        # empty delta short-circuits before any adopt RPC
+        again = _call(nodes["n0"], {
+            "verb": "kv_handoff", "op": "ship", "name": "tlm",
+            "target_host": "n1", "target_name": "tlm2",
+            "tokens": [1, 2, 3, 4]})
+        assert again["already"] is True and again["bytes"] == 0
+        hgot = _call(nodes["n0"], {"verb": "trace",
+                                   "trace_id": hroot.trace_id})
+        hby = {s["name"]: s for s in hgot["spans"]}
+        hship = hby["lm.handoff"]
+        assert hship["parent"] == hroot.span_id and hship["node"] == "n0"
+        assert hby["lm.handoff_export"]["parent"] == hship["span_id"]
+        hadopt = hby["lm.handoff_adopt"]
+        assert hadopt["parent"] == hship["span_id"]
+        assert hadopt["node"] == "n1"
+        assert hadopt["attrs"]["blocks"] == shipped["shipped"]
+        # the gauges land on each endpoint's own stats plane: the export
+        # counts the ship on the prefill pool (the zero-delta replay is
+        # free), the adopt counts the bytes on the decode pool
+        pre_stats = _call(nodes["n0"], {"verb": "lm_stats",
+                                        "name": "tlm"})["stats"]
+        dec_stats = _call(nodes["n1"], {"verb": "lm_stats",
+                                        "name": "tlm2"})["stats"]
+        assert pre_stats["kv_handoff_requests"] == 1
+        assert dec_stats["kv_handoff_bytes"] == shipped["bytes"]
     finally:
         for n in nodes.values():
             n.stop()

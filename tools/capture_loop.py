@@ -106,6 +106,14 @@ STEPS = [
      {"BENCH_SUITE": "lm_autoscale", "BENCH_TIME_BUDGET_S": "600"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_lm_autoscale.json"),
+    # ISSUE 18: DistServe KV-block handoff — colocated vs whole-request
+    # role split vs handoff on chip: TTFT, decode-interference p95
+    # inter-token latency, and handoff bytes; the predictive scale-ahead
+    # forecast lead rides in the record's details
+    ("distserve_suite",
+     {"BENCH_SUITE": "lm_distserve", "BENCH_TIME_BUDGET_S": "600"},
+     [sys.executable, "bench.py"],
+     "BENCH_LAST_GOOD_lm_distserve.json"),
     # ISSUE 6: one traced request through a real pool on chip — the
     # admit→queue_wait→prefill→decode_step waterfall with TPU latencies
     # (tools/trace_export.py --capture; cheap: tiny model, one request)

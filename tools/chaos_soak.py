@@ -51,9 +51,15 @@ def main() -> int:
     # inline wrong-token / double-prefill checks on every fetch
     # (ISSUE 17; single-feature seed, replayable in isolation)
     ap.add_argument("--cluster-prefix", type=int, default=1)
-    # second concurrent managed pool from schedule 3 on (0 disables):
+    # DistServe handoff group for schedule 3 (0 disables): role-split
+    # replicas ship real KVC1 block chains between the fake loops with
+    # journaled prefilling→shipping→adopted edges; death-mid-handoff
+    # faults must replay or fall back, never lose or double a request
+    # (ISSUE 18; single-feature seed, replayable in isolation)
+    ap.add_argument("--distserve", type=int, default=1)
+    # second concurrent managed pool from schedule 4 on (0 disables):
     # per-pool fence scopes + cross-pool isolation under the fault
-    # surface (schedules 0-2 keep their single-feature seeds replayable)
+    # surface (schedules 0-3 keep their single-feature seeds replayable)
     ap.add_argument("--multi-pool", type=int, default=1)
     # lint preflight on by default: a wall-clock/rng draw in a chaos-
     # reachable module makes every printed seed unreplayable, so soaking
@@ -89,7 +95,8 @@ def main() -> int:
     work = {"cnn_acked": 0, "lm_acked": 0, "lmb_acked": 0,
             "lmp_acked": 0, "sdfs_acked": 0, "spans_recorded": 0,
             "prefix_remote_hits": 0, "prefix_published": 0,
-            "prefix_warmed": 0}
+            "prefix_warmed": 0, "lmh_acked": 0, "handoff_routed": 0,
+            "handoff_blocks_shipped": 0, "handoff_blocks_adopted": 0}
     for i in range(args.schedules):
         seed = args.seed0 + i
         try:
@@ -112,9 +119,13 @@ def main() -> int:
                     # (ISSUE 17): ring-published KV chains fetched back
                     # under the fault surface, content-checked inline
                     cluster_prefix=bool(args.cluster_prefix) and i == 2,
-                    # schedules 3+ run TWO concurrent managed pools
+                    # fourth schedule runs the DistServe handoff group
+                    # (ISSUE 18): KV-block ships between role-split
+                    # replicas, journaled + replayed under faults
+                    distserve=bool(args.distserve) and i == 3,
+                    # schedules 4+ run TWO concurrent managed pools
                     # (ISSUE 14): per-pool fences + cross-pool isolation
-                    multi_pool=bool(args.multi_pool) and i >= 3,
+                    multi_pool=bool(args.multi_pool) and i >= 4,
                     n_hosts=args.hosts)
         except Exception as e:  # noqa: BLE001 - invariant trip is data
             rec = {"seed": seed, "error":
