@@ -44,11 +44,11 @@ REFERENCE_IMAGES_PER_S = 400 / 9.0   # ≈44.4, whole reference cluster
 BENCH_SUITE = os.environ.get("BENCH_SUITE", "cnn")
 if BENCH_SUITE not in ("cnn", "lm", "lm_prefix", "lm_cluster_prefix",
                        "lm_slots", "lm_paged", "lm_tp", "lm_gateway",
-                       "lm_autoscale", "lm_distserve", "train"):
+                       "lm_autoscale", "lm_distserve", "lm_gray", "train"):
     raise SystemExit(
         f"BENCH_SUITE={BENCH_SUITE!r}: want "
         "cnn|lm|lm_prefix|lm_cluster_prefix|lm_slots|lm_paged|lm_tp|"
-        "lm_gateway|lm_autoscale|lm_distserve|train")
+        "lm_gateway|lm_autoscale|lm_distserve|lm_gray|train")
 # BENCH_MODEL selects the measured network: resnet18 (headline, matches the
 # reference's "resnet"), resnet50 (bottleneck — ~4x the FLOPs/image, the
 # MXU-utilisation probe), alexnet (the other half of the reference's
@@ -73,6 +73,7 @@ METRIC = {"cnn": f"{BENCH_MODEL}_imagenet_inference_throughput",
           "lm_gateway": "lm_gateway_goodput",
           "lm_autoscale": "lm_autoscale_scaleout_goodput",
           "lm_distserve": "lm_distserve_handoff_throughput",
+          "lm_gray": "lm_gray_hedged_delivery_throughput",
           "train": "lm_train_throughput"}[BENCH_SUITE]
 
 # The TPU sits behind a tunnel that is intermittently down; a successful TPU
@@ -95,6 +96,7 @@ _LAST_GOOD = os.path.join(
      if BENCH_SUITE == "lm_autoscale"
      else "BENCH_LAST_GOOD_lm_distserve.json"
      if BENCH_SUITE == "lm_distserve"
+     else "BENCH_LAST_GOOD_lm_gray.json" if BENCH_SUITE == "lm_gray"
      else "BENCH_LAST_GOOD_train.json" if BENCH_SUITE == "train"
      else f"BENCH_LAST_GOOD_{BENCH_MODEL}.json"))
 # the compact LM sub-record captured during a default cnn run caches here
@@ -831,6 +833,21 @@ def run_lm_distserve_suite(devices) -> None:
                       "lm distserve measurement failed", compact=False)
 
 
+def run_lm_gray_suite(devices) -> None:
+    """BENCH_SUITE=lm_gray: what the gray-failure defense buys a polling
+    client when one of two ring replicas limps without dying (ISSUE 20)
+    — real decode completions served through three arms: undefended
+    round-robin (every other poll eats the gray tail), quarantine-only
+    (the differential ledger routes around the limper after detection),
+    and quarantine + tail-hedged lm_poll (pre-detection polls answered
+    by the healthy backup at the hedge delay). Headline is the hedged
+    arm's client-observed delivered-tokens/sec; the p99 comparison,
+    detection poll index and hedge win counters ride in details."""
+    from idunno_tpu.utils.lm_bench import run_lm_gray_bench
+    _run_record_suite(devices, run_lm_gray_bench, "hedged",
+                      "lm gray-failure measurement failed", compact=False)
+
+
 def run_train_suite(devices) -> None:
     """BENCH_SUITE=train: LM + CNN train-step throughput (trained
     tokens/sec; accum/fsdp/cnn points in details)."""
@@ -895,6 +912,8 @@ def main() -> None:
             run_lm_autoscale_suite(devices)
         elif BENCH_SUITE == "lm_distserve":
             run_lm_distserve_suite(devices)
+        elif BENCH_SUITE == "lm_gray":
+            run_lm_gray_suite(devices)
         elif BENCH_SUITE == "train":
             run_train_suite(devices)
         else:

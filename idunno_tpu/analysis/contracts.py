@@ -85,6 +85,35 @@ class RetrySite:
                              "a justification sentence")
 
 
+@dataclasses.dataclass(frozen=True)
+class HedgeVerb:
+    """An idempotent READ verb allowed to tail-hedge (ISSUE 20): fire a
+    duplicate request at a second host and take the first reply. Only
+    verbs declared here may appear at a ``call_hedged`` site — hedging a
+    mutation would double-book exactly like an unkeyed retry."""
+    verb: str
+    why: str
+
+    def __post_init__(self) -> None:
+        if len(self.why.strip()) < 20:
+            raise ValueError(f"hedge verb {self.verb!r} needs a "
+                             "justification sentence")
+
+
+@dataclasses.dataclass(frozen=True)
+class HedgeSite:
+    """A ``call_hedged`` call site and the read verbs it may carry."""
+    file: str
+    symbol: str      # qualname of the enclosing function
+    verbs: tuple[str, ...]   # HEDGE_VERBS entries it may carry
+    why: str
+
+    def __post_init__(self) -> None:
+        if len(self.why.strip()) < 20:
+            raise ValueError(f"hedge site {self.file}:{self.symbol} needs "
+                             "a justification sentence")
+
+
 @dataclasses.dataclass
 class Contracts:
     fence_targets: tuple[str, ...]
@@ -94,6 +123,8 @@ class Contracts:
     guarded: tuple[Guard, ...]
     retry_safe: tuple[RetrySite, ...]
     allowlist: tuple[Allow, ...]
+    hedge_verbs: tuple[HedgeVerb, ...] = ()
+    hedge_safe: tuple[HedgeSite, ...] = ()
 
 
 # -- the shipped registries -------------------------------------------------
@@ -233,6 +264,8 @@ GUARDED = (
            "_pool_wal", "_pool_wal_bytes")),
     Guard("idunno_tpu/membership/epoch.py", "ScopeOwners", "_lock",
           ("_map",)),
+    Guard("idunno_tpu/membership/health.py", "HealthLedger", "_lock",
+          ("_peers", "_remote")),
     Guard("idunno_tpu/serve/inference_service.py", "InferenceService",
           "_results_lock", ("_results", "_qnum", "_idem")),
     Guard("idunno_tpu/serve/inference_service.py", "InferenceService",
@@ -264,6 +297,38 @@ RETRY_SAFE = (
 )
 
 
+# idempotent READ verbs that may tail-hedge (ISSUE 20). A new verb joins
+# this table only with a sentence explaining why a duplicated, concurrent
+# read converges — then a HEDGE_SAFE row names each call site.
+HEDGE_VERBS = (
+    HedgeVerb("lm_poll",
+              why="poll delivery is at-most-once per completion and the "
+                  "hedged caller merges the losing reply via on_late, so "
+                  "a doubled poll neither loses nor double-delivers rows"),
+    HedgeVerb("prefix_probe",
+              why="probe is a pure read (ring STATs of content-addressed "
+                  "names); it mutates nothing so concurrent duplicates "
+                  "are trivially exactly-once"),
+    HedgeVerb("sdfs_stat",
+              why="STAT is a pure metadata read; masters max-merge "
+                  "versions/tombstones so two replies can only disagree "
+                  "transiently and the caller takes either"),
+)
+
+HEDGE_SAFE = (
+    HedgeSite("idunno_tpu/store/sdfs.py", "FileStoreService.stat",
+              verbs=("sdfs_stat", "prefix_probe"),
+              why="stat hedges its pure STAT read across the master "
+                  "chain; cluster_prefix probe/publish ride this same "
+                  "read so prefix_probe is covered at the store layer"),
+    HedgeSite("idunno_tpu/utils/lm_bench.py", "_gray_hedged_poll",
+              verbs=("lm_poll",),
+              why="the gray-suite client hedges lm_poll across two ring "
+                  "hosts and merges the losing reply's completions via "
+                  "on_late before counting delivered rows"),
+)
+
+
 def default() -> Contracts:
     from idunno_tpu.analysis.allowlist import ALLOWLIST
     return Contracts(
@@ -274,4 +339,6 @@ def default() -> Contracts:
         guarded=GUARDED,
         retry_safe=RETRY_SAFE,
         allowlist=tuple(ALLOWLIST),
+        hedge_verbs=HEDGE_VERBS,
+        hedge_safe=HEDGE_SAFE,
     )

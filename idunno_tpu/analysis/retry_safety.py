@@ -110,3 +110,57 @@ def check(modules: dict[str, Module], contracts) -> list:
                 f"call_with_retry site exists there anymore — remove or "
                 f"re-anchor the declaration"))
     return findings
+
+
+@checker("hedge")
+def check_hedge(modules: dict[str, Module], contracts) -> list:
+    """hedge-safety: tail-hedged duplicates only for declared read verbs.
+
+    Mirrors the retry checker for ``call_hedged`` (ISSUE 20): every call
+    site must be declared in ``contracts.HEDGE_SAFE``, every verb a site
+    claims must exist in the ``HEDGE_VERBS`` registry of idempotent
+    reads, and stale declarations are findings. Hedging an undeclared
+    verb is the same bug class as retrying an unkeyed mutation — the
+    duplicate request lands twice."""
+    findings = []
+    hedge_sites = tuple(getattr(contracts, "hedge_safe", ()) or ())
+    hedge_verbs = {v.verb for v in
+                   getattr(contracts, "hedge_verbs", ()) or ()}
+    declared = {(s.file, s.symbol): s for s in hedge_sites}
+    seen_sites = set()
+
+    for s in hedge_sites:
+        for v in s.verbs:
+            if v not in hedge_verbs:
+                findings.append(Finding(
+                    "hedge", s.file, 0, s.symbol, f"verb:{v}",
+                    f"HEDGE_SAFE site {s.symbol!r} claims verb {v!r} "
+                    f"which is not in HEDGE_VERBS — declare why a "
+                    f"duplicated concurrent read of it converges first"))
+
+    for rel, mod in modules.items():
+        if not rel.startswith("idunno_tpu/"):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and (
+                    dotted(node.func).endswith("call_hedged")):
+                qual = mod.qualname(node)
+                seen_sites.add((rel, qual))
+                if (rel, qual) not in declared:
+                    f = mod.finding(
+                        "hedge", node, qual,
+                        f"call_hedged in {qual!r} is not declared in "
+                        f"contracts.HEDGE_SAFE — a hedged mutation lands "
+                        f"twice; declare the site with its idempotent "
+                        f"read verbs and why first-reply-wins is safe")
+                    if f is not None:
+                        findings.append(f)
+
+    for (file, symbol), s in declared.items():
+        if (file, symbol) not in seen_sites:
+            findings.append(Finding(
+                "hedge", file, 0, symbol, "stale-site",
+                f"HEDGE_SAFE declares {symbol!r} in {file} but no "
+                f"call_hedged site exists there anymore — remove or "
+                f"re-anchor the declaration"))
+    return findings

@@ -42,6 +42,8 @@ semantics are mirrored verb-for-verb from `serve/control.py`.
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import random
 import time
@@ -185,6 +187,9 @@ class ChaosControl:
         owner = self.membership.owners.owner(scope)
         if owner is None or owner == self.host:
             alive = set(self.membership.members.alive_hosts())
+            # quarantine-blind on purpose: this guess must match the
+            # adoption formula (failover._adopt_scopes_of), not the
+            # assignment-time view — see the note there
             owner = place_scope(scope, self.membership.config.hosts, alive)
         if owner is None or owner == self.host:
             return None
@@ -629,7 +634,8 @@ class ChaosCluster:
                  prefill_chunk: int = 0, n_model: int = 1,
                  autoscale: bool = False, multi_pool: bool = False,
                  cluster_prefix: bool = False,
-                 distserve: bool = False) -> None:
+                 distserve: bool = False,
+                 fail_slow: bool = False) -> None:
         self.seed = seed
         self.prefill_chunk = prefill_chunk
         self.n_model = n_model
@@ -648,6 +654,17 @@ class ChaosCluster:
         # handoff mode (manager ships real KVC1 blobs between the fake
         # loops) — flag-gated: submissions AND ship RPCs draw chaos rng
         self.distserve = distserve
+        # ISSUE 20: gray-failure schedule — flag-gated because attaching
+        # the health ledger to the transports, the victim rng draw, and
+        # the per-step latency-sampling sweep all consume rng / send
+        # extra datagrams, which would shift every existing seed
+        self.fail_slow = fail_slow
+        self.slow_victim: str | None = None
+        self.slow_prober: str | None = None
+        self.saw_quarantine = False
+        # per-host consecutive steps the victim was missing from that
+        # host's alive view while both ends' links were clean
+        self._leave_streak: dict[str, int] = {}
         # created before the host loop: the controls hold a reference so
         # the fake tier's inline content checks (wrong-token graft,
         # double-prefill) land in the same invariant ledger
@@ -667,6 +684,14 @@ class ChaosCluster:
             straggler_timeout_s=4.0, rpc_retry_deadline_s=0.5)
         self.net = InProcNetwork(seed=seed)
         self.clock = ChaosClock()
+        if fail_slow:
+            # victim off the coordinator chain (the limp is a worker-side
+            # gray failure; deposing masters is the kill schedules' job);
+            # the prober is a fixed second host whose ledger derives the
+            # verdict and gossips it
+            self.slow_victim = self.rng.choice(self.cfg.hosts[2:])
+            self.slow_prober = ("n3" if self.slow_victim == "n2"
+                                else "n2")
         self.members: dict[str, MembershipService] = {}
         self.services: dict[str, InferenceService] = {}
         self.stores: dict[str, FileStoreService] = {}
@@ -687,6 +712,11 @@ class ChaosCluster:
             self.spans[h] = SpanStore(h, clock=self.clock)
             self.members[h] = MembershipService(h, self.cfg, t,
                                                 clock=self.clock)
+            if fail_slow:
+                # node.py wiring, flag-gated: every reliable call now
+                # feeds the caller's ledger with the net's SYNTHESIZED
+                # latency (call_latency — no clock advance, no rng)
+                t.health = self.members[h].health
             self.services[h] = InferenceService(
                 h, self.cfg, t, self.members[h],
                 ChaosEngine(h, self.clock),
@@ -1067,6 +1097,24 @@ class ChaosCluster:
         self.lmh_acked.append({"serial": s, "hrid": int(out["id"]),
                                "prompt": prompt, "seed": s, "max_new": 4})
 
+    def probe_sweep(self, prober: str) -> None:
+        """One latency-sampling sweep (fail_slow schedules only): the
+        prober calls every peer once so its ledger holds >= min_samples
+        on the whole fleet — the fleet median needs healthy samples, not
+        just the victim's. Replies (even ERROR) observe the synthesized
+        latency; a cut link observes an error sample. Consumes net rng,
+        so it only ever runs under the fail_slow flag."""
+        t = self.net._nodes[prober]
+        for peer in self.cfg.hosts:
+            if peer == prober:
+                continue
+            try:
+                t.call(peer, "control",
+                       Message(MessageType.INFERENCE, prober,
+                               {"verb": "health_probe"}))
+            except TransportError:
+                pass
+
     def _scripted_gauges(self, mgr: LMPoolManager, name: str) -> dict:
         """Deterministic stand-in for `group_gauges`: scripted p95
         pressure (one number for the whole group), real journal backlog
@@ -1158,6 +1206,17 @@ class ChaosCluster:
             # scale-out threshold, then idle so the group scales back in
             self.group_pressure = (5.0 if self._steps_run
                                    <= self.overload_steps else 0.0)
+        if self.fail_slow:
+            # scripted fail-slow window (ISSUE 20): the victim limps —
+            # heartbeats still flow, so this is GRAY, not fail-stop —
+            # through the middle of the schedule, then heals. The sweep
+            # and the fault itself live entirely behind the flag so
+            # existing seeds replay unshifted.
+            if self._steps_run == 4:
+                self.net.slow_host(self.slow_victim, 10.0)
+            elif self._steps_run == self.overload_steps + 4:
+                self.net.clear_slow(self.slow_victim)
+            self.probe_sweep(self.slow_prober)
         r = self.rng.random()
         client = self.rng.choice(self.cfg.hosts)
         if r < 0.22:
@@ -1189,6 +1248,39 @@ class ChaosCluster:
         self.pump_membership(waves=1)
         self.pump_work()
         self.record_fences()
+        if self.fail_slow:
+            self._sample_fail_slow()
+
+    def _sample_fail_slow(self) -> None:
+        """Per-step fail-slow invariant sampling: record that some
+        ledger reached QUARANTINED, and trip a FALSE-LEAVE violation if
+        a host keeps the victim out of its alive view for many
+        consecutive steps while both ends' links are verifiably clean —
+        the health plane diverting traffic must never suppress the
+        heartbeats that would refute a drop-induced suspicion, and the
+        fault itself advances no clock so it can never cause a timeout.
+        One-off missing views are legal (datagram drop chaos); a LONG
+        streak over clean links is the forged-LEAVE smell."""
+        victim = self.slow_victim
+        if not self.saw_quarantine:
+            for m in self.members.values():
+                if m.health.state(victim) == "quarantined":
+                    self.saw_quarantine = True
+                    break
+        clean_victim = self.net.unperturbed(victim)
+        for h in self.cfg.hosts:
+            if h == victim:
+                continue
+            missing = (clean_victim and self.net.unperturbed(h)
+                       and victim
+                       not in self.members[h].members.alive_hosts())
+            streak = self._leave_streak.get(h, 0) + 1 if missing else 0
+            self._leave_streak[h] = streak
+            if streak >= 8:
+                self.violations.append(
+                    f"false LEAVE: {h} kept fail-slow victim {victim} "
+                    f"out of its alive view for {streak} clean-link "
+                    f"steps (step {self._steps_run})")
 
     def run_schedule(self, steps: int = 40,
                      chaos: dict | None = None) -> None:
@@ -1299,6 +1391,14 @@ class ChaosCluster:
                     for rid, q in rpool["requests"].items():
                         if q["status"] in ("pending", "inflight"):
                             out.append(f"grp {r} rid {rid} {q['status']}")
+        if self.fail_slow:
+            # probation must HEAL once the fault clears: converge ends
+            # only when no ledger still watches anyone — quarantine is a
+            # verdict about a fault, not a permanent exile
+            for h in self.cfg.hosts:
+                w = self.members[h].health.watched()
+                if w:
+                    out.append(f"health {h} watches {sorted(w)}")
         return out
 
     def _settled(self) -> bool:
@@ -1486,9 +1586,16 @@ class ChaosCluster:
             for r in gview["replicas"]:
                 idx = LMPoolManager._replica_index(r)
                 assert 0 <= idx, f"malformed replica name {r!r}"
+            # forecast determinism (ISSUE 20 satellite): the decision
+            # rows carry the Holt predicted_rate that justified them —
+            # digesting the full journal lets the soak driver replay the
+            # seed and assert the forecast reproduced bit-for-bit
+            blob = json.dumps(gview["decisions"], sort_keys=True)
             grp_summary = {"grp_acked": len(self.grp_acked),
                            "grp_replicas": len(gview["replicas"]),
-                           "grp_decisions": gview["next_seq"]}
+                           "grp_decisions": gview["next_seq"],
+                           "grp_decision_digest":
+                               hashlib.sha256(blob.encode()).hexdigest()[:16]}
         # cluster prefix cache (ISSUE 17): inline content checks landed
         # in self.violations (asserted empty above); the summary carries
         # the aggregate fake-tier gauges so soak JSON shows the workload
@@ -1545,6 +1652,22 @@ class ChaosCluster:
                 "handoff_fallback": states.get("fallback", 0),
                 "handoff_blocks_shipped": shipped,
                 "handoff_blocks_adopted": adopted}
+        # gray failure (ISSUE 20): the differential plane must have
+        # QUARANTINED the scripted limping victim (heartbeats alive the
+        # whole time — the false-LEAVE streak check above feeds
+        # self.violations), and every ledger must be back to all-healthy
+        # after the fault cleared (probation heals; also a converge
+        # gate, re-asserted here so the summary can't lie)
+        fs_summary: dict = {}
+        if self.fail_slow:
+            assert self.saw_quarantine, \
+                f"fail-slow victim {self.slow_victim} never quarantined"
+            for h in self.cfg.hosts:
+                w = self.members[h].health.watched()
+                assert not w, \
+                    f"{h} still watches {sorted(w)} after fault clear"
+            fs_summary = {"slow_victim": self.slow_victim,
+                          "quarantine_seen": True}
         pool_epochs: dict[str, int] = {}
         for scope, e in self.scope_owners:
             pool_epochs[scope] = max(pool_epochs.get(scope, 0), e)
@@ -1569,7 +1692,8 @@ class ChaosCluster:
                 "owner_moves": owner_moves,
                 "hosts": len(self.cfg.hosts),
                 "final_master": self.final_master(),
-                **grp_summary, **prefix_summary, **ds_summary}
+                **grp_summary, **prefix_summary, **ds_summary,
+                **fs_summary}
 
 
 def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
@@ -1580,7 +1704,8 @@ def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
                         multi_pool: bool = False,
                         n_hosts: int = 5,
                         cluster_prefix: bool = False,
-                        distserve: bool = False) -> dict:
+                        distserve: bool = False,
+                        fail_slow: bool = False) -> dict:
     """One full seeded chaos run: schedule -> converge -> invariants.
     Returns the invariant summary plus convergence time.
     ``prefill_chunk`` rides the managed pool's lm_serve spec (ISSUE 7):
@@ -1602,13 +1727,18 @@ def run_seeded_schedule(seed: int, data_dir: str, steps: int = 40,
     (ISSUE 18): long-prompt submissions route in handoff mode — the
     manager journals prefilling→shipping→adopted edges and ships real
     KVC1 blobs between the fake loops; deaths mid-handoff must replay
-    the ship or fall back, never lose or double the request."""
+    the ship or fall back, never lose or double the request.
+    ``fail_slow`` runs the gray-failure schedule (ISSUE 20): one scripted
+    limping victim (synthesized latency, heartbeats alive), a fixed
+    prober sampling the whole fleet, quarantine-without-LEAVE and
+    probation-heals invariants on top of everything above."""
     c = ChaosCluster(seed, data_dir, n_hosts=n_hosts,
                      prefill_chunk=prefill_chunk,
                      n_model=n_model, autoscale=autoscale,
                      multi_pool=multi_pool,
                      cluster_prefix=cluster_prefix,
-                     distserve=distserve)
+                     distserve=distserve,
+                     fail_slow=fail_slow)
     try:
         c.run_schedule(steps=steps,
                        chaos=chaos if chaos is not None

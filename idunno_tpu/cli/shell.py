@@ -208,6 +208,17 @@ class Shell:
             for scope in owners.scopes():
                 o, seq = owners.view(scope)
                 rows.append(f"  {scope} -> {o} (seq {seq})")
+        # differential-health table (ISSUE 20): this node's verdict on
+        # every peer it holds a non-HEALTHY verdict for, with the RPC
+        # latency EWMA the verdict was derived from
+        health = getattr(self.node.membership, "health", None)
+        if health is not None:
+            table = [(peer, st, ewma) for peer, st, ewma
+                     in health.table() if st != "healthy"]
+            if table:
+                rows.append("peer health:")
+                rows.extend(f"  {peer:<12} {st:<12} {ewma * 1000:.1f}ms"
+                            for peer, st, ewma in table)
         return "\n".join(rows)
 
     # -- grep -------------------------------------------------------------

@@ -21,6 +21,14 @@ style of FoundationDB's deterministic simulation (Zhou et al., SIGMOD 2021):
 - ``lose_next_reply(src, dst, n)`` — a targeted, deterministic lost ACK:
   the next ``n`` reliable calls src→dst execute server-side but the caller
   sees a timeout.
+- ``slow_host(host, factor)`` — a sustained FAIL-SLOW fault (ISSUE 20,
+  gray failure): every call touching the host reports an inflated
+  handler latency (``base_call_s × factor``) to the caller's attached
+  health ledger — a *handler-delay multiplier*, distinct from the
+  per-datagram ``delay`` reordering above. Deterministic (no clock, no
+  rng): the latency is synthesized, not slept, unless ``sleep_s`` is
+  given (the gray bench uses a real sleep so hedging has a real tail to
+  cut). Cleared by ``clear_chaos``.
 
 Chaos is off by default (all probabilities 0, no cuts): existing fixtures
 burn no RNG draws and behave exactly as before.
@@ -29,6 +37,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 
 from idunno_tpu.comm.message import Message
 from idunno_tpu.comm.transport import Handler, Transport, TransportError
@@ -53,6 +62,11 @@ class InProcNetwork:
         # service, msg] — releasing after N subsequent delivers gives
         # bounded delay AND reordering without a clock dependency
         self._held: list[list] = []
+        # fail-slow fault state: host -> (latency multiplier, real sleep)
+        self._slow: dict[str, tuple[float, float]] = {}
+        # nominal per-call handler latency reported to health ledgers
+        # when no fault is active (everything equally fast = no verdicts)
+        self.base_call_s = 0.01
         self._lock = threading.RLock()
 
     def transport(self, host: str) -> "InProcTransport":
@@ -114,11 +128,37 @@ class InProcNetwork:
             if seed is not None:
                 self._rng = random.Random(seed)
 
+    def slow_host(self, host: str, factor: float,
+                  sleep_s: float = 0.0) -> None:
+        """Sustained fail-slow: calls to/from ``host`` report
+        ``base_call_s × factor`` latency to attached health ledgers
+        (and really sleep ``sleep_s`` when given — bench only; chaos
+        stays sleepless so fake clocks own time)."""
+        with self._lock:
+            self._slow[host] = (max(1.0, float(factor)), float(sleep_s))
+
+    def clear_slow(self, host: str | None = None) -> None:
+        with self._lock:
+            if host is None:
+                self._slow.clear()
+            else:
+                self._slow.pop(host, None)
+
+    def call_latency(self, src: str, dst: str) -> float:
+        """Synthesized handler latency for one reliable call src→dst —
+        what the caller's health ledger observes. Pure function of the
+        fault state: deterministic under seeded schedules."""
+        with self._lock:
+            f = max(self._slow.get(dst, (1.0, 0.0))[0],
+                    self._slow.get(src, (1.0, 0.0))[0])
+            return self.base_call_s * f
+
     def clear_chaos(self) -> None:
         with self._lock:
             self._drop_p = self._dup_p = self._delay_p = 0.0
             self._chaos_links = None
             self._lose_reply.clear()
+            self._slow.clear()
 
     def heal_all(self) -> None:
         """Remove every cut (symmetric and one-way); chaos probabilities
@@ -126,6 +166,16 @@ class InProcNetwork:
         with self._lock:
             self._cuts.clear()
             self._oneway.clear()
+
+    def unperturbed(self, host: str) -> bool:
+        """True when ``host`` is alive and no cut (symmetric or one-way)
+        touches it — the precondition for the chaos harness's
+        false-LEAVE invariant: a merely SLOW host with clean links must
+        never be declared dead."""
+        with self._lock:
+            return (host not in self._dead
+                    and not any(host in c for c in self._cuts)
+                    and not any(host in pair for pair in self._oneway))
 
     def flush_held(self) -> None:
         """Deliver every delayed datagram now (still subject to the
@@ -220,6 +270,13 @@ class InProcNetwork:
                 raise TransportError(
                     f"request {src}->{dst} dropped (chaos)",
                     reason="timeout")
+            with self._lock:
+                naps = max(self._slow.get(dst, (1.0, 0.0))[1],
+                           self._slow.get(src, (1.0, 0.0))[1])
+            if naps > 0.0:
+                # bench-mode fail-slow only: chaos schedules keep
+                # sleep_s=0 so the fake clock owns all time
+                time.sleep(naps)
             # delay is unobservable on a synchronous call — deliver
             out = self._deliver_raw(src, dst, service, msg, reliable=True)
             if mode == "dup":    # duplicated request frame: handler twice
@@ -253,7 +310,22 @@ class InProcTransport(Transport):
 
     def call(self, host: str, service: str, msg: Message,
              timeout: float | None = None) -> Message | None:
-        return self._net.deliver(self.host, host, service, msg, reliable=True)
+        h = self.health
+        if h is None:
+            return self._net.deliver(self.host, host, service, msg,
+                                     reliable=True)
+        # differential health feed: the synthesized per-call latency is a
+        # pure function of the network's fail-slow state, so seeded chaos
+        # schedules observe identical samples on replay
+        lat = self._net.call_latency(self.host, host)
+        try:
+            out = self._net.deliver(self.host, host, service, msg,
+                                    reliable=True)
+        except TransportError:
+            h.observe(host, lat, error=True)
+            raise
+        h.observe(host, lat)
+        return out
 
     def datagram(self, host: str, service: str, msg: Message) -> None:
         try:

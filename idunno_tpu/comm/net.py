@@ -17,6 +17,7 @@ import logging
 import socket
 import struct
 import threading
+import time
 from collections.abc import Callable
 
 from idunno_tpu.comm.message import Message
@@ -81,6 +82,8 @@ class NetTransport(Transport):
         self._addr_of = addr_of
         self._handlers: dict[str, Handler] = {}
         self._stop = threading.Event()
+        # latency source for the optional health feed (injectable)
+        self.clock = time.monotonic
 
         my_ip, tcp_port, udp_port = addr_of(host)
         self._tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -171,20 +174,37 @@ class NetTransport(Transport):
         # matters: socket.timeout ⊂ OSError, ConnectionRefusedError ⊂
         # ConnectionError ⊂ OSError.
         ip, tcp_port, _ = self._addr_of(host)
+        # differential health feed (membership/health.py): real wall
+        # latency per call when a ledger is attached. The clock is an
+        # injectable attribute so tests can pin it; NetTransport never
+        # runs under the seeded chaos harness.
+        h = self.health
+        t0 = self.clock() if h is not None else 0.0
         try:
-            return oneshot_call(ip, tcp_port, service, msg,
-                                timeout=timeout or 10.0)
+            out = oneshot_call(ip, tcp_port, service, msg,
+                               timeout=timeout or 10.0)
         except socket.timeout as e:
+            if h is not None:
+                h.observe(host, self.clock() - t0, error=True)
             raise TransportError(f"{host} timed out: {e}",
                                  reason="timeout") from e
         except ConnectionRefusedError as e:
+            if h is not None:
+                h.observe(host, self.clock() - t0, error=True)
             raise TransportError(f"{host} refused: {e}",
                                  reason="refused") from e
         except ConnectionError as e:
+            if h is not None:
+                h.observe(host, self.clock() - t0, error=True)
             raise TransportError(f"{host} closed connection: {e}",
                                  reason="closed") from e
         except OSError as e:
+            if h is not None:
+                h.observe(host, self.clock() - t0, error=True)
             raise TransportError(f"{host} unreachable: {e}") from e
+        if h is not None:
+            h.observe(host, self.clock() - t0)
+        return out
 
     def datagram(self, host: str, service: str, msg: Message) -> None:
         try:

@@ -30,7 +30,8 @@ from idunno_tpu.comm.transport import TransportError
 # Prometheus exposition, and `counters()` consumers read them via
 # `retry_counters()`. Thread-safe; reset only in tests.
 _counters_lock = threading.Lock()
-_counters = {"retry_attempts": 0, "retry_exhausted": 0}
+_counters = {"retry_attempts": 0, "retry_exhausted": 0,
+             "hedged_rpcs": 0, "hedge_wins": 0}
 
 
 def _count(name: str, n: int = 1) -> None:
@@ -83,3 +84,81 @@ def call_with_retry(fn: Callable[[], object], *, attempts: int = 3,
     assert last is not None
     _count("retry_exhausted")
     raise last
+
+
+def call_hedged(fns, *, delay_s: float = 0.05,
+                on_late: Callable[[object], None] | None = None):
+    """Tail-hedged read (Dean & Barroso, *The Tail at Scale*, CACM 2013):
+    fire ``fns[0]``; if it has not answered within ``delay_s``, fire the
+    backup thunks too and return the FIRST success. Every call site must
+    be declared in ``contracts.HEDGE_SAFE`` with idempotent READ verbs
+    only (machine-checked by protocol_lint's hedge checker) — a hedged
+    mutation lands twice.
+
+    ``on_late(result)`` receives each losing thunk's eventual success so
+    callers with delivery-marking reads (lm_poll) can merge rather than
+    lose the duplicate's rows. Late *failures* are discarded.
+
+    Single-thunk (or non-positive delay with one fn) degenerates to a
+    plain call: no thread, no counter. NOT for the chaos harness —
+    hedge threads would interleave the seeded rng draws; `hedge_reads`
+    stays off there by config default.
+    """
+    fns = list(fns)
+    if not fns:
+        raise ValueError("call_hedged needs at least one thunk")
+    if len(fns) == 1:
+        return fns[0]()
+
+    done = threading.Event()
+    lock = threading.Lock()
+    results: list[tuple[int, object]] = []    # (thunk index, value)
+    errors: list[BaseException] = []
+    launched = [False] * len(fns)
+
+    def run(i: int) -> None:
+        try:
+            out = fns[i]()
+        except BaseException as e:  # noqa: BLE001 - collected, re-raised
+            with lock:
+                errors.append(e)
+                all_failed = len(errors) == sum(launched)
+            if all_failed:
+                done.set()
+            return
+        late = None
+        with lock:
+            results.append((i, out))
+            late = len(results) > 1
+        if late and on_late is not None:
+            on_late(out)
+        done.set()
+
+    threads = []
+    with lock:
+        launched[0] = True
+    t0 = threading.Thread(target=run, args=(0,), daemon=True)
+    threads.append(t0)
+    t0.start()
+    if not done.wait(max(0.0, delay_s)):
+        _count("hedged_rpcs")
+        for i in range(1, len(fns)):
+            with lock:
+                launched[i] = True
+            t = threading.Thread(target=run, args=(i,), daemon=True)
+            threads.append(t)
+            t.start()
+    # first success wins; if every launched thunk failed, re-raise the
+    # last error. Clear-before-check: appends happen under the lock
+    # strictly before set(), so nothing observable is lost to the clear.
+    while True:
+        done.wait()
+        done.clear()
+        with lock:
+            if results:
+                idx, out = results[0]
+                if idx > 0:
+                    _count("hedge_wins")
+                return out
+            if len(errors) == sum(launched):
+                raise errors[-1]

@@ -54,6 +54,13 @@ class TransportError(Exception):
 class Transport(abc.ABC):
     """One node's endpoint: serve handlers, call peers."""
 
+    # optional differential-health feed (membership/health.py): when a
+    # HealthLedger is attached here, every reliable call's latency and
+    # error observation lands in it. None = no observation (default; the
+    # chaos harness only attaches it under the fail-slow flag so seeded
+    # schedules without it burn no extra state).
+    health = None
+
     @abc.abstractmethod
     def serve(self, service: str, handler: Handler) -> None:
         """Register the handler for a named service on this node."""

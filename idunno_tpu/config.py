@@ -120,6 +120,35 @@ class ClusterConfig:
     # analogue of lm_resize_dwell_s.
     autoscale_dwell_s: float = 15.0
 
+    # Differential health scoring (membership/health.py): fail-SLOW
+    # detection beside the fail-stop detector. A peer whose RPC-latency
+    # EWMA exceeds deviation_factor × the fleet median (and the absolute
+    # floor — nothing breaches on microsecond noise) while still
+    # heartbeat-alive walks healthy → suspect → quarantined; these seed
+    # `HealthPolicy.from_config`.
+    health_deviation_factor: float = 3.0
+    health_floor_s: float = 0.02
+    health_min_samples: int = 5
+    # sustained-breach window before suspect escalates to quarantined,
+    # and the clean dwell probation must hold before re-admitting
+    health_suspect_window_s: float = 1.0
+    health_probation_s: float = 2.0
+    # error-rate EWMA breach (transport errors / calls)
+    health_error_rate: float = 0.5
+
+    # Tail-hedged reads (comm/retry.py:call_hedged): a HEDGE_SAFE read
+    # not answered within hedge_delay_s fires a duplicate to the next
+    # chain host and takes the first reply. OFF by default — hedge
+    # threads would interleave the chaos harness's seeded rng draws, so
+    # only real deployments and the gray bench opt in.
+    hedge_reads: bool = False
+    hedge_delay_s: float = 0.05
+
+    # Early straggler re-dispatch: a task whose worker the health ledger
+    # marks SUSPECT/QUARANTINED re-dispatches after this fraction of
+    # straggler_timeout_s instead of waiting the full window.
+    straggler_early_frac: float = 0.25
+
     def __post_init__(self) -> None:
         for name in ("coordinator", "standby_coordinator", "introducer"):
             host = getattr(self, name)

@@ -148,18 +148,30 @@ def pool_scope(name: str) -> str:
     return f"pool:{name.rsplit('@r', 1)[0]}"
 
 
-def place_scope(scope: str, hosts, alive) -> str | None:
+def place_scope(scope: str, hosts, alive, quarantined=()) -> str | None:
     """Deterministic owner for a pool scope: the first ALIVE host in the
     scope's rendezvous order over the full configured registry
     (utils/ring.py:rendezvous_order). Every node computes the same
     answer from the same membership view, and one host's death moves
-    only the scopes that ranked it first. None when nothing is alive."""
+    only the scopes that ranked it first. None when nothing is alive.
+
+    ``quarantined`` (gray-failure defense, membership/health.py): hosts
+    the health ledger has quarantined are skipped when minting NEW
+    owners — a limping host must not win placement — unless skipping
+    them would leave nothing (availability beats health)."""
     from idunno_tpu.utils.ring import rendezvous_order
     alive = set(alive)
+    quarantined = set(quarantined)
+    fallback = None
     for h in rendezvous_order(scope, tuple(hosts)):
-        if h in alive:
-            return h
-    return None
+        if h not in alive:
+            continue
+        if h in quarantined:
+            if fallback is None:
+                fallback = h
+            continue
+        return h
+    return fallback
 
 
 class ScopeOwnerRedirect(Exception):

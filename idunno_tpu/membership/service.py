@@ -30,6 +30,7 @@ from idunno_tpu.comm.transport import Transport, TransportError
 from idunno_tpu.config import ClusterConfig
 from idunno_tpu.membership.epoch import (EpochFence, FenceRegistry,
                                          ScopeOwners, observe_payload)
+from idunno_tpu.membership.health import HealthLedger, HealthPolicy
 from idunno_tpu.membership.list import MembershipList
 from idunno_tpu.utils.types import MemberStatus, MessageType
 
@@ -59,9 +60,23 @@ class MembershipService:
         # the safety): pool-directed verbs go to the claimed owner first,
         # a wrong view costs one typed redirect hop
         self.owners = ScopeOwners()
+        # differential fail-SLOW ledger (ISSUE 20): verdicts gossip on
+        # every membership payload like scope views; the ledger never
+        # forges a LEAVE — fail-stop detection below is untouched. It
+        # only observes once a transport attaches it (node.py / chaos).
+        self.health = HealthLedger(host, HealthPolicy.from_config(config),
+                                   clock=clock)
         self._callbacks: list[ChangeCallback] = []
         self._left = False           # voluntary leave: never auto-refute
         transport.serve(SERVICE, self._handle)
+
+    def _gossip_payload(self) -> dict:
+        """The piggybacked view every membership message carries."""
+        return {"members": self.members.to_wire(),
+                "epoch": list(self.epoch.view()),
+                "scopes": self.scopes.view_all(),
+                "owners": self.owners.view_all(),
+                "health": self.health.view_all()}
 
     # -- wiring -----------------------------------------------------------
 
@@ -123,11 +138,7 @@ class MembershipService:
         self.members.touch(self.host, now)
         if self.host == self.config.introducer:
             return
-        msg = Message(MessageType.JOIN, self.host,
-                      {"members": self.members.to_wire(),
-                       "epoch": list(self.epoch.view()),
-                       "scopes": self.scopes.view_all(),
-                       "owners": self.owners.view_all()})
+        msg = Message(MessageType.JOIN, self.host, self._gossip_payload())
         for seed in (self.config.introducer, self.config.coordinator,
                      self.config.standby_coordinator):
             if seed == self.host:
@@ -144,6 +155,7 @@ class MembershipService:
                 observe_payload(self.epoch, out.payload)
                 self.scopes.observe_all(out.payload.get("scopes"))
                 self.owners.observe_all(out.payload.get("owners"))
+                self.health.observe_all(out.payload.get("health"))
                 self._fire(self.members.merge(out.payload["members"]))
                 return
         # nobody reachable — we are first up; keep our solo list.
@@ -154,14 +166,11 @@ class MembershipService:
         now = self.clock()
         self._left = True
         self.members.set(self.host, MemberStatus.LEAVE, now)
-        msg = Message(MessageType.LEAVE, self.host,
-                      {"members": self.members.to_wire(),
-                       "epoch": list(self.epoch.view()),
-                       "scopes": self.scopes.view_all(),
-                       "owners": self.owners.view_all()})
+        msg = Message(MessageType.LEAVE, self.host, self._gossip_payload())
         for h in self.config.hosts:
             if h != self.host:
-                self.transport.datagram(h, SERVICE, msg)
+                self.transport.datagram(  # lint: ok stamp -- _gossip_payload stamps the epoch view
+                    h, SERVICE, msg)
 
     # -- periodic steps (driven by runtime threads or tests) --------------
 
@@ -170,14 +179,11 @@ class MembershipService:
         the full list piggybacked."""
         if not self.is_acting_master:
             return
-        msg = Message(MessageType.PING, self.host,
-                      {"members": self.members.to_wire(),
-                       "epoch": list(self.epoch.view()),
-                       "scopes": self.scopes.view_all(),
-                       "owners": self.owners.view_all()})
+        msg = Message(MessageType.PING, self.host, self._gossip_payload())
         for h in self.config.hosts:
             if h != self.host:
-                self.transport.datagram(h, SERVICE, msg)
+                self.transport.datagram(  # lint: ok stamp -- _gossip_payload stamps the epoch view
+                    h, SERVICE, msg)
 
     def monitor_once(self) -> None:
         """Failure detection step.
@@ -192,6 +198,27 @@ class MembershipService:
         """
         now = self.clock()
         timeout = self.config.failure_timeout_s
+        # differential health step (ISSUE 20): derive fail-slow verdicts
+        # from what this node measured, then keep PROBING any peer under
+        # a non-healthy verdict — quarantine diverts discretionary
+        # traffic away from the peer, so recovery evidence must come
+        # from somewhere, and a direct membership call (observed by the
+        # transport's attached ledger) is that somewhere. Inert when no
+        # transport ever attached the ledger (no samples -> no verdicts
+        # -> no probes), so chaos schedules without the fail-slow flag
+        # send not one extra datagram and existing seeds replay.
+        self.health.tick(now)
+        for peer in sorted(self.health.watched()):
+            if peer == self.host or not self.members.is_alive(peer):
+                continue
+            try:
+                self.transport.call(  # lint: ok stamp -- _gossip_payload stamps the epoch view
+                    peer, SERVICE,
+                    Message(MessageType.PING, self.host,
+                            self._gossip_payload()),
+                    timeout=max(0.5, self.config.ping_interval_s))
+            except TransportError:
+                pass  # observed as an error sample by the transport hook
         # SWIM-style refutation: if someone marked US dead (false suspicion
         # across a healed partition or a long GC pause) while we are in fact
         # alive, overwrite with a RUNNING stamp strictly newer than the
@@ -254,14 +281,14 @@ class MembershipService:
         if isinstance(msg.payload, dict):
             self.scopes.observe_all(msg.payload.get("scopes"))
             self.owners.observe_all(msg.payload.get("owners"))
+            # health verdicts gossip like scope views: observed, never
+            # fenced — a quarantined peer must still learn its verdict
+            self.health.observe_all(msg.payload.get("health"))
         if msg.type is MessageType.JOIN:
             self._fire(self.members.merge(msg.payload["members"]))
             self.members.touch(msg.sender, now)
             return Message(MessageType.ACK, self.host,
-                           {"members": self.members.to_wire(),
-                            "epoch": list(self.epoch.view()),
-                            "scopes": self.scopes.view_all(),
-                            "owners": self.owners.view_all()})
+                           self._gossip_payload())
         if msg.type in (MessageType.PING, MessageType.PONG,
                         MessageType.LEAVE):
             self._fire(self.members.merge(msg.payload["members"]))
@@ -270,9 +297,6 @@ class MembershipService:
                 self.transport.datagram(
                     msg.sender, SERVICE,
                     Message(MessageType.PONG, self.host,
-                            {"members": self.members.to_wire(),
-                             "epoch": list(self.epoch.view()),
-                             "scopes": self.scopes.view_all(),
-                             "owners": self.owners.view_all()}))
+                            self._gossip_payload()))
             return None
         return None

@@ -274,20 +274,69 @@ class Autoscaler:
         # 1. complete in-flight retires: a DRAINING replica with zero
         #    undelivered journal entries, past the drain window, goes.
         #    This finishes a prior decision, so it is dwell-exempt.
+        #    Every decision this tick carries the forecast's view
+        #    (predicted_rate) so the journal is auditable per decision,
+        #    not just per predictive spawn (ISSUE 20 satellite) —
+        #    retires run before this tick's forecast sample, so they
+        #    stamp the LAST prediction.
+        fc_prev = self.forecast_view(name)["predicted_rate"]
         for rname, meta in sorted(view["replicas"].items()):
             if meta["state"] != "draining":
                 continue
             if (meta["undelivered"] == 0
                     and now - meta["t_drain"] >= policy.drain_window_s):
-                d = self.manager.group_retire(name, rname)
+                d = self.manager.group_retire(name, rname,
+                                              predicted_rate=fc_prev)
                 if d:
                     out.append(d)
 
         view = self.manager.group_view(name)
         if view is None:
             return out
+
+        # 1b. quarantine-and-drain (ISSUE 20): an ACTIVE replica on a
+        #     node the differential-health plane QUARANTINED stops
+        #     taking new routing now — spawn its replacement first
+        #     (capacity), then mark it draining. Dwell-exempt like
+        #     retire completion: a gray failure does not wait out the
+        #     damper. The drain → retire path is the ordinary one, so
+        #     zero admitted requests are lost; if the victim is the
+        #     LAST active replica and no replacement could place,
+        #     retire_start refuses and it keeps serving (availability
+        #     beats health).
+        quarantined = set(self.manager._quarantined_hosts())
+        if quarantined:
+            victims = sorted(
+                r for r, m in view["replicas"].items()
+                if m["state"] == "active"
+                and m.get("node") in quarantined)
+            n_active = sum(1 for m in view["replicas"].values()
+                           if m["state"] == "active")
+            for rname in victims:
+                if n_active < policy.max_replicas:
+                    d = self.manager.group_spawn(
+                        name, role=view["replicas"][rname]["role"],
+                        quarantine=True, replaced=rname,
+                        predicted_rate=fc_prev)
+                    if d:
+                        out.append(d)
+                        n_active += 1
+                d = self.manager.group_retire_start(
+                    name, replica=rname, quarantine=True,
+                    predicted_rate=fc_prev)
+                if d:
+                    out.append(d)
+                    n_active -= 1
+            if victims:
+                view = self.manager.group_view(name)
+                if view is None:
+                    return out
+
+        # quarantined-but-undrainable replicas don't count as capacity:
+        # thresholds below see only healthy actives
         active = sorted(r for r, m in view["replicas"].items()
-                        if m["state"] == "active")
+                        if m["state"] == "active"
+                        and m.get("node") not in quarantined)
         if not active:
             return out
         if now - view["t_last_decision"] < policy.dwell_s:
@@ -309,7 +358,8 @@ class Autoscaler:
                     and rc["total"] > 0
                     and rc["prefill"] / rc["total"] >= policy.prefill_share):
                 role = "prefill"
-            d = self.manager.group_spawn(name, role=role, p95=round(p95, 4))
+            d = self.manager.group_spawn(name, role=role, p95=round(p95, 4),
+                                         predicted_rate=round(pred, 4))
             if d:
                 out.append(d)
             return out
@@ -343,7 +393,8 @@ class Autoscaler:
         low = (backlog == 0
                or p95 < policy.scale_in_frac * policy.deadline_slack_s)
         if low and len(active) > policy.min_replicas:
-            d = self.manager.group_retire_start(name, p95=round(p95, 4))
+            d = self.manager.group_retire_start(
+                name, p95=round(p95, 4), predicted_rate=round(pred, 4))
             if d:
                 out.append(d)
             return out
@@ -354,7 +405,8 @@ class Autoscaler:
             hi = max(debts.values())
             lo = min(debts.values())
             if hi - lo > policy.rebalance_debt:
-                d = self.manager.group_rebalance(name)
+                d = self.manager.group_rebalance(
+                    name, predicted_rate=round(pred, 4))
                 if d:
                     out.append(d)
         return out

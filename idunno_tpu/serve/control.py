@@ -582,6 +582,19 @@ class ControlService:
                     kv_handoff_fallbacks=stats.get(
                         "kv_handoff_fallbacks", 0))
             node.metrics.record_lm_gauges(p["name"], gauges)
+            # ISSUE 20: the node's differential-health verdict summary
+            # (worst peer deviation ratio, quarantine count) and the
+            # process-wide hedge counters ride every lm_stats reply so
+            # `lm-stats` shows the gray-failure picture without a
+            # separate scrape
+            from idunno_tpu.comm.retry import retry_counters as _rc
+            hl = getattr(node.membership, "health", None)
+            if hl is not None:
+                c = _rc()
+                stats["node_health"] = dict(
+                    hl.gauges(),
+                    hedged_rpcs=c["hedged_rpcs"],
+                    hedge_wins=c["hedge_wins"])
             gw = stats.get("gateway")
             if gw is not None:
                 node.metrics.record_gateway_gauges(p["name"], {
@@ -717,12 +730,20 @@ class ControlService:
             # ISSUE 15: ownership-routing counters are always present in
             # the scrape (zero until the first redirect/handoff) so
             # dashboards can alert on them without a priming event
+            # ISSUE 20: node_health_score (worst peer deviation ratio)
+            # and quarantined_nodes from the differential ledger; the
+            # hedge counters ride retry_counters() below
+            hl = getattr(node.membership, "health", None)
+            if hl is not None:
+                extra_g.update(hl.gauges())
             extra_c = dict(retry_counters())
             cc = node.metrics.counters()
-            # ISSUE 18: handoff-fallback and predictive-spawn counters
-            # join the always-present set (zero until the first event)
+            # ISSUE 18/20: handoff-fallback, predictive-spawn and
+            # gray-failure routing counters join the always-present set
+            # (zero until the first event)
             for k in ("scope_owner_redirects", "scope_owner_moves",
-                      "kv_handoff_fallbacks", "predictive_spawns"):
+                      "kv_handoff_fallbacks", "predictive_spawns",
+                      "early_redispatches", "quarantine_reroutes"):
                 extra_c.setdefault(k, cc.get(k, 0))
             return {"text": node.metrics.prometheus_text(
                 node.host, extra_counters=extra_c,
@@ -984,6 +1005,9 @@ class ControlService:
                     # over the alive view rather than bouncing the client
                     alive = set(
                         self.node.membership.members.alive_hosts())
+                    # quarantine-blind: the guess must match the adoption
+                    # formula (failover._adopt_scopes_of) — see the
+                    # split-brain note there
                     owner = place_scope(
                         scope, self.node.config.hosts, alive)
                 if owner is not None and owner != self.node.host:

@@ -409,6 +409,13 @@ class FailoverManager:
         # minority successor stays put (unavailable, never split-brained)
         if 2 * len(alive | {self.host}) <= len(self.config.hosts):
             return
+        # NOTE: adoption placement is deliberately quarantine-BLIND.
+        # Every surviving host evaluates this formula independently, so
+        # its inputs must converge fast; health verdicts are per-host
+        # views with long divergence windows — feeding them in lets two
+        # hosts each compute themselves successor (per-pool split
+        # brain). Quarantine steers single-decider placement (the acting
+        # master's lm_serve assignment) and routing only.
         scopes = [s for s in owners.owned_by(dead)
                   if place_scope(s, self.config.hosts, alive) == self.host]
         if not scopes:

@@ -636,6 +636,33 @@ class InferenceService:
                                         snapshot=(worker, stamp),
                                         alive=alive):
                 moved += 1
+        # gray-failure early pass (ISSUE 20): a task whose worker the
+        # differential-health ledger holds SUSPECT or QUARANTINED (slow
+        # but heartbeat-alive — the full timeout would wait out a limp
+        # that heartbeats never surface) re-dispatches after
+        # straggler_early_frac of the window, onto a healthy worker when
+        # one exists. Same snapshot/retry-cap semantics as the full pass.
+        health = getattr(self.membership, "health", None)
+        unhealthy = health.unhealthy() if health is not None else set()
+        if unhealthy:
+            early_s = (self.config.straggler_timeout_s
+                       * self.config.straggler_early_frac)
+            healthy_alive = [w for w in alive
+                             if w not in unhealthy] or alive
+            for task in self.scheduler.book.stragglers(now, early_s):
+                worker, stamp, state = self.scheduler.book.assignment(task)
+                if state != WORKING or worker not in unhealthy:
+                    continue
+                if (task.moves == 0 and task.retries == 0
+                        and self.metrics.finished_images(task.model) == 0
+                        and not self._task_errors.get(task.model)
+                        and now - stamp <= self.first_compile_grace_s):
+                    continue
+                if self._redispatch_or_fail(task, "gray-straggler",
+                                            snapshot=(worker, stamp),
+                                            alive=healthy_alive):
+                    moved += 1
+                    self.metrics.record_counter("early_redispatches")
         return moved
 
     def _redispatch_or_fail(self, task: Task, why: str,

@@ -147,6 +147,11 @@ class LMPoolManager:
         # standby ACKed, so _replicate_pool can ship journal deltas and
         # fall back to a full entry on any gap (ISSUE 15)
         self._wal_shipped: dict[str, dict[str, Any]] = {}
+        # measured prefill ship-time EWMAs per prefill replica (ISSUE 20
+        # satellite): manager-local soft state feeding prefill-role
+        # routing; replica -> (ewma_s, n). Deliberately NOT in the group
+        # wire form — an adopter starts cold and re-measures.
+        self._ttft_ewma: dict[str, tuple[float, int]] = {}
         # cumulative journal rows compacted out of shipped WAL segments
         # below the delivered low-water mark (ISSUE 17 satellite;
         # metrics_export: pool_wal_truncated)
@@ -299,7 +304,15 @@ class LMPoolManager:
         alive = set(self.membership.members.alive_hosts())
         if claimed in alive:
             return claimed
-        return place_scope(scope, self.config.hosts, alive)
+        return place_scope(scope, self.config.hosts, alive,
+                           quarantined=self._quarantined_hosts())
+
+    def _quarantined_hosts(self) -> set[str]:
+        """Hosts the differential-health plane has quarantined (gray
+        failure: heartbeat-alive but limping). Routing-only input — the
+        set is empty on bare test doubles without a ledger."""
+        h = getattr(self.membership, "health", None)
+        return h.quarantined() if h is not None else set()
 
     def _claim_scope(self, scope: str) -> None:
         """Advisory ownership claim, gossiped on membership payloads.
@@ -1071,6 +1084,7 @@ class LMPoolManager:
                 attrs={"pool": name, "rid": rid, "prefill": pre_rname,
                        "node": pre_node})
             stamp_trace(payload, sp.ctx)
+        t_ship = self.wall()
         try:
             out = call_with_retry(
                 lambda: self._call(pre_node, payload,
@@ -1085,6 +1099,9 @@ class LMPoolManager:
         if sp is not None:
             self.spans.finish(sp, shipped=int(out.get("shipped", 0)),
                               bytes=int(out.get("bytes", 0)))
+        # measured-TTFT feed (ISSUE 20 satellite): the ship wall time IS
+        # the prefill latency the decode replica skipped
+        self._observe_ttft(pre_rname, self.wall() - t_ship)
         self._handoff_done(name, rid, pre_rname, "adopted",
                            shipped=int(out.get("shipped", 0)),
                            nbytes=int(out.get("bytes", 0)))
@@ -1401,8 +1418,8 @@ class LMPoolManager:
         self._replicate_scale(name, decision)
         return decision
 
-    def group_retire(self, name: str,
-                     replica: str) -> dict[str, Any] | None:
+    def group_retire(self, name: str, replica: str,
+                     **attrs) -> dict[str, Any] | None:
         """Remove a DRAINED replica and stop its pool — only when every
         journaled request on it has been DELIVERED (zero admitted-
         request loss); the autoscaler additionally waits out
@@ -1424,12 +1441,12 @@ class LMPoolManager:
                             in g["rid_map"].items()
                             if ent[0] != replica}
             decision = self._record_decision_locked(
-                name, g, "retire", replica=replica)
+                name, g, "retire", replica=replica, **attrs)
         self.stop(replica)
         self._replicate_scale(name, decision)
         return decision
 
-    def group_rebalance(self, name: str) -> dict[str, Any] | None:
+    def group_rebalance(self, name: str, **attrs) -> dict[str, Any] | None:
         """Move the heaviest-debt tenant on the max-WFQ-debt decode
         replica to the min-debt one. New submissions only — outstanding
         work stays where it was journaled."""
@@ -1464,7 +1481,7 @@ class LMPoolManager:
             g["tenants"][tenant] = lo
             decision = self._record_decision_locked(
                 name, g, "rebalance", tenant=tenant, src=hi, dst=lo,
-                debt_gap=round(debts[hi] - debts[lo], 4))
+                debt_gap=round(debts[hi] - debts[lo], 4), **attrs)
         self._replicate_scale(name, decision)
         return decision
 
@@ -1487,13 +1504,32 @@ class LMPoolManager:
           the pre-ISSUE-18 behavior.
 
         Everything else is tenant-sticky on decode replicas, new tenants
-        landing on the least-WFQ-debt one."""
+        landing on the least-WFQ-debt one.
+
+        Gray-failure defense (ISSUE 20): replicas placed on QUARANTINED
+        nodes (membership/health.py) are skipped — including a tenant's
+        sticky assignment, which re-pins by debt on its next submit —
+        unless every placed replica is quarantined, where availability
+        wins and routing falls back to the full set. Among multiple
+        prefill replicas the one with the lowest measured ship-time EWMA
+        (``_ttft_ewma``, fed by ``_handoff_ship``) takes the admission;
+        with no samples the order is unchanged (lowest replica index)."""
         from idunno_tpu.serve.admission import is_prefill_heavy
         policy = AutoscalePolicy.from_wire(g["policy"])
         active = sorted((r for r, m in g["replicas"].items()
                          if m["state"] == "active"
                          and r in self._pools),
                         key=self._replica_index)
+        quarantined = self._quarantined_hosts()
+        if quarantined:
+            healthy = [r for r in active
+                       if (self._pools.get(r) or {}).get("node")
+                       not in quarantined]
+            if healthy and len(healthy) < len(active):
+                if self.service is not None:
+                    self.service.metrics.record_counter(
+                        "quarantine_reroutes", len(active) - len(healthy))
+                active = healthy
         if not active:
             # transient mid-scale (every replica draining/unplaced):
             # land on any placed replica rather than failing the submit
@@ -1521,6 +1557,15 @@ class LMPoolManager:
             g["route_counts"]["prefill"] += 1
             pre = [r for r in active
                    if g["replicas"][r]["role"] == "prefill"]
+            if len(pre) > 1:
+                # measured-TTFT routing (ISSUE 20 satellite): soft-state
+                # ship-time EWMAs; unsampled replicas sort as 0.0 so they
+                # attract traffic until measured, and with no samples at
+                # all the key degenerates to the replica index — the
+                # pre-EWMA order
+                pre.sort(key=lambda r: (
+                    self._ttft_ewma.get(r, (0.0, 0))[0],
+                    self._replica_index(r)))
             has_decode = any(g["replicas"][r]["role"] == "decode"
                              for r in active)
             if pre and has_decode \
@@ -1531,6 +1576,16 @@ class LMPoolManager:
             if pre:
                 return pre[0], None
         return sticky(), None
+
+    def _observe_ttft(self, replica: str, seconds: float) -> None:
+        """Record one measured prefill ship time for a prefill replica.
+        Manager-local soft state (NOT journaled/wired): after failover
+        the adopter simply starts cold and routing degrades to the
+        replica-index order until it re-measures."""
+        with self._lock:
+            ewma, n = self._ttft_ewma.get(replica, (0.0, 0))
+            ewma = seconds if n == 0 else 0.7 * ewma + 0.3 * seconds
+            self._ttft_ewma[replica] = (ewma, n + 1)
 
     def _group_submit(self, name: str, prompt: list[int], max_new: int,
                       *, temperature: float, top_p: float, top_k: int,
@@ -1777,7 +1832,8 @@ class LMPoolManager:
                         if not q["delivered"])
                 replicas[r] = {"state": m["state"], "role": m["role"],
                                "t_drain": m["t_drain"],
-                               "undelivered": undelivered}
+                               "undelivered": undelivered,
+                               "node": (pool or {}).get("node")}
             decode = [r for r, m in g["replicas"].items()
                       if m["state"] == "active" and m["role"] == "decode"]
             return {"policy": AutoscalePolicy.from_wire(g["policy"]),
@@ -1828,6 +1884,14 @@ class LMPoolManager:
                 # scale-ahead's arrival-rate signal (ISSUE 18)
                 admitted = {c: int((cls or {}).get("admitted", 0))
                             for c, cls in classes.items()}
+                # service-level health feed (ISSUE 20): the replica's
+                # interactive p95 lands in the differential ledger as a
+                # second breach channel beside raw RPC latency (the
+                # ledger ignores it until a transport activated it)
+                if n > 0 and p95 > 0.0:
+                    health = getattr(self.membership, "health", None)
+                    if health is not None:
+                        health.observe_service(node, p95)
             out[r] = {"interactive_p95": p95, "n": n,
                       "backlog": backlog, "admitted": admitted}
         return out

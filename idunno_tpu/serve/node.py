@@ -42,6 +42,10 @@ class Node:
         # verbs (utils/spans.py)
         self.spans = SpanStore(host)
         self.membership = MembershipService(host, config, transport)
+        # attach the differential-health ledger to the transport: every
+        # reliable call from this node now feeds per-peer latency/error
+        # EWMAs (gray-failure defense; membership/health.py)
+        transport.health = self.membership.health
         self.store = FileStoreService(host, config, transport,
                                       self.membership, data_dir)
         self.store.spans = self.spans

@@ -40,7 +40,7 @@ import uuid
 import zlib
 
 from idunno_tpu.comm.message import Message
-from idunno_tpu.comm.retry import call_with_retry
+from idunno_tpu.comm.retry import call_hedged, call_with_retry
 from idunno_tpu.comm.transport import Transport, TransportError
 from idunno_tpu.config import ClusterConfig
 from idunno_tpu.membership.epoch import (check_payload, observe_payload,
@@ -324,9 +324,47 @@ class FileStoreService:
         """(latest version, holder hosts) — metadata only, no blob transfer.
         Lets readers with a local replica decide whether it is CURRENT
         before serving it (a stale local copy must not masquerade as the
-        latest). Raises StoreError when the file does not exist."""
-        out = self._master_call(Message(MessageType.STAT, self.host,
-                                        {"name": sdfs_name}))
+        latest). Raises StoreError when the file does not exist.
+
+        With ``config.hedge_reads`` on, the pure STAT read tail-hedges
+        (HEDGE_SAFE; comm/retry.py:call_hedged) across the first two
+        master-chain targets: a read the primary has not answered within
+        ``hedge_delay_s`` fires at the backup and the first reply wins —
+        masters max-merge versions so either answer is valid. Any hedge
+        trouble (errors, not_master, a single-target chain) degrades to
+        the plain retrying chain below, never fails the read."""
+        msg = Message(MessageType.STAT, self.host, {"name": sdfs_name})
+        cfg = self.config
+        if cfg.hedge_reads:
+            seen: set[str] = set()
+            chain = [t for t in (self.membership.acting_master(),
+                                 cfg.coordinator, cfg.standby_coordinator)
+                     if t != self.host and not (t in seen or seen.add(t))]
+
+            def leg(t: str) -> Message:
+                out = self.transport.call(t, SERVICE, msg, timeout=30.0)
+                if out is None:
+                    raise TransportError(f"{t}: no stat reply",
+                                         reason="timeout")
+                observe_payload(self.membership.epoch, out.payload)
+                if out.type is MessageType.ERROR:
+                    # not_master / stale epoch / missing file: let the
+                    # failover chain below classify it properly
+                    raise TransportError(
+                        f"{t}: {out.payload.get('error', 'stat error')}",
+                        reason="timeout")
+                return out
+
+            if len(chain) >= 2:
+                try:
+                    out = call_hedged(
+                        [lambda: leg(chain[0]), lambda: leg(chain[1])],
+                        delay_s=cfg.hedge_delay_s)
+                    return (int(out.payload["version"]),
+                            list(out.payload["hosts"]))
+                except TransportError:
+                    pass
+        out = self._master_call(msg)
         return int(out.payload["version"]), list(out.payload["hosts"])
 
     def local_files(self) -> dict[str, list[int]]:

@@ -1786,3 +1786,204 @@ def run_lm_distserve_bench(platform: str, device_kind: str,
             out["handoff"]["tokens_per_s"] * 2.0 * n_params
             / peak_bf16, 4)
     return out
+
+
+def _gray_hedged_poll(transport, hosts, cursor: int, *, delay_s: float,
+                      merged: dict):
+    """Tail-hedged ``lm_poll`` (contracts.HEDGE_SAFE): fire the primary
+    ring host; if it has not answered within ``delay_s``, fire the backup
+    and take the FIRST reply. The read is cursor-addressed — the same
+    cursor returns the same row on either replica — so BOTH replies'
+    rows land in ``merged`` keyed by cursor (the loser via ``on_late``)
+    and duplicates collapse: delivery stays exactly-once no matter which
+    replica answers first or how late the loser lands."""
+    from idunno_tpu.comm.message import Message
+    from idunno_tpu.comm.retry import call_hedged
+    from idunno_tpu.utils.types import MessageType
+
+    def fetch(host: str):
+        def go():
+            return transport.call(
+                host, "control",
+                Message(MessageType.INFERENCE, transport.host,
+                        {"verb": "lm_poll", "cursor": cursor}))
+        return go
+
+    def merge(reply) -> None:
+        if reply is not None and "row" in reply.payload:
+            merged.setdefault(reply.payload["cursor"],
+                              reply.payload["row"])
+
+    out = call_hedged([fetch(h) for h in hosts], delay_s=delay_s,
+                      on_late=merge)
+    merge(out)
+    return out
+
+
+def run_lm_gray_bench(platform: str, device_kind: str, n_devices: int,
+                      peak_bf16: float | None, *, deadline: float,
+                      compact: bool = False) -> dict:
+    """BENCH_SUITE=lm_gray: what the gray-failure defense buys a client
+    whose replica limps without dying (ISSUE 20).
+
+    Real decode work first: one `DecodeServer` drains a request batch
+    and its completions become the rows two in-proc ring replicas serve
+    (standby replication means either replica can answer ``lm_poll``).
+    Replica r1 then limps — `InProcNetwork.slow_host` with a REAL
+    ``sleep_s`` tail (bench mode; chaos schedules stay sleepless), so
+    hedging has a real tail to cut, while the synthesized latency factor
+    feeds the client's differential `HealthLedger`. Three polling arms
+    over the identical cursor stream:
+
+    ``baseline``    round-robin, no defense: every other poll eats the
+                    full gray tail for the whole run.
+    ``quarantine``  an attached ledger ticks per poll; once r1 is
+                    QUARANTINED the client routes around it. The tail
+                    vanishes after ``detect_poll`` — but every poll
+                    before detection still ate it.
+    ``hedged``      quarantine routing PLUS `_gray_hedged_poll` with a
+                    hedge delay well under the tail: pre-detection polls
+                    whose primary is the limping replica are answered by
+                    the healthy backup at ~``hedge_ms`` instead of the
+                    tail (headline; ``hedge_wins`` > 0 is the proof the
+                    backup actually won, not just fired).
+
+    Headline is the hedged arm's delivered-tokens/sec (client-observed:
+    tokens in delivered rows over the arm's wall clock), so the gray
+    tail directly costs the headline in the undefended arms. ``p99_cut``
+    carries the client-observed p99 comparison."""
+    from idunno_tpu.comm.inproc import InProcNetwork
+    from idunno_tpu.comm.message import Message
+    from idunno_tpu.comm.retry import reset_retry_counters, retry_counters
+    from idunno_tpu.engine.serve_lm import DecodeServer
+    from idunno_tpu.membership.health import HealthLedger, HealthPolicy
+    from idunno_tpu.models.transformer import TransformerLM
+    from idunno_tpu.utils.types import MessageType
+
+    cfg = lm_bench_config(platform)
+    tpu = platform == "tpu"
+    n_requests = _env_int("BENCH_LM_GRAY_REQUESTS", 3 * cfg["slots"])
+    n_polls = _env_int("BENCH_LM_GRAY_POLLS", 160 if tpu else 120)
+    tail_s = _env_int("BENCH_LM_GRAY_TAIL_MS", 25) / 1000.0
+    hedge_s = _env_int("BENCH_LM_GRAY_HEDGE_MS", 8) / 1000.0
+    out: dict = {"config": {k: v for k, v in cfg.items()},
+                 "platform": platform, "device_kind": device_kind,
+                 "n_devices": n_devices,
+                 "workload": {"n_requests": n_requests,
+                              "n_polls": n_polls,
+                              "tail_ms": round(tail_s * 1000, 1),
+                              "hedge_ms": round(hedge_s * 1000, 1)}}
+    dt = jnp.bfloat16
+    model = TransformerLM(vocab=cfg["vocab"], dim=cfg["dim"],
+                          depth=cfg["depth"], num_heads=cfg["heads"],
+                          causal=True, dtype=dt, param_dtype=dt)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    n_params, _ = _count_params(params)
+    out["n_params"] = n_params
+
+    max_new = min(cfg["decode_steps"] + 1,
+                  cfg["max_len"] - cfg["prompt_len"])
+    rng = np.random.default_rng(29)
+    srv = DecodeServer(model, params, slots=cfg["slots"],
+                       prompt_len=cfg["prompt_len"],
+                       max_len=cfg["max_len"],
+                       decode_steps=cfg["decode_steps"])
+    srv.warmup()
+    t0 = time.perf_counter()
+    for _ in range(n_requests):
+        srv.submit([int(t) for t in
+                    rng.integers(1, cfg["vocab"], size=cfg["prompt_len"])],
+                   max_new=max_new)
+    comps = srv.run_until_drained()
+    drain_s = time.perf_counter() - t0
+    gen = sum(len(c.tokens) - c.prompt_len for c in comps)
+    out["decode"] = {"requests": len(comps), "drain_s": round(drain_s, 3),
+                     "tokens_per_s": round(gen / drain_s, 1)}
+    rows = [{"rid": c.id, "n_tokens": len(c.tokens) - c.prompt_len}
+            for c in comps]
+
+    net = InProcNetwork(seed=20)
+    hosts = ("r0", "r1")
+    client = net.transport("c0")
+    for h in hosts:
+        t = net.transport(h)
+
+        def handle(service, msg, _h=h):
+            cur = msg.payload["cursor"]
+            return Message(MessageType.ACK, _h,
+                           {"cursor": cur,
+                            "row": dict(rows[cur % len(rows)], node=_h)})
+        t.serve("control", handle)
+    # factor feeds the ledger's synthesized latency; sleep_s is the REAL
+    # tail the client's wall clock (and the hedge) actually sees
+    net.slow_host("r1", 10.0, sleep_s=tail_s)
+    # real-time detector sized to the bench: a handful of tail-length
+    # polls must be enough to quarantine, or the arms measure detector
+    # patience instead of routing
+    pol = HealthPolicy(min_samples=4, suspect_window_s=2 * tail_s,
+                       probation_s=8 * tail_s)
+
+    def run_arm(mode: str) -> dict:
+        ledger = None
+        if mode != "baseline":
+            ledger = HealthLedger("c0", policy=pol,
+                                  clock=time.monotonic)
+        client.health = ledger
+        reset_retry_counters()
+        merged: dict = {}
+        lats: list[float] = []
+        detect_poll = None
+        t1 = time.perf_counter()
+        for i in range(n_polls):
+            order = [hosts[i % 2], hosts[(i + 1) % 2]]
+            if ledger is not None:
+                q = ledger.quarantined()
+                order.sort(key=lambda h: h in q)   # healthy first, stable
+            t2 = time.perf_counter()
+            if mode == "hedged":
+                _gray_hedged_poll(client, order, i, delay_s=hedge_s,
+                                  merged=merged)
+            else:
+                reply = client.call(
+                    order[0], "control",
+                    Message(MessageType.INFERENCE, "c0",
+                            {"verb": "lm_poll", "cursor": i}))
+                merged.setdefault(reply.payload["cursor"],
+                                  reply.payload["row"])
+            lats.append(time.perf_counter() - t2)
+            if ledger is not None:
+                ledger.tick()
+                if detect_poll is None and "r1" in ledger.quarantined():
+                    detect_poll = i
+        wall = time.perf_counter() - t1
+        toks = sum(r["n_tokens"] for r in merged.values())
+        arm = {"polls": n_polls, "wall_s": round(wall, 3),
+               "rows_delivered": len(merged),
+               "tokens_per_s": round(toks / wall, 1),
+               "p50_ms": _pct_ms(lats, 50), "p95_ms": _pct_ms(lats, 95),
+               "p99_ms": _pct_ms(lats, 99)}
+        if ledger is not None:
+            arm["detect_poll"] = detect_poll
+            arm["health"] = ledger.gauges()
+        if mode == "hedged":
+            c = retry_counters()
+            arm["hedged_rpcs"] = c["hedged_rpcs"]
+            arm["hedge_wins"] = c["hedge_wins"]
+        client.health = None
+        return arm
+
+    # headline first: a deadline hit must cost the comparison arms
+    out["hedged"] = run_arm("hedged")
+    if time.perf_counter() < deadline:
+        out["baseline"] = run_arm("baseline")
+    if time.perf_counter() < deadline:
+        out["quarantine"] = run_arm("quarantine")
+    net.clear_slow()
+    if "baseline" in out:
+        b, h = out["baseline"]["p99_ms"], out["hedged"]["p99_ms"]
+        out["p99_cut"] = {
+            "baseline_p99_ms": b, "hedged_p99_ms": h,
+            "quarantine_p99_ms": out.get("quarantine", {}).get("p99_ms"),
+            "hedged_vs_baseline": round(h / b, 3) if b else None}
+    return out

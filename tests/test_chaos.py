@@ -214,6 +214,76 @@ def test_autoscale_partition_mid_scale_out(tmp_path):
     assert summary["grp_acked"] >= 2
 
 
+def test_fail_slow_quarantine_drain_and_probation(tmp_path):
+    """ISSUE 20 directed schedule: one group-replica host limps 10x
+    (synthesized latency — its heartbeats flow the whole time). The
+    differential plane must QUARANTINE it within the policy window with
+    ZERO false LEAVEs, the autoscaler must drain-and-replace its replica
+    with zero lost/doubled requests, and once the fault clears probation
+    must heal every ledger back to all-healthy."""
+    c = ChaosCluster(616, str(tmp_path), autoscale=True, fail_slow=True)
+    c.pump_work()        # replication cycle: standby snapshot has the group
+    for client in ("n2", "n3", "n4"):
+        c.op_lm_group(client)
+    for _ in range(3):   # claims + initial verdict-free gossip settle
+        c.pump_membership(waves=1)
+        c.pump_work()
+    owner = c._pool_owner(c.LM_GROUP)
+    mgr = c.managers[owner]
+    with mgr._lock:
+        replica = sorted(mgr._groups[c.LM_GROUP]["replicas"])[0]
+        victim = mgr._pools[replica]["node"]
+    # override the scripted choice: the directed fault targets the host
+    # actually serving a group replica, so the drain path has real work
+    c.slow_victim = victim
+    c.slow_prober = prober = "n2" if victim != "n2" else "n3"
+    c.net.slow_host(victim, 10.0)
+    for _ in range(14):
+        c.probe_sweep(prober)
+        c.pump_membership(waves=1)
+        c.pump_work()
+        c.record_fences()
+        c._sample_fail_slow()
+        # gray, not fail-stop: NO datagram chaos in this schedule, so
+        # the victim must never leave anyone's alive view, not even once
+        for h in c.cfg.hosts:
+            assert victim in c.members[h].members.alive_hosts(), \
+                f"{h} forged a LEAVE for the limping {victim}"
+    assert c.saw_quarantine
+    assert c.members[prober].health.state(victim) == "quarantined"
+    # quarantine-and-drain (autoscaler step 1b): the owner's tick must
+    # have journaled a replacement spawn AND a drain of the victim's
+    # replica, both stamped quarantine=True
+    with mgr._lock:
+        decisions = [dict(d) for d in
+                     mgr._groups[c.LM_GROUP]["decisions"]]
+    q_spawns = [d for d in decisions
+                if d["action"] == "spawn" and d.get("quarantine")]
+    q_drains = [d for d in decisions
+                if d["action"] == "retire_start" and d.get("quarantine")]
+    assert q_spawns and q_spawns[0].get("replaced") == replica
+    assert q_drains and q_drains[0]["replica"] == replica
+    # work keeps landing mid-drain (the draining replica still delivers
+    # its journal; new admissions route to healthy replicas only)
+    c.op_lm_group("n3")
+    c.pump_work()
+    # fault clears -> probe-driven probation heals WITHOUT converge's
+    # help: monitor_once keeps probing watched peers, samples decay
+    c.net.clear_slow(victim)
+    for _ in range(25):
+        c.pump_membership(waves=1)
+        c.pump_work()
+        if all(not c.members[h].health.watched() for h in c.cfg.hosts):
+            break
+    assert c.members[prober].health.state(victim) == "healthy"
+    c.converge()
+    summary = c.check_invariants()     # zero lost/doubled through drain
+    assert summary["quarantine_seen"]
+    assert not c.violations
+    for h in c.cfg.hosts:
+        assert c.members[h].health.state(victim) == "healthy"
+
+
 def test_multi_pool_seeded_schedule_invariants(tmp_path):
     """Two concurrent managed pools under the full seeded fault surface:
     per-pool fence scopes, cross-pool delivery attribution, and the

@@ -1,0 +1,225 @@
+"""Differential health ledger unit suite (ISSUE 20, fast lane): EWMA
+math, the leave-one-out fleet median, the typed healthy → suspect →
+quarantined → probation → healthy state machine with its hysteresis
+windows, and the seq-wins gossip merge — all on a fake clock, no
+network. The integration half (transports feeding ledgers, verdicts on
+membership payloads, zero forged LEAVEs) lives in tests/test_membership
+.py and tests/test_chaos.py."""
+import pytest
+
+from idunno_tpu.membership.health import (HealthLedger, HealthPolicy)
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make(host: str = "n0", clock: FakeClock | None = None,
+         **pol) -> HealthLedger:
+    defaults = dict(min_samples=3, suspect_window_s=1.0, probation_s=2.0)
+    defaults.update(pol)
+    return HealthLedger(host, HealthPolicy(**defaults),
+                        clock=clock or FakeClock())
+
+
+def feed(led: HealthLedger, fleet: dict[str, float], n: int = 5) -> None:
+    """n latency samples per peer (constant -> EWMA converges exactly)."""
+    for _ in range(n):
+        for peer, lat in fleet.items():
+            led.observe(peer, lat)
+
+
+# -- EWMA math ------------------------------------------------------------
+
+def test_ewma_math_and_error_rate():
+    led = make()
+    a = led.policy.ewma_alpha
+    led.observe("n1", 0.10)
+    assert led.score("n1") == pytest.approx(0.10)   # first sample seeds
+    led.observe("n1", 0.20)
+    assert led.score("n1") == pytest.approx((1 - a) * 0.10 + a * 0.20)
+    # error-rate EWMA: errors push toward 1, successes decay toward 0
+    led2 = make()
+    for _ in range(20):
+        led2.observe("n1", 0.01, error=True)
+    assert led2._peers["n1"].err > 0.95
+    for _ in range(20):
+        led2.observe("n1", 0.01, error=False)
+    assert led2._peers["n1"].err < 0.05
+    # self-observations are dropped
+    led.observe("n0", 9.0)
+    assert "n0" not in led._peers
+
+
+def test_observe_service_gated_until_active():
+    """A ledger nobody wired to a transport must stay inert: the manager
+    gauge sweep alone (observe_service) derives nothing."""
+    led = make()
+    led.observe_service("n1", 5.0)
+    assert "n1" not in led._peers and not led.active
+    led.observe(led.host, 0.01)             # self-observation: still inert
+    assert not led.active
+    led.observe("n2", 0.01)                 # a real RPC sample activates
+    assert led.active
+    led.observe_service("n1", 5.0)
+    assert led._peers["n1"].serv_n == 1
+
+
+# -- leave-one-out fleet median -------------------------------------------
+
+def test_leave_one_out_median_convicts_dominant_peer():
+    """A ledger that mostly talks to the limping peer must still convict
+    it: judged against the median of the OTHER measured peers, never a
+    baseline its own EWMA dominates."""
+    led = make()
+    feed(led, {"slow": 0.30, "n2": 0.01})   # only one healthy baseline
+    led.tick()
+    assert led.state("slow") == "suspect"
+    assert led.state("n2") == "healthy"     # judged against slow: 0.01 < floor
+
+
+def test_sole_peer_judged_by_absolute_floor():
+    """With no other measured peer the median is 0 and the absolute
+    floor governs — a microsecond-noise fleet never breaches on noise,
+    a genuinely slow sole peer still convicts."""
+    led = make(floor_s=0.05)
+    feed(led, {"only": 0.01})
+    led.tick()
+    assert led.state("only") == "healthy"   # under the floor
+    led2 = make(floor_s=0.05)
+    feed(led2, {"only": 0.30})
+    led2.tick()
+    assert led2.state("only") == "suspect"  # over the floor, median 0
+
+
+def test_error_rate_breach_path():
+    led = make(error_rate=0.5)
+    for _ in range(6):
+        led.observe("flaky", 0.001, error=True)
+        led.observe("n2", 0.001)
+    led.tick()
+    assert led.state("flaky") == "suspect"
+
+
+# -- state machine + hysteresis -------------------------------------------
+
+def test_full_cycle_suspect_quarantine_probation_heal():
+    clock = FakeClock()
+    led = make(clock=clock)
+    fleet = {"limp": 0.30, "n2": 0.01, "n3": 0.01}
+    feed(led, fleet)
+    assert led.tick() == [("limp", "healthy", "suspect")]
+    assert led.unhealthy() == {"limp"} and led.watched() == {"limp"}
+    assert led.quarantined() == set()
+    # breach must SUSTAIN through the suspect window before quarantine
+    clock.advance(0.5)
+    feed(led, fleet, n=1)
+    assert led.tick() == []
+    clock.advance(0.6)
+    feed(led, fleet, n=1)
+    assert led.tick() == [("limp", "suspect", "quarantined")]
+    assert led.quarantined() == {"limp"}
+    assert led.gauges()["quarantined_nodes"] == 1
+    assert led.gauges()["node_health_score"] > 1.0
+    # recovery: healthy samples decay the EWMA below threshold
+    for _ in range(30):
+        feed(led, {"limp": 0.01, "n2": 0.01, "n3": 0.01}, n=1)
+    assert led.tick() == [("limp", "quarantined", "probation")]
+    # probation holds (still watched, not yet trusted)...
+    clock.advance(1.0)
+    assert led.tick() == [] and led.watched() == {"limp"}
+    # ...until the clean window elapses
+    clock.advance(1.1)
+    assert led.tick() == [("limp", "probation", "healthy")]
+    assert led.watched() == set()
+
+
+def test_probation_relapse_returns_to_quarantine():
+    """Hysteresis: a breach during probation goes straight back to
+    QUARANTINED — no second trip through the suspect window."""
+    clock = FakeClock()
+    led = make(clock=clock)
+    fleet = {"limp": 0.30, "n2": 0.01, "n3": 0.01}
+    feed(led, fleet)
+    led.tick()
+    clock.advance(1.1)
+    feed(led, fleet, n=1)
+    led.tick()
+    assert led.state("limp") == "quarantined"
+    for _ in range(30):
+        feed(led, {"limp": 0.01, "n2": 0.01, "n3": 0.01}, n=1)
+    led.tick()
+    assert led.state("limp") == "probation"
+    feed(led, fleet, n=10)                  # relapse mid-probation
+    assert led.tick() == [("limp", "probation", "quarantined")]
+
+
+def test_suspect_clears_without_quarantine_on_fast_recovery():
+    clock = FakeClock()
+    led = make(clock=clock)
+    feed(led, {"blip": 0.30, "n2": 0.01, "n3": 0.01})
+    led.tick()
+    assert led.state("blip") == "suspect"
+    for _ in range(30):
+        feed(led, {"blip": 0.01, "n2": 0.01, "n3": 0.01}, n=1)
+    assert led.tick() == [("blip", "suspect", "healthy")]
+
+
+# -- gossip merge ---------------------------------------------------------
+
+def test_gossip_merge_seq_wins_and_severity_tiebreak():
+    led = make()
+    led.observe_all({"n3": ["quarantined", 2, 0.3]})
+    assert led.state("n3") == "quarantined"
+    led.observe_all({"n3": ["healthy", 1, 0.0]})     # stale seq loses
+    assert led.state("n3") == "quarantined"
+    led.observe_all({"n3": ["healthy", 3, 0.0]})     # fresher seq wins
+    assert led.state("n3") == "healthy"
+    led.observe_all({"n3": ["suspect", 3, 0.2]})     # tie: severe wins
+    assert led.state("n3") == "suspect"
+    led.observe_all({"n3": ["healthy", 3, 0.0]})     # tie: mild loses
+    assert led.state("n3") == "suspect"
+    # malformed / self rows are ignored, never raise
+    led.observe_all(None)
+    led.observe_all({"n4": ["bogus-state", 1, 0.0], "n5": ["suspect"],
+                     led.host: ["quarantined", 9, 9.9]})
+    assert led.state("n4") == "healthy"
+    assert led.state(led.host) == "healthy"
+
+
+def test_gossip_adoption_restarts_local_windows():
+    """Adopting SUSPECT/PROBATION stamps the local breach/clear clocks:
+    our own next tick measures windows from adoption time, not from a
+    zero that would instantly quarantine."""
+    clock = FakeClock(t=500.0)
+    led = make(clock=clock)
+    led.observe_all({"n3": ["suspect", 1, 0.3]})
+    assert led._peers["n3"].t_breach == 500.0
+    led.observe_all({"n3": ["probation", 2, 0.1]})
+    assert led._peers["n3"].t_clear == 500.0
+    # no local evidence (n < min_samples): tick derives nothing, the
+    # gossiped verdict stands
+    assert led.tick() == []
+    assert led.state("n3") == "probation"
+
+
+def test_view_all_roundtrip_carries_only_nontrivial_rows():
+    led = make()
+    feed(led, {"limp": 0.30, "n2": 0.01, "n3": 0.01})
+    led.tick()
+    view = led.view_all()
+    assert "limp" in view and view["limp"][0] == "suspect"
+    assert "n2" not in view                 # healthy seq-0: no information
+    other = make("n9")
+    other.observe_all(view)
+    assert other.state("limp") == "suspect"
+    assert other.score("limp") == pytest.approx(0.30)   # gossiped score
+    assert [r for r in other.table() if r[0] == "limp"] \
+        == [("limp", "suspect", 0.3)]

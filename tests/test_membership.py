@@ -275,3 +275,59 @@ def test_delayed_pongs_false_leave_then_refute(cluster):
     pump(services, clock, waves=2)
     for h in cfg.hosts:
         assert services[h].members.is_alive("n3"), h
+
+
+def test_fail_slow_suspect_without_leave(cluster):
+    """ISSUE 20, the complement of the delayed-pong test above: a peer
+    that merely LIMPS (10x handler latency, every heartbeat still
+    delivered) goes SUSPECT then QUARANTINED on the differential health
+    ledger, gossips fleet-wide, and heals through PROBATION when the
+    fault clears — while membership NEVER marks it LEAVE at any point.
+    Gray-failure detection and fail-stop detection are separate
+    machines; the health layer must not forge what the SWIM detector
+    refused to."""
+    cfg, net, clock, services = cluster
+    pump(services, clock)
+    # NB: net.transport() MINTS a node endpoint (replacing any prior
+    # registration) — wire the ledgers through the services' own
+    for h in cfg.hosts:
+        t = services[h].transport
+        t.health = services[h].health
+        t.serve("echo",
+                lambda svc, m, _h=h: Message(MessageType.ACK, _h))
+    net.slow_host("n3", 10.0)
+    t0 = services["n0"].transport
+
+    def sweep() -> None:
+        # one latency sample against every peer: the leave-one-out
+        # median needs healthy baselines beside the limping outlier
+        for peer in cfg.hosts[1:]:
+            t0.call(peer, "echo", Message(MessageType.PING, "n0"))
+        services["n0"].health.tick()
+        pump(services, clock, waves=1)
+
+    led = services["n0"].health
+    for _ in range(6):                       # past min_samples
+        sweep()
+    assert led.state("n3") in ("suspect", "quarantined")
+    assert services["n0"].members.is_alive("n3")   # no LEAVE forged
+    while led.state("n3") != "quarantined":  # ride out suspect_window_s
+        sweep()
+    pump(services, clock, waves=3)           # verdict gossips outward
+    for h in cfg.hosts:
+        if h == "n3":
+            continue
+        assert services[h].health.state("n3") == "quarantined", h
+        assert services[h].members.is_alive("n3"), h
+
+    net.clear_slow()
+    for _ in range(40):                      # probation -> healthy
+        sweep()
+        services["n0"].monitor_once()        # probes keep evidence flowing
+        if led.state("n3") == "healthy":
+            break
+    assert led.state("n3") == "healthy"
+    pump(services, clock, waves=4)           # the heal gossips too
+    for h in cfg.hosts:
+        assert services[h].health.state("n3") == "healthy", h
+        assert services[h].members.is_alive("n3"), h

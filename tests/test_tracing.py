@@ -247,7 +247,73 @@ def test_retry_counters_and_exhaustion():
     assert c["retry_attempts"] == 3, c
     assert c["retry_exhausted"] == 1, c
     reset_retry_counters()
-    assert retry_counters() == {"retry_attempts": 0, "retry_exhausted": 0}
+    assert retry_counters() == {"retry_attempts": 0, "retry_exhausted": 0,
+                                "hedged_rpcs": 0, "hedge_wins": 0}
+
+
+def test_call_hedged_win_loss_merge_and_error_paths():
+    """ISSUE 20: the tail-hedged read primitive. A slow primary loses to
+    the hedged backup (hedge_wins counts), a fast primary never hedges,
+    the loser's late success still reaches on_late, and an all-fail call
+    raises the last error."""
+    import threading
+    import time
+
+    from idunno_tpu.comm.retry import (
+        TransportError, call_hedged, reset_retry_counters, retry_counters)
+
+    # slow primary, fast backup: backup wins, loser merges via on_late
+    reset_retry_counters()
+    late, got_late = [], threading.Event()
+
+    def slow():
+        time.sleep(0.08)
+        return "primary"
+
+    out = call_hedged([slow, lambda: "backup"], delay_s=0.01,
+                      on_late=lambda r: (late.append(r), got_late.set()))
+    assert out == "backup"
+    c = retry_counters()
+    assert c["hedged_rpcs"] == 1 and c["hedge_wins"] == 1, c
+    assert got_late.wait(2.0) and late == ["primary"]
+
+    # fast primary: the hedge never fires, no counters move
+    reset_retry_counters()
+    assert call_hedged([lambda: "fast", slow], delay_s=0.5) == "fast"
+    c = retry_counters()
+    assert c["hedged_rpcs"] == 0 and c["hedge_wins"] == 0, c
+
+    # primary errors BEFORE the delay expires: the error surfaces and the
+    # backup never fires — hedging defends against slowness; fast
+    # failures belong to the retry layer (call_with_retry wraps it)
+    reset_retry_counters()
+
+    def boom():
+        raise TransportError("boom", reason="timeout")
+
+    with pytest.raises(TransportError):
+        call_hedged([boom, lambda: "backup"], delay_s=0.5)
+    assert retry_counters()["hedged_rpcs"] == 0
+
+    # slow-failing primary: the hedge fires, the backup's success wins
+    def slow_boom():
+        time.sleep(0.08)
+        raise TransportError("late boom", reason="timeout")
+
+    reset_retry_counters()
+    assert call_hedged([slow_boom, lambda: "backup"],
+                       delay_s=0.01) == "backup"
+    c = retry_counters()
+    assert c["hedged_rpcs"] == 1 and c["hedge_wins"] == 1, c
+
+    # every thunk fails: the last error surfaces
+    with pytest.raises(TransportError):
+        call_hedged([boom, boom], delay_s=0.0)
+
+    # degenerate single-thunk call: plain passthrough
+    reset_retry_counters()
+    assert call_hedged([lambda: 7], delay_s=0.0) == 7
+    assert retry_counters()["hedged_rpcs"] == 0
 
 
 # -- chaos-backed: retry dedup and failover adoption ----------------------
@@ -516,6 +582,15 @@ def test_two_node_cluster_collects_lm_trace(tmp_path):
             in text
         assert 'idunno_events_total{node="n0",name="predictive_spawns"}' \
             in text
+        # ISSUE 20: the differential-health gauges and the gray-failure
+        # counters scrape unconditionally — the ledger exists on every
+        # node (zero-scored until a transport observation lands), and
+        # the hedge counters ride retry_counters() beside the retry ones
+        assert 'idunno_gauge{node="n0",name="node_health_score"}' in text
+        assert 'idunno_gauge{node="n0",name="quarantined_nodes"}' in text
+        for c in ("hedged_rpcs", "hedge_wins", "early_redispatches",
+                  "quarantine_reroutes"):
+            assert f'idunno_events_total{{node="n0",name="{c}"}}' in text, c
         remote = _call(nodes["n0"], {"verb": "metrics_export",
                                      "host": "n1"})["text"]
         assert 'node="n1"' in remote

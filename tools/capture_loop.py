@@ -114,6 +114,15 @@ STEPS = [
      {"BENCH_SUITE": "lm_distserve", "BENCH_TIME_BUDGET_S": "600"},
      [sys.executable, "bench.py"],
      "BENCH_LAST_GOOD_lm_distserve.json"),
+    # ISSUE 20: gray-failure defense — real decode completions polled
+    # through one limping ring replica: undefended round-robin vs
+    # quarantine-only vs quarantine + tail-hedged lm_poll (p99 cut,
+    # detection poll index, hedge win counters); the decode drain runs
+    # on chip, the RPC arms are backend-independent
+    ("gray_suite",
+     {"BENCH_SUITE": "lm_gray", "BENCH_TIME_BUDGET_S": "600"},
+     [sys.executable, "bench.py"],
+     "BENCH_LAST_GOOD_lm_gray.json"),
     # ISSUE 6: one traced request through a real pool on chip — the
     # admit→queue_wait→prefill→decode_step waterfall with TPU latencies
     # (tools/trace_export.py --capture; cheap: tiny model, one request)

@@ -57,9 +57,17 @@ def main() -> int:
     # faults must replay or fall back, never lose or double a request
     # (ISSUE 18; single-feature seed, replayable in isolation)
     ap.add_argument("--distserve", type=int, default=1)
-    # second concurrent managed pool from schedule 4 on (0 disables):
-    # per-pool fence scopes + cross-pool isolation under the fault
-    # surface (schedules 0-3 keep their single-feature seeds replayable)
+    # gray-failure schedule for schedule 4 (0 disables): one scripted
+    # limping host (synthesized latency, heartbeats alive) under the
+    # full fault surface + the autoscale group so quarantine-and-drain
+    # has replicas to move; invariants: quarantine fires with zero
+    # false LEAVEs, zero lost/doubled requests through the drain,
+    # probation heals every ledger post-clear (ISSUE 20)
+    ap.add_argument("--fail-slow", type=int, default=1)
+    # second concurrent managed pool from schedule 5 on (schedule 4 when
+    # --fail-slow 0; 0 disables): per-pool fence scopes + cross-pool
+    # isolation under the fault surface (schedules 0-4 keep their
+    # single-feature seeds replayable)
     ap.add_argument("--multi-pool", type=int, default=1)
     # lint preflight on by default: a wall-clock/rng draw in a chaos-
     # reachable module makes every printed seed unreplayable, so soaking
@@ -84,6 +92,11 @@ def main() -> int:
     passed, failures = 0, []
     worst_convergence = 0.0
     epochs_total = 0
+    quarantines_seen = 0
+    # (seed, kwargs, digest) of the autoscale schedule: replayed once
+    # after the loop to assert the Holt forecast (predicted_rate on
+    # every autoscaler decision) reproduces bit-for-bit from the seed
+    forecast_probe = None
     pool_epochs: dict[str, int] = {}
     # ISSUE 15 ownership ledger: the final rendezvous owner per scope
     # (last schedule's converged claim map wins — same scopes recur
@@ -97,36 +110,44 @@ def main() -> int:
             "prefix_remote_hits": 0, "prefix_published": 0,
             "prefix_warmed": 0, "lmh_acked": 0, "handoff_routed": 0,
             "handoff_blocks_shipped": 0, "handoff_blocks_adopted": 0}
+    multi_pool_from = 5 if args.fail_slow else 4
     for i in range(args.schedules):
         seed = args.seed0 + i
+        kwargs = dict(
+            steps=args.steps,
+            chaos={"drop": args.drop, "dup": args.dup,
+                   "delay": args.delay, "seed": seed},
+            # first schedule runs the managed pool with chunked
+            # prefill AND a TP shape in its journaled spec
+            # (ISSUEs 7/9): deferred completions + replayed
+            # n_model under the same fault surface
+            prefill_chunk=args.prefill_chunk if i == 0 else 0,
+            n_model=args.n_model if i == 0 else 1,
+            # second schedule runs the autoscaled replica group
+            # (ISSUE 11) — separate from schedule 0 so each
+            # feature's faults replay in isolation by seed. The gray
+            # schedule rides the group too: quarantine-and-drain
+            # needs replicas to drain (ISSUE 20)
+            autoscale=bool(args.autoscale) and i == 1
+            or bool(args.fail_slow) and i == 4,
+            # third schedule runs the cluster prefix cache
+            # (ISSUE 17): ring-published KV chains fetched back
+            # under the fault surface, content-checked inline
+            cluster_prefix=bool(args.cluster_prefix) and i == 2,
+            # fourth schedule runs the DistServe handoff group
+            # (ISSUE 18): KV-block ships between role-split
+            # replicas, journaled + replayed under faults
+            distserve=bool(args.distserve) and i == 3,
+            # fifth schedule runs the gray-failure fault (ISSUE 20):
+            # scripted limping host + fleet-sampling prober
+            fail_slow=bool(args.fail_slow) and i == 4,
+            # later schedules run TWO concurrent managed pools
+            # (ISSUE 14): per-pool fences + cross-pool isolation
+            multi_pool=bool(args.multi_pool) and i >= multi_pool_from,
+            n_hosts=args.hosts)
         try:
             with tempfile.TemporaryDirectory() as d:
-                out = run_seeded_schedule(
-                    seed, d, steps=args.steps,
-                    chaos={"drop": args.drop, "dup": args.dup,
-                           "delay": args.delay, "seed": seed},
-                    # first schedule runs the managed pool with chunked
-                    # prefill AND a TP shape in its journaled spec
-                    # (ISSUEs 7/9): deferred completions + replayed
-                    # n_model under the same fault surface
-                    prefill_chunk=args.prefill_chunk if i == 0 else 0,
-                    n_model=args.n_model if i == 0 else 1,
-                    # second schedule runs the autoscaled replica group
-                    # (ISSUE 11) — separate from schedule 0 so each
-                    # feature's faults replay in isolation by seed
-                    autoscale=bool(args.autoscale) and i == 1,
-                    # third schedule runs the cluster prefix cache
-                    # (ISSUE 17): ring-published KV chains fetched back
-                    # under the fault surface, content-checked inline
-                    cluster_prefix=bool(args.cluster_prefix) and i == 2,
-                    # fourth schedule runs the DistServe handoff group
-                    # (ISSUE 18): KV-block ships between role-split
-                    # replicas, journaled + replayed under faults
-                    distserve=bool(args.distserve) and i == 3,
-                    # schedules 4+ run TWO concurrent managed pools
-                    # (ISSUE 14): per-pool fences + cross-pool isolation
-                    multi_pool=bool(args.multi_pool) and i >= 4,
-                    n_hosts=args.hosts)
+                out = run_seeded_schedule(seed, d, **kwargs)
         except Exception as e:  # noqa: BLE001 - invariant trip is data
             rec = {"seed": seed, "error":
                    f"{type(e).__name__}: {e}"[:300]}
@@ -144,12 +165,38 @@ def main() -> int:
         passed += 1
         worst_convergence = max(worst_convergence, out["convergence_s"])
         epochs_total += out["epochs"]
+        quarantines_seen += int(bool(out.get("quarantine_seen")))
+        if kwargs["autoscale"] and out.get("grp_decision_digest"):
+            forecast_probe = (seed, kwargs,
+                              out["grp_decision_digest"])
         for scope, e in out.get("pool_epochs", {}).items():
             pool_epochs[scope] = max(pool_epochs.get(scope, 0), int(e))
         owner_moves_total += int(out.get("owner_moves", 0))
         scope_owners.update(out.get("scope_owners", {}))
         for k in work:
             work[k] += out.get(k, 0)
+    # forecast determinism (ISSUE 20 satellite): replay the autoscale
+    # schedule's seed and require the identical decision journal —
+    # every predicted_rate the Holt filter stamped must reproduce, or
+    # the printed seeds are not debuggable
+    forecast = {}
+    if forecast_probe is not None:
+        seed, kwargs, digest = forecast_probe
+        try:
+            with tempfile.TemporaryDirectory() as d:
+                redo = run_seeded_schedule(seed, d, **kwargs)
+            deterministic = redo.get("grp_decision_digest") == digest
+        except Exception as e:  # noqa: BLE001 - replay trip is data
+            deterministic = False
+            failures.append({"seed": seed, "error":
+                             f"forecast replay: {type(e).__name__}: "
+                             f"{e}"[:300]})
+        forecast = {"forecast_digest": digest,
+                    "forecast_deterministic": deterministic}
+        if not deterministic and not any(
+                f.get("seed") == seed for f in failures):
+            failures.append({"seed": seed,
+                             "error": "forecast replay digest mismatch"})
     print(json.dumps({
         "suite": "chaos_soak", "schedules": args.schedules,
         "steps": args.steps, "hosts": args.hosts, "passed": passed,
@@ -159,7 +206,8 @@ def main() -> int:
         "scope_owners": scope_owners,
         "owner_moves": owner_moves_total,
         "worst_convergence_s": round(worst_convergence, 3),
-        **work}))
+        "quarantines_seen": quarantines_seen,
+        **forecast, **work}))
     return 0 if not failures else 1
 
 
